@@ -1,0 +1,29 @@
+"""kubeflow_tpu (CLI name: ``kfx``) — a TPU-native ML platform with Kubeflow's
+capabilities.
+
+The reference (scostache/kubeflow, a fork of kubeflow/kubeflow +
+training-operator/Katib/KFServing) is a set of Kubernetes CRDs and Go
+controllers orchestrating GPU training containers over NCCL/MPI rendezvous.
+This framework keeps the same *resource semantics* — declarative YAML
+resources, reconcile loops, gang all-or-nothing scheduling, status
+conditions, HPO experiments, low-latency serving — but the data plane is
+JAX-native: workers rendezvous via ``jax.distributed`` over a TPU slice,
+collectives ride XLA over ICI/DCN, models are flax/optax with orbax
+checkpoint/resume, and inference is XLA-compiled.
+
+Layout (mirrors SURVEY.md §2's component inventory):
+  api/        typed resource model (JAXJob, TFJob, PyTorchJob, MPIJob,
+              Experiment/Suggestion/Trial, InferenceService, Notebook, Profile)
+  core/       store + watch + workqueue + reconcile engine (L2 equivalent)
+  runtime/    gang process launcher + rendezvous env injection (L3 data plane)
+  operators/  per-kind controllers (L3-L6 equivalents)
+  hpo/        Katib-parity suggestion algorithms + metrics collection (L4)
+  serving/    KFServing-parity model server + InferenceService plumbing (L5)
+  models/     flax model zoo (MLP, ResNet, Transformer LM flagship)
+  data/       deterministic synthetic datasets (no-network environment)
+  ops/        pallas TPU kernels with XLA fallbacks
+  parallel/   mesh/sharding/collectives/ring-attention library
+  utils/      config, logging, small shared helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+"""Typed resource model (the CRD layer). Importing this package registers
+every kind in the registry."""
+
+from .base import (  # noqa: F401
+    API_GROUP,
+    Condition,
+    ObjectMeta,
+    Resource,
+    ValidationError,
+    from_manifest,
+    get_condition,
+    has_condition,
+    new_uid,
+    registered_kinds,
+    resource_class,
+    set_condition,
+    utcnow,
+)
+from .katib import (  # noqa: F401
+    Experiment,
+    Suggestion,
+    Trial,
+)
+from .manifest import dump_manifest, load_manifest_file, load_manifests  # noqa: F401
+from .platform import Notebook, PodDefault, Profile  # noqa: F401
+from .serving import InferenceService  # noqa: F401
+from .training import (  # noqa: F401
+    JAXJob,
+    MPIJob,
+    PyTorchJob,
+    ReplicaSpec,
+    RunPolicy,
+    TFJob,
+    TrainingJob,
+)

@@ -1,0 +1,292 @@
+"""Core resource model: the in-process equivalent of k8s API machinery.
+
+The reference's resources are CRDs admitted by the k8s apiserver (SURVEY.md
+§1 L0). With no cluster in this environment, resources are plain typed
+objects with the same observable contract: apiVersion/kind/metadata/spec/
+status, monotonically increasing resourceVersion, status conditions with
+lastTransitionTime, and generation tracking for spec changes.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import datetime
+import itertools
+import uuid
+from typing import Any, Callable, ClassVar, Dict, List, Optional
+
+API_GROUP = "kubeflow.org"
+
+
+def utcnow() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    )
+
+
+class ValidationError(ValueError):
+    """Spec failed validation (the admission-webhook equivalent)."""
+
+    def __init__(self, path: str, message: str):
+        self.path = path
+        self.message = message
+        super().__init__(f"{path}: {message}")
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    """Mirrors k8s ObjectMeta for the fields the controllers actually use."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: str = ""
+    deletion_timestamp: Optional[str] = None
+    owner_references: List[Dict[str, str]] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.uid:
+            d["uid"] = self.uid
+        if self.resource_version:
+            d["resourceVersion"] = str(self.resource_version)
+        if self.generation:
+            d["generation"] = self.generation
+        if self.creation_timestamp:
+            d["creationTimestamp"] = self.creation_timestamp
+        if self.deletion_timestamp:
+            d["deletionTimestamp"] = self.deletion_timestamp
+        if self.owner_references:
+            d["ownerReferences"] = [dict(o) for o in self.owner_references]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            uid=d.get("uid", ""),
+            resource_version=int(d.get("resourceVersion") or 0),
+            generation=int(d.get("generation") or 0),
+            creation_timestamp=d.get("creationTimestamp", ""),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            owner_references=list(d.get("ownerReferences") or []),
+        )
+
+
+@dataclasses.dataclass
+class Condition:
+    """Status condition, same shape as the reference's JobCondition
+    (tf-operator common lib: Created/Running/Restarting/Succeeded/Failed)."""
+
+    type: str
+    status: str = "True"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: str = dataclasses.field(default_factory=utcnow)
+    last_update_time: str = dataclasses.field(default_factory=utcnow)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastTransitionTime": self.last_transition_time,
+            "lastUpdateTime": self.last_update_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Condition":
+        return cls(
+            type=d["type"],
+            status=d.get("status", "True"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_transition_time=d.get("lastTransitionTime", utcnow()),
+            last_update_time=d.get("lastUpdateTime", utcnow()),
+        )
+
+
+def set_condition(conditions: List[Condition], cond: Condition) -> List[Condition]:
+    """Upsert a condition by type, preserving lastTransitionTime when the
+    status did not flip — identical semantics to the reference common lib's
+    updateJobConditions."""
+    out: List[Condition] = []
+    replaced = False
+    for c in conditions:
+        if c.type == cond.type:
+            if c.status == cond.status:
+                cond.last_transition_time = c.last_transition_time
+            out.append(cond)
+            replaced = True
+        else:
+            out.append(c)
+    if not replaced:
+        out.append(cond)
+    return out
+
+
+def get_condition(conditions: List[Condition], ctype: str) -> Optional[Condition]:
+    for c in conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def has_condition(conditions: List[Condition], ctype: str, status: str = "True") -> bool:
+    c = get_condition(conditions, ctype)
+    return c is not None and c.status == status
+
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    # uuid4-shaped but with a monotonic component for readable test logs.
+    return f"{uuid.uuid4().hex[:24]}{next(_uid_counter):08x}"
+
+
+class Resource:
+    """Base class for all typed resources.
+
+    Subclasses set ``KIND`` (and optionally ``API_VERSION``) and implement
+    ``spec_from_dict`` / ``spec_to_dict`` / ``validate``. ``status`` is a
+    plain dict so controllers can evolve it without schema churn, with
+    ``conditions`` handled uniformly here.
+    """
+
+    KIND: ClassVar[str] = ""
+    API_VERSION: ClassVar[str] = f"{API_GROUP}/v1"
+    # Kinds whose plural is used by the CLI (kfx get jaxjobs).
+    PLURAL: ClassVar[str] = ""
+
+    def __init__(self, metadata: Optional[ObjectMeta] = None,
+                 spec: Optional[Dict[str, Any]] = None,
+                 status: Optional[Dict[str, Any]] = None):
+        self.metadata = metadata or ObjectMeta()
+        self.spec: Dict[str, Any] = spec or {}
+        self.status: Dict[str, Any] = status or {}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    # -- conditions --------------------------------------------------------
+    @property
+    def conditions(self) -> List[Condition]:
+        return [Condition.from_dict(c) for c in self.status.get("conditions", [])]
+
+    def set_condition(self, ctype: str, status: str = "True", reason: str = "",
+                      message: str = "") -> None:
+        conds = set_condition(
+            self.conditions,
+            Condition(type=ctype, status=status, reason=reason, message=message),
+        )
+        self.status["conditions"] = [c.to_dict() for c in conds]
+
+    def has_condition(self, ctype: str, status: str = "True") -> bool:
+        return has_condition(self.conditions, ctype, status)
+
+    # -- (de)serialisation -------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": self.API_VERSION,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": copy.deepcopy(self.spec),
+            "status": copy.deepcopy(self.status),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Resource":
+        obj = cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=copy.deepcopy(d.get("spec") or {}),
+            status=copy.deepcopy(d.get("status") or {}),
+        )
+        return obj
+
+    def deepcopy(self) -> "Resource":
+        return self.__class__.from_dict(self.to_dict())
+
+    # -- validation (admission) -------------------------------------------
+    def validate(self) -> None:
+        """Raise ValidationError on a bad spec. Subclasses extend."""
+        if not self.metadata.name:
+            raise ValidationError("metadata.name", "required")
+        _validate_dns1123(self.metadata.name, "metadata.name")
+        _validate_dns1123(self.metadata.namespace, "metadata.namespace")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{self.KIND} {self.key} rv={self.metadata.resource_version}>"
+
+
+def _validate_dns1123(value: str, path: str) -> None:
+    import re
+
+    if not re.fullmatch(r"[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?", value):
+        raise ValidationError(path, f"{value!r} is not a valid DNS-1123 name")
+
+
+# ---------------------------------------------------------------------------
+# Kind registry (the CRD-registration equivalent)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator registering a Resource subclass by kind."""
+    if not issubclass(cls, Resource) or not cls.KIND:
+        raise TypeError(f"{cls} must subclass Resource and set KIND")
+    _REGISTRY[cls.KIND] = cls
+    if cls.PLURAL:
+        _REGISTRY[cls.PLURAL.lower()] = cls
+    _REGISTRY[cls.KIND.lower()] = cls
+    return cls
+
+
+def resource_class(kind: str) -> type:
+    try:
+        return _REGISTRY[kind] if kind in _REGISTRY else _REGISTRY[kind.lower()]
+    except KeyError:
+        raise KeyError(
+            f"unknown resource kind {kind!r}; registered: "
+            f"{sorted(k for k in _REGISTRY if k[0].isupper())}"
+        ) from None
+
+
+def registered_kinds() -> List[str]:
+    return sorted(k for k in _REGISTRY if k[0].isupper())
+
+
+def from_manifest(d: Dict[str, Any]) -> Resource:
+    """Build a typed resource from a parsed manifest dict."""
+    kind = d.get("kind")
+    if not kind:
+        raise ValidationError("kind", "required")
+    cls = resource_class(kind)
+    obj = cls.from_dict(d)
+    return obj

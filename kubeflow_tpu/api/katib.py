@@ -1,0 +1,194 @@
+"""HPO resources: Experiment / Suggestion / Trial — Katib API parity.
+
+Shapes follow the reference Katib v1beta1 API (SURVEY.md §2.1):
+``ExperimentSpec{objective, algorithm, parameters, trialTemplate,
+maxTrialCount, parallelTrialCount, maxFailedTrialCount}``; Suggestion holds
+requested/assigned parameter sets; Trial holds one rendered run and its
+observation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .base import Resource, ValidationError, register
+
+# Experiment/Trial condition vocabulary (Katib parity).
+EXP_CREATED = "Created"
+EXP_RUNNING = "Running"
+EXP_RESTARTING = "Restarting"
+EXP_GOAL_REACHED = "GoalReached"
+EXP_SUCCEEDED = "Succeeded"
+EXP_FAILED = "Failed"
+
+TRIAL_CREATED = "Created"
+TRIAL_RUNNING = "Running"
+TRIAL_SUCCEEDED = "Succeeded"
+TRIAL_FAILED = "Failed"
+TRIAL_EARLY_STOPPED = "EarlyStopped"
+TRIAL_METRICS_UNAVAILABLE = "MetricsUnavailable"
+
+OBJECTIVE_MAXIMIZE = "maximize"
+OBJECTIVE_MINIMIZE = "minimize"
+
+PARAM_INT = "int"
+PARAM_DOUBLE = "double"
+PARAM_DISCRETE = "discrete"
+PARAM_CATEGORICAL = "categorical"
+
+_VALID_PARAM_TYPES = {PARAM_INT, PARAM_DOUBLE, PARAM_DISCRETE, PARAM_CATEGORICAL}
+
+
+@register
+class Experiment(Resource):
+    KIND = "Experiment"
+    PLURAL = "experiments"
+
+    # -- spec accessors ----------------------------------------------------
+    def objective(self) -> Dict[str, Any]:
+        return self.spec.get("objective") or {}
+
+    def objective_metric(self) -> str:
+        return self.objective().get("objectiveMetricName", "")
+
+    def objective_type(self) -> str:
+        return self.objective().get("type", OBJECTIVE_MAXIMIZE)
+
+    def objective_goal(self) -> Optional[float]:
+        g = self.objective().get("goal")
+        return None if g is None else float(g)
+
+    def additional_metrics(self) -> List[str]:
+        return list(self.objective().get("additionalMetricNames") or [])
+
+    def algorithm_name(self) -> str:
+        return (self.spec.get("algorithm") or {}).get("algorithmName", "random")
+
+    def algorithm_settings(self) -> Dict[str, str]:
+        out = {}
+        for s in (self.spec.get("algorithm") or {}).get("algorithmSettings") or []:
+            out[s["name"]] = str(s.get("value", ""))
+        return out
+
+    def early_stopping(self) -> Optional[Dict[str, Any]]:
+        return self.spec.get("earlyStopping")
+
+    def parameters(self) -> List[Dict[str, Any]]:
+        return list(self.spec.get("parameters") or [])
+
+    def max_trial_count(self) -> int:
+        return int(self.spec.get("maxTrialCount", 12))
+
+    def parallel_trial_count(self) -> int:
+        return int(self.spec.get("parallelTrialCount", 3))
+
+    def max_failed_trial_count(self) -> int:
+        return int(self.spec.get("maxFailedTrialCount", 3))
+
+    def trial_template(self) -> Dict[str, Any]:
+        return self.spec.get("trialTemplate") or {}
+
+    def trial_parameters(self) -> List[Dict[str, str]]:
+        return list(self.trial_template().get("trialParameters") or [])
+
+    def metrics_collector_spec(self) -> Dict[str, Any]:
+        return self.spec.get("metricsCollectorSpec") or {"collector": {"kind": "StdOut"}}
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.objective_metric():
+            raise ValidationError("spec.objective.objectiveMetricName", "required")
+        if self.objective_type() not in (OBJECTIVE_MAXIMIZE, OBJECTIVE_MINIMIZE):
+            raise ValidationError("spec.objective.type",
+                                  f"{self.objective_type()!r} invalid")
+        if not self.parameters():
+            raise ValidationError("spec.parameters", "at least one required")
+        for i, p in enumerate(self.parameters()):
+            path = f"spec.parameters[{i}]"
+            if not p.get("name"):
+                raise ValidationError(f"{path}.name", "required")
+            ptype = p.get("parameterType")
+            if ptype not in _VALID_PARAM_TYPES:
+                raise ValidationError(f"{path}.parameterType",
+                                      f"{ptype!r} not in {sorted(_VALID_PARAM_TYPES)}")
+            fs = p.get("feasibleSpace") or {}
+            if ptype in (PARAM_INT, PARAM_DOUBLE):
+                if fs.get("min") is None or fs.get("max") is None:
+                    raise ValidationError(f"{path}.feasibleSpace", "min/max required")
+                if float(fs["min"]) > float(fs["max"]):
+                    raise ValidationError(f"{path}.feasibleSpace", "min > max")
+            else:
+                if not fs.get("list"):
+                    raise ValidationError(f"{path}.feasibleSpace.list", "required")
+        tmpl = self.trial_template()
+        if not tmpl.get("trialSpec"):
+            raise ValidationError("spec.trialTemplate.trialSpec", "required")
+
+    # -- status helpers ----------------------------------------------------
+    def trials_summary(self) -> Dict[str, int]:
+        s = self.status
+        return {
+            "trials": int(s.get("trials", 0)),
+            "running": int(s.get("trialsRunning", 0)),
+            "succeeded": int(s.get("trialsSucceeded", 0)),
+            "failed": int(s.get("trialsFailed", 0)),
+            "earlyStopped": int(s.get("trialsEarlyStopped", 0)),
+        }
+
+
+@register
+class Suggestion(Resource):
+    """Tracks how many suggestions were requested vs produced for an
+    experiment, plus the algorithm service state."""
+
+    KIND = "Suggestion"
+    PLURAL = "suggestions"
+
+    def requests(self) -> int:
+        return int(self.spec.get("requests", 0))
+
+    def algorithm_name(self) -> str:
+        return (self.spec.get("algorithm") or {}).get("algorithmName", "random")
+
+    def assignments(self) -> List[Dict[str, Any]]:
+        return list(self.status.get("suggestions") or [])
+
+    def validate(self) -> None:
+        super().validate()
+        if self.requests() < 0:
+            raise ValidationError("spec.requests", "must be >= 0")
+
+
+@register
+class Trial(Resource):
+    """One HPO trial: a rendered run spec + parameter assignments +
+    observation (final metric values)."""
+
+    KIND = "Trial"
+    PLURAL = "trials"
+
+    def parameter_assignments(self) -> List[Dict[str, Any]]:
+        return list(self.spec.get("parameterAssignments") or [])
+
+    def assignments_dict(self) -> Dict[str, str]:
+        return {a["name"]: str(a["value"]) for a in self.parameter_assignments()}
+
+    def run_spec(self) -> Dict[str, Any]:
+        return self.spec.get("runSpec") or {}
+
+    def objective_metric(self) -> str:
+        return (self.spec.get("objective") or {}).get("objectiveMetricName", "")
+
+    def observation(self) -> List[Dict[str, Any]]:
+        return list((self.status.get("observation") or {}).get("metrics") or [])
+
+    def final_metric(self, name: str) -> Optional[float]:
+        for m in self.observation():
+            if m.get("name") == name and m.get("latest") is not None:
+                return float(m["latest"])
+        return None
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.run_spec():
+            raise ValidationError("spec.runSpec", "required")

@@ -1,0 +1,39 @@
+"""YAML manifest loading — the `kubectl apply -f` input path.
+
+Accepts single- and multi-document YAML (``---`` separated), returning
+validated typed resources. Unknown kinds fail loudly (no silent drops),
+matching apiserver admission behavior.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, List, Union
+
+import yaml
+
+from .base import Resource, ValidationError, from_manifest
+
+
+def load_manifests(text: str) -> List[Resource]:
+    """Parse + validate every document in a YAML string."""
+    resources: List[Resource] = []
+    for i, doc in enumerate(yaml.safe_load_all(io.StringIO(text))):
+        if doc is None:
+            continue
+        if not isinstance(doc, dict):
+            raise ValidationError(f"document[{i}]", "manifest must be a mapping")
+        obj = from_manifest(doc)
+        obj.validate()
+        resources.append(obj)
+    return resources
+
+
+def load_manifest_file(path: str) -> List[Resource]:
+    with open(path, "r") as f:
+        return load_manifests(f.read())
+
+
+def dump_manifest(obj: Union[Resource, Dict[str, Any]]) -> str:
+    d = obj.to_dict() if isinstance(obj, Resource) else obj
+    return yaml.safe_dump(d, sort_keys=False, default_flow_style=False)

@@ -1,0 +1,111 @@
+"""Platform resources: Notebook, Profile, PodDefault — Kubeflow L6 parity.
+
+Reference shapes (SURVEY.md §2.1): notebook-controller's ``Notebook`` CR
+(pod template -> StatefulSet + routing), profile-controller's ``Profile``
+(per-user namespace + RBAC), and the admission-webhook's ``PodDefault``
+(env/volume injection into pods in a profile namespace).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from .base import Resource, ValidationError, register
+
+NOTEBOOK_READY = "Ready"
+NOTEBOOK_CULLED = "Culled"
+PROFILE_READY = "Ready"
+
+
+@register
+class Notebook(Resource):
+    """A long-running interactive process (reference: Jupyter StatefulSet).
+
+    Here the template's container command is launched as a supervised local
+    process with a routed local port; idle culling follows the reference
+    culler's last-activity contract."""
+
+    KIND = "Notebook"
+    PLURAL = "notebooks"
+
+    def template(self) -> Dict[str, Any]:
+        return self.spec.get("template") or {}
+
+    def container(self) -> Dict[str, Any]:
+        containers = ((self.template().get("spec") or {}).get("containers")) or []
+        return containers[0] if containers else {}
+
+    def argv(self) -> List[str]:
+        c = self.container()
+        return list(c.get("command") or []) + list(c.get("args") or [])
+
+    def culling_idle_seconds(self) -> int:
+        return int(self.metadata.annotations.get(
+            "notebooks.kubeflow.org/idle-seconds", "0"))
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.argv():
+            raise ValidationError(
+                "spec.template.spec.containers[0].command", "required")
+
+
+@register
+class Profile(Resource):
+    """Multi-tenancy root: owns a namespace, contributor bindings, and
+    resource quotas (reference profile-controller + kfam)."""
+
+    KIND = "Profile"
+    PLURAL = "profiles"
+
+    def owner(self) -> Dict[str, str]:
+        return self.spec.get("owner") or {}
+
+    def contributors(self) -> List[Dict[str, str]]:
+        return list(self.spec.get("contributors") or [])
+
+    def resource_quota(self) -> Dict[str, Any]:
+        return self.spec.get("resourceQuotaSpec") or {}
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.owner().get("name"):
+            raise ValidationError("spec.owner.name", "required")
+
+
+@register
+class PodDefault(Resource):
+    """Mutation template applied to workloads whose labels match
+    ``selector`` in the same namespace (reference admission-webhook)."""
+
+    KIND = "PodDefault"
+    PLURAL = "poddefaults"
+
+    def selector(self) -> Dict[str, str]:
+        return ((self.spec.get("selector") or {}).get("matchLabels")) or {}
+
+    def env(self) -> List[Dict[str, str]]:
+        return list(self.spec.get("env") or [])
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.spec.get("selector"):
+            raise ValidationError("spec.selector", "required")
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        sel = self.selector()
+        return bool(sel) and all(labels.get(k) == v for k, v in sel.items())
+
+    def apply_to_template(self, template: Dict[str, Any]) -> Dict[str, Any]:
+        """Return template with this PodDefault's env merged into every
+        container (existing keys win, matching webhook semantics)."""
+        import copy
+
+        out = copy.deepcopy(template)
+        containers = (out.setdefault("spec", {})).setdefault("containers", [])
+        for c in containers:
+            have = {e["name"] for e in c.setdefault("env", [])}
+            for e in self.env():
+                if e["name"] not in have:
+                    c["env"].append(dict(e))
+        return out

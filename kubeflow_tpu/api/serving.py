@@ -1,0 +1,92 @@
+"""Serving resource: InferenceService — KFServing API parity.
+
+Shape follows the reference KFServing v1beta1-era API (SURVEY.md §2.1):
+predictor/transformer/explainer components, framework-specific predictor
+specs (here: ``jax``/``sklearn``/``xgboost``/``pytorch``/``custom``),
+``storageUri`` model loading, default+canary traffic split
+(``canaryTrafficPercent``), and min/max replica autoscaling knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .base import Resource, ValidationError, register
+
+ISVC_READY = "Ready"
+ISVC_PREDICTOR_READY = "PredictorReady"
+ISVC_TRANSFORMER_READY = "TransformerReady"
+ISVC_FAILED = "Failed"
+
+PREDICTOR_FRAMEWORKS = ["jax", "sklearn", "xgboost", "pytorch", "tensorflow",
+                        "onnx", "triton", "custom"]
+COMPONENTS = ["predictor", "transformer", "explainer"]
+
+
+@register
+class InferenceService(Resource):
+    KIND = "InferenceService"
+    API_VERSION = "serving.kubeflow.org/v1beta1"
+    PLURAL = "inferenceservices"
+
+    # -- spec accessors ----------------------------------------------------
+    def component_spec(self, component: str) -> Optional[Dict[str, Any]]:
+        return self.spec.get(component)
+
+    def predictor(self) -> Dict[str, Any]:
+        return self.spec.get("predictor") or {}
+
+    def predictor_framework(self) -> str:
+        p = self.predictor()
+        for fw in PREDICTOR_FRAMEWORKS:
+            if fw in p:
+                return fw
+        if p.get("containers"):
+            return "custom"
+        return ""
+
+    def predictor_config(self) -> Dict[str, Any]:
+        fw = self.predictor_framework()
+        if fw == "custom":
+            return self.predictor().get("containers", [{}])[0]
+        return self.predictor().get(fw) or {}
+
+    def storage_uri(self) -> str:
+        return str(self.predictor_config().get("storageUri", ""))
+
+    def canary_traffic_percent(self) -> int:
+        return int(self.predictor().get("canaryTrafficPercent", 100))
+
+    def min_replicas(self) -> int:
+        return int(self.predictor().get("minReplicas", 1))
+
+    def max_replicas(self) -> int:
+        return int(self.predictor().get("maxReplicas", max(1, self.min_replicas())))
+
+    def scale_target_concurrency(self) -> int:
+        # Knative KPA-style: target in-flight requests per replica.
+        return int(self.predictor().get("scaleTarget", 8))
+
+    def batcher(self) -> Optional[Dict[str, Any]]:
+        """Micro-batching config: {maxBatchSize, maxLatencyMs} (KFServing
+        batcher annotation equivalent, promoted to a first-class field)."""
+        return self.predictor().get("batcher")
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.predictor():
+            raise ValidationError("spec.predictor", "required")
+        fw = self.predictor_framework()
+        if not fw:
+            raise ValidationError(
+                "spec.predictor",
+                f"one of {PREDICTOR_FRAMEWORKS} (or containers) required")
+        if fw != "custom" and not self.storage_uri():
+            raise ValidationError(f"spec.predictor.{fw}.storageUri", "required")
+        pct = self.canary_traffic_percent()
+        if not 0 <= pct <= 100:
+            raise ValidationError("spec.predictor.canaryTrafficPercent",
+                                  "must be in [0, 100]")
+        if self.min_replicas() < 0 or self.max_replicas() < self.min_replicas():
+            raise ValidationError("spec.predictor.minReplicas/maxReplicas",
+                                  "0 <= min <= max required")

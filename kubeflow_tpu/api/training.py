@@ -1,0 +1,252 @@
+"""Training job resources: JAXJob (the TPU-native flagship) plus
+TFJob/PyTorchJob/MPIJob compatibility kinds.
+
+Mirrors the reference's training-operator API surface (SURVEY.md §2.1):
+``*ReplicaSpecs`` keyed by replica type, a shared ``RunPolicy``
+(cleanPodPolicy, backoffLimit, ttlSecondsAfterFinished, schedulingPolicy),
+per-replica ``restartPolicy``, and the Created/Running/Restarting/
+Succeeded/Failed condition state machine.
+
+In this environment a "pod template" maps to a *process template*: the
+first container's command/args/env become the worker process argv/env.
+Stock manifests (with image/resources fields) are accepted verbatim; the
+container image is recorded but not acted on (no container runtime here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .base import Resource, ValidationError, register
+
+# Condition types (same vocabulary as the reference common lib).
+JOB_CREATED = "Created"
+JOB_RUNNING = "Running"
+JOB_RESTARTING = "Restarting"
+JOB_SUCCEEDED = "Succeeded"
+JOB_FAILED = "Failed"
+JOB_SUSPENDED = "Suspended"
+
+# Restart policies (per replica).
+RESTART_NEVER = "Never"
+RESTART_ON_FAILURE = "OnFailure"
+RESTART_ALWAYS = "Always"
+RESTART_EXIT_CODE = "ExitCode"  # retry only on retryable (>128) exit codes
+
+# Clean-pod policies.
+CLEAN_POD_ALL = "All"
+CLEAN_POD_RUNNING = "Running"
+CLEAN_POD_NONE = "None"
+
+_VALID_RESTART = {RESTART_NEVER, RESTART_ON_FAILURE, RESTART_ALWAYS, RESTART_EXIT_CODE}
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """One replica group (e.g. Worker x4). Parsed from the manifest's
+    ``replicas/template/restartPolicy`` shape."""
+
+    replicas: int = 1
+    restart_policy: str = RESTART_ON_FAILURE
+    template: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaSpec":
+        return cls(
+            replicas=int(d.get("replicas", 1)),
+            restart_policy=d.get("restartPolicy", RESTART_ON_FAILURE),
+            template=dict(d.get("template") or {}),
+        )
+
+    def container(self) -> Dict[str, Any]:
+        """First container of the pod template (the process definition)."""
+        containers = ((self.template.get("spec") or {}).get("containers")) or []
+        return containers[0] if containers else {}
+
+    def argv(self) -> List[str]:
+        c = self.container()
+        return list(c.get("command") or []) + list(c.get("args") or [])
+
+    def env(self) -> Dict[str, str]:
+        c = self.container()
+        return {e["name"]: str(e.get("value", "")) for e in c.get("env") or []}
+
+    def working_dir(self) -> Optional[str]:
+        return self.container().get("workingDir")
+
+    def validate(self, path: str) -> None:
+        if self.replicas < 0:
+            raise ValidationError(f"{path}.replicas", "must be >= 0")
+        if self.restart_policy not in _VALID_RESTART:
+            raise ValidationError(
+                f"{path}.restartPolicy",
+                f"{self.restart_policy!r} not in {sorted(_VALID_RESTART)}",
+            )
+
+
+@dataclasses.dataclass
+class RunPolicy:
+    clean_pod_policy: str = CLEAN_POD_RUNNING
+    backoff_limit: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    suspend: bool = False
+    # Gang scheduling knob (reference: volcano PodGroup minAvailable).
+    min_available: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RunPolicy":
+        sched = d.get("schedulingPolicy") or {}
+        return cls(
+            clean_pod_policy=d.get("cleanPodPolicy", CLEAN_POD_RUNNING),
+            backoff_limit=_opt_int(d.get("backoffLimit")),
+            active_deadline_seconds=_opt_int(d.get("activeDeadlineSeconds")),
+            ttl_seconds_after_finished=_opt_int(d.get("ttlSecondsAfterFinished")),
+            suspend=bool(d.get("suspend", False)),
+            min_available=_opt_int(sched.get("minAvailable")),
+        )
+
+
+def _opt_int(v: Any) -> Optional[int]:
+    return None if v is None else int(v)
+
+
+class TrainingJob(Resource):
+    """Shared behavior for all training-job kinds.
+
+    Subclasses set ``KIND``, ``REPLICA_SPECS_FIELD`` (e.g.
+    ``jaxReplicaSpecs``) and ``VALID_REPLICA_TYPES``.
+    """
+
+    REPLICA_SPECS_FIELD = ""
+    VALID_REPLICA_TYPES: List[str] = []
+    # Replica type elected "chief" for success semantics (first match wins).
+    CHIEF_PRIORITY: List[str] = []
+
+    def replica_specs(self) -> Dict[str, ReplicaSpec]:
+        raw = self.spec.get(self.REPLICA_SPECS_FIELD) or {}
+        return {rtype: ReplicaSpec.from_dict(d) for rtype, d in raw.items()}
+
+    def run_policy(self) -> RunPolicy:
+        # training-operator accepts runPolicy both nested and at top level
+        # (older API versions inlined it); accept both shapes.
+        merged = dict(self.spec.get("runPolicy") or {})
+        for k in ("cleanPodPolicy", "backoffLimit", "activeDeadlineSeconds",
+                  "ttlSecondsAfterFinished", "schedulingPolicy", "suspend"):
+            if k not in merged and k in self.spec:
+                merged[k] = self.spec[k]
+        return RunPolicy.from_dict(merged)
+
+    def total_replicas(self) -> int:
+        return sum(rs.replicas for rs in self.replica_specs().values())
+
+    def chief_replica_type(self) -> str:
+        specs = self.replica_specs()
+        for rt in self.CHIEF_PRIORITY:
+            if rt in specs and specs[rt].replicas > 0:
+                return rt
+        return next(iter(specs)) if specs else ""
+
+    def validate(self) -> None:
+        super().validate()
+        specs = self.replica_specs()
+        if not specs:
+            raise ValidationError(f"spec.{self.REPLICA_SPECS_FIELD}", "required")
+        for rtype, rs in specs.items():
+            if self.VALID_REPLICA_TYPES and rtype not in self.VALID_REPLICA_TYPES:
+                raise ValidationError(
+                    f"spec.{self.REPLICA_SPECS_FIELD}.{rtype}",
+                    f"not in {self.VALID_REPLICA_TYPES}",
+                )
+            rs.validate(f"spec.{self.REPLICA_SPECS_FIELD}.{rtype}")
+            if not rs.argv():
+                raise ValidationError(
+                    f"spec.{self.REPLICA_SPECS_FIELD}.{rtype}.template",
+                    "containers[0].command/args required (process argv)",
+                )
+
+    # -- status helpers used by operators ---------------------------------
+    def is_finished(self) -> bool:
+        return self.has_condition(JOB_SUCCEEDED) or self.has_condition(JOB_FAILED)
+
+    def replica_statuses(self) -> Dict[str, Dict[str, int]]:
+        return self.status.setdefault("replicaStatuses", {})
+
+
+@register
+class JAXJob(TrainingJob):
+    """TPU-native training job (the north-star CRD).
+
+    Replaces the reference PyTorchJob's NCCL rendezvous with
+    ``jax.distributed.initialize``: the operator starts every worker with
+    coordinator address / num_processes / process_id env, and all
+    collectives ride XLA over ICI/DCN (SURVEY.md §5.8).
+    """
+
+    KIND = "JAXJob"
+    PLURAL = "jaxjobs"
+    REPLICA_SPECS_FIELD = "jaxReplicaSpecs"
+    VALID_REPLICA_TYPES = ["Worker"]
+    CHIEF_PRIORITY = ["Worker"]
+
+
+@register
+class TFJob(TrainingJob):
+    """tf-operator-compatible kind. The operator injects ``TF_CONFIG``
+    (cluster spec + task) per replica, like the reference's genTFConfig."""
+
+    KIND = "TFJob"
+    PLURAL = "tfjobs"
+    REPLICA_SPECS_FIELD = "tfReplicaSpecs"
+    VALID_REPLICA_TYPES = ["Chief", "Master", "Worker", "PS", "Evaluator"]
+    CHIEF_PRIORITY = ["Chief", "Master", "Worker"]
+
+    def validate(self) -> None:
+        super().validate()
+        specs = self.replica_specs()
+        if "Chief" in specs and "Master" in specs:
+            raise ValidationError(
+                "spec.tfReplicaSpecs", "Chief and Master are mutually exclusive")
+
+
+@register
+class PyTorchJob(TrainingJob):
+    """pytorch-operator-compatible kind: Master+Worker, env rendezvous via
+    MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK (reference SetPodEnv)."""
+
+    KIND = "PyTorchJob"
+    PLURAL = "pytorchjobs"
+    REPLICA_SPECS_FIELD = "pytorchReplicaSpecs"
+    VALID_REPLICA_TYPES = ["Master", "Worker"]
+    CHIEF_PRIORITY = ["Master", "Worker"]
+
+    def validate(self) -> None:
+        super().validate()
+        specs = self.replica_specs()
+        if "Master" in specs and specs["Master"].replicas > 1:
+            raise ValidationError(
+                "spec.pytorchReplicaSpecs.Master.replicas", "must be <= 1")
+
+
+@register
+class MPIJob(TrainingJob):
+    """mpi-operator-compatible kind: Launcher+Worker, hostfile-based
+    ``mpirun`` from the launcher (reference newLauncher/newWorker)."""
+
+    KIND = "MPIJob"
+    PLURAL = "mpijobs"
+    REPLICA_SPECS_FIELD = "mpiReplicaSpecs"
+    VALID_REPLICA_TYPES = ["Launcher", "Worker"]
+    CHIEF_PRIORITY = ["Launcher"]
+    # slotsPerWorker lives at spec top level in the reference API.
+
+    def slots_per_worker(self) -> int:
+        return int(self.spec.get("slotsPerWorker", 1))
+
+    def validate(self) -> None:
+        super().validate()
+        specs = self.replica_specs()
+        if "Launcher" not in specs or specs["Launcher"].replicas != 1:
+            raise ValidationError(
+                "spec.mpiReplicaSpecs.Launcher.replicas", "exactly 1 required")

@@ -1,0 +1,298 @@
+"""Unit tests for the typed resource model (admission-level behavior).
+
+Mirrors the reference's table-driven controller API tests: build manifests,
+assert parsing/validation/condition semantics.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import (
+    Condition,
+    Experiment,
+    InferenceService,
+    JAXJob,
+    MPIJob,
+    PyTorchJob,
+    Resource,
+    TFJob,
+    ValidationError,
+    from_manifest,
+    load_manifests,
+    registered_kinds,
+    set_condition,
+)
+
+JAXJOB_YAML = """
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata:
+  name: mnist
+  namespace: team-a
+spec:
+  runPolicy:
+    backoffLimit: 3
+    cleanPodPolicy: Running
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 4
+      restartPolicy: OnFailure
+      template:
+        spec:
+          containers:
+          - name: jax
+            image: kfx/jax:latest
+            command: ["python", "-m", "kubeflow_tpu.runners.jax_runner"]
+            args: ["--model=mlp", "--steps=100"]
+            env:
+            - name: LR
+              value: "0.001"
+"""
+
+TFJOB_YAML = """
+apiVersion: kubeflow.org/v1
+kind: TFJob
+metadata: {name: tf-mnist}
+spec:
+  tfReplicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+          - name: tensorflow
+            command: ["python", "train.py"]
+"""
+
+
+class TestParsing:
+    def test_jaxjob_roundtrip(self):
+        (job,) = load_manifests(JAXJOB_YAML)
+        assert isinstance(job, JAXJob)
+        assert job.key == "team-a/mnist"
+        specs = job.replica_specs()
+        assert specs["Worker"].replicas == 4
+        assert specs["Worker"].argv() == [
+            "python", "-m", "kubeflow_tpu.runners.jax_runner",
+            "--model=mlp", "--steps=100"]
+        assert specs["Worker"].env() == {"LR": "0.001"}
+        assert job.run_policy().backoff_limit == 3
+        assert job.total_replicas() == 4
+        # dict round-trip preserves spec
+        clone = from_manifest(job.to_dict())
+        assert clone.to_dict()["spec"] == job.to_dict()["spec"]
+
+    def test_multi_document(self):
+        docs = load_manifests(JAXJOB_YAML + "\n---\n" + TFJOB_YAML)
+        assert [d.KIND for d in docs] == ["JAXJob", "TFJob"]
+
+    def test_unknown_kind_fails(self):
+        with pytest.raises(KeyError):
+            load_manifests("kind: FooBar\nmetadata: {name: x}\n")
+
+    def test_registered_kinds(self):
+        kinds = registered_kinds()
+        for k in ["JAXJob", "TFJob", "PyTorchJob", "MPIJob", "Experiment",
+                  "Suggestion", "Trial", "InferenceService", "Notebook",
+                  "Profile", "PodDefault"]:
+            assert k in kinds
+
+
+class TestValidation:
+    def test_missing_name(self):
+        with pytest.raises(ValidationError, match="metadata.name"):
+            load_manifests("kind: JAXJob\nmetadata: {}\nspec: {}\n")
+
+    def test_bad_dns_name(self):
+        with pytest.raises(ValidationError, match="DNS-1123"):
+            load_manifests(
+                "kind: JAXJob\nmetadata: {name: Bad_Name}\n"
+                "spec: {jaxReplicaSpecs: {}}\n")
+
+    def test_missing_replica_specs(self):
+        with pytest.raises(ValidationError, match="jaxReplicaSpecs"):
+            load_manifests("kind: JAXJob\nmetadata: {name: j}\nspec: {}\n")
+
+    def test_invalid_replica_type(self):
+        bad = JAXJOB_YAML.replace("Worker:", "Gardener:")
+        with pytest.raises(ValidationError, match="Gardener"):
+            load_manifests(bad)
+
+    def test_missing_command(self):
+        with pytest.raises(ValidationError, match="command"):
+            load_manifests("""
+kind: JAXJob
+metadata: {name: j}
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      template: {spec: {containers: [{name: c}]}}
+""")
+
+    def test_pytorch_master_singleton(self):
+        with pytest.raises(ValidationError, match="Master.replicas"):
+            load_manifests("""
+kind: PyTorchJob
+metadata: {name: p}
+spec:
+  pytorchReplicaSpecs:
+    Master:
+      replicas: 2
+      template: {spec: {containers: [{name: c, command: [python]}]}}
+""")
+
+    def test_mpi_launcher_required(self):
+        with pytest.raises(ValidationError, match="Launcher"):
+            load_manifests("""
+kind: MPIJob
+metadata: {name: m}
+spec:
+  mpiReplicaSpecs:
+    Worker:
+      replicas: 2
+      template: {spec: {containers: [{name: c, command: [python]}]}}
+""")
+
+    def test_tfjob_chief_master_exclusive(self):
+        with pytest.raises(ValidationError, match="mutually exclusive"):
+            load_manifests("""
+kind: TFJob
+metadata: {name: t}
+spec:
+  tfReplicaSpecs:
+    Chief:
+      replicas: 1
+      template: {spec: {containers: [{name: c, command: [python]}]}}
+    Master:
+      replicas: 1
+      template: {spec: {containers: [{name: c, command: [python]}]}}
+""")
+
+
+class TestConditions:
+    def test_set_preserves_transition_time(self):
+        job = JAXJob.from_dict({"metadata": {"name": "j"}})
+        job.set_condition("Running", "True", reason="JobRunning")
+        t0 = job.conditions[0].last_transition_time
+        job.set_condition("Running", "True", reason="StillRunning")
+        assert job.conditions[0].last_transition_time == t0
+        assert job.conditions[0].reason == "StillRunning"
+
+    def test_status_flip_updates_transition_time(self):
+        conds = [Condition(type="Running", status="True",
+                           last_transition_time="2020-01-01T00:00:00Z")]
+        conds = set_condition(conds, Condition(type="Running", status="False"))
+        assert conds[0].last_transition_time != "2020-01-01T00:00:00Z"
+
+    def test_chief_priority(self):
+        (job,) = load_manifests(TFJOB_YAML)
+        assert job.chief_replica_type() == "Worker"
+
+
+class TestKatibResources:
+    EXPERIMENT_YAML = """
+kind: Experiment
+metadata: {name: random-search}
+spec:
+  objective:
+    type: maximize
+    goal: 0.99
+    objectiveMetricName: accuracy
+  algorithm: {algorithmName: random}
+  maxTrialCount: 12
+  parallelTrialCount: 3
+  parameters:
+  - name: lr
+    parameterType: double
+    feasibleSpace: {min: "0.001", max: "0.1"}
+  - name: layers
+    parameterType: int
+    feasibleSpace: {min: "2", max: "5"}
+  - name: optimizer
+    parameterType: categorical
+    feasibleSpace: {list: [sgd, adam]}
+  trialTemplate:
+    trialParameters:
+    - {name: learningRate, reference: lr}
+    trialSpec:
+      kind: JAXJob
+      metadata: {name: trial}
+      spec:
+        jaxReplicaSpecs:
+          Worker:
+            replicas: 1
+            template:
+              spec:
+                containers:
+                - name: jax
+                  command: ["python", "-m", "x", "--lr=${trialParameters.learningRate}"]
+"""
+
+    def test_experiment_parse(self):
+        (exp,) = load_manifests(self.EXPERIMENT_YAML)
+        assert isinstance(exp, Experiment)
+        assert exp.objective_metric() == "accuracy"
+        assert exp.objective_goal() == 0.99
+        assert exp.algorithm_name() == "random"
+        assert len(exp.parameters()) == 3
+        assert exp.max_trial_count() == 12
+
+    def test_experiment_validation(self):
+        bad = self.EXPERIMENT_YAML.replace('max: "0.1"', 'max: "0.0001"')
+        with pytest.raises(ValidationError, match="min > max"):
+            load_manifests(bad)
+
+
+class TestInferenceService:
+    ISVC_YAML = """
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata: {name: resnet}
+spec:
+  predictor:
+    canaryTrafficPercent: 80
+    minReplicas: 1
+    maxReplicas: 4
+    jax:
+      storageUri: "file:///tmp/models/resnet"
+"""
+
+    def test_parse(self):
+        (isvc,) = load_manifests(self.ISVC_YAML)
+        assert isinstance(isvc, InferenceService)
+        assert isvc.predictor_framework() == "jax"
+        assert isvc.storage_uri() == "file:///tmp/models/resnet"
+        assert isvc.canary_traffic_percent() == 80
+        assert isvc.max_replicas() == 4
+
+    def test_requires_predictor(self):
+        with pytest.raises(ValidationError, match="predictor"):
+            load_manifests("kind: InferenceService\nmetadata: {name: x}\nspec: {}\n")
+
+    def test_bad_canary_pct(self):
+        bad = self.ISVC_YAML.replace("80", "180")
+        with pytest.raises(ValidationError, match="canaryTrafficPercent"):
+            load_manifests(bad)
+
+
+class TestPodDefault:
+    def test_apply(self):
+        from kubeflow_tpu.api import PodDefault
+
+        pd = PodDefault.from_dict({
+            "metadata": {"name": "add-token"},
+            "spec": {
+                "selector": {"matchLabels": {"team": "a"}},
+                "env": [{"name": "TOKEN", "value": "s3cret"},
+                        {"name": "LR", "value": "9.9"}],
+            },
+        })
+        assert pd.matches({"team": "a", "x": "y"})
+        assert not pd.matches({"team": "b"})
+        tmpl = {"spec": {"containers": [
+            {"name": "c", "env": [{"name": "LR", "value": "0.1"}]}]}}
+        out = pd.apply_to_template(tmpl)
+        env = {e["name"]: e["value"] for e in out["spec"]["containers"][0]["env"]}
+        assert env == {"LR": "0.1", "TOKEN": "s3cret"}  # existing key wins
+        # original untouched
+        assert len(tmpl["spec"]["containers"][0]["env"]) == 1
