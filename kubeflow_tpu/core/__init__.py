@@ -1,0 +1,16 @@
+"""Control-plane engine: store + watch + workqueue + reconcile (L2)."""
+
+from .controller import Controller, Manager, Result  # noqa: F401
+from .store import (  # noqa: F401
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    Event,
+    NotFound,
+    ResourceStore,
+    Watch,
+    WatchEvent,
+)
+from .workqueue import RateLimitingQueue  # noqa: F401

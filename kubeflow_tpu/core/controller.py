@@ -1,0 +1,210 @@
+"""Controller engine: informer dispatch + reconcile loops.
+
+Mirrors the reference's controller-runtime shape (SURVEY.md §1 L2): each
+controller owns a rate-limited workqueue and a reconcile function keyed by
+``namespace/name``; a Manager fans store watch events out to interested
+controllers (including owner-reference routing, so a child's change
+enqueues its parent — the reference's `Owns(...)` relation) and runs each
+controller's worker loop on its own thread, keeping single-writer-per-
+resource discipline (one worker per controller by default).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+from ..api.base import Resource
+from .store import DELETED, ResourceStore, WatchEvent
+from .workqueue import RateLimitingQueue
+
+log = logging.getLogger("kfx.controller")
+
+
+class Result:
+    """Reconcile result: optionally requeue (with delay)."""
+
+    __slots__ = ("requeue", "requeue_after")
+
+    def __init__(self, requeue: bool = False, requeue_after: float = 0.0):
+        self.requeue = requeue
+        self.requeue_after = requeue_after
+
+
+class Controller:
+    """Base reconciler. Subclasses set KIND, optionally OWNS (child kinds
+    whose events route to the owner), and implement reconcile(key)."""
+
+    KIND: str = ""
+    OWNS: List[str] = []
+    MAX_RETRIES: Optional[int] = None  # None = retry forever with backoff
+    RESYNC_PERIOD: Optional[float] = None
+
+    def __init__(self, store: ResourceStore):
+        self.store = store
+        self.queue = RateLimitingQueue()
+
+    # -- helpers -----------------------------------------------------------
+    def get_resource(self, key: str) -> Optional[Resource]:
+        ns, _, name = key.partition("/")
+        return self.store.try_get(self.KIND, name, ns)
+
+    def record_event(self, obj: Resource, etype: str, reason: str,
+                     message: str) -> None:
+        self.store.record_event(obj, etype, reason, message)
+        log.info("%s %s: %s %s: %s", self.KIND, obj.key, etype, reason, message)
+
+    # -- the reconcile contract -------------------------------------------
+    def reconcile(self, key: str) -> Optional[Result]:
+        raise NotImplementedError
+
+    def on_delete(self, obj: Resource) -> None:
+        """Called when a resource of this controller's kind is deleted
+        (finalizer-equivalent cleanup hook)."""
+
+    def map_child(self, obj: Resource) -> Optional[str]:
+        """Map an un-owned child event (kind in OWNS, no ownerReferences)
+        to a parent key to enqueue. Default: no mapping."""
+        return None
+
+    # -- worker loop -------------------------------------------------------
+    def _process_one(self) -> bool:
+        key = self.queue.get(timeout=0.2)
+        if key is None:
+            return False
+        try:
+            result = self.reconcile(key)
+        except Exception:
+            log.error("reconcile %s %s failed:\n%s", self.KIND, key,
+                      traceback.format_exc())
+            retries = self.queue.num_requeues(key)
+            if self.MAX_RETRIES is None or retries < self.MAX_RETRIES:
+                self.queue.add_rate_limited(key)
+            else:
+                log.error("giving up on %s %s after %d retries",
+                          self.KIND, key, retries)
+                self.queue.forget(key)
+        else:
+            self.queue.forget(key)
+            if result is not None and result.requeue:
+                self.queue.add_after(key, result.requeue_after)
+        finally:
+            self.queue.done(key)
+        return True
+
+    def run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            self._process_one()
+
+
+class Manager:
+    """Owns the store, the shared informer dispatch, and controller threads."""
+
+    def __init__(self, store: Optional[ResourceStore] = None):
+        self.store = store or ResourceStore()
+        self.controllers: Dict[str, Controller] = {}
+        self._owns_index: Dict[str, List[Controller]] = {}
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._watch = None
+        self._started = False
+
+    def register(self, controller: Controller) -> None:
+        if controller.KIND in self.controllers:
+            raise ValueError(f"duplicate controller for {controller.KIND}")
+        self.controllers[controller.KIND] = controller
+        for kind in controller.OWNS:
+            self._owns_index.setdefault(kind, []).append(controller)
+
+    # -- informer dispatch -------------------------------------------------
+    def _dispatch(self, ev: WatchEvent) -> None:
+        obj = ev.resource
+        ctrl = self.controllers.get(obj.KIND)
+        if ctrl is not None:
+            if ev.type == DELETED:
+                try:
+                    ctrl.on_delete(obj)
+                except Exception:
+                    log.error("on_delete %s %s failed:\n%s", obj.KIND, obj.key,
+                              traceback.format_exc())
+            else:
+                ctrl.queue.add(obj.key)
+        # Owner routing: a child event enqueues the owning parent.
+        for owner_ref in obj.metadata.owner_references:
+            okind = owner_ref.get("kind", "")
+            oname = owner_ref.get("name", "")
+            octrl = self.controllers.get(okind)
+            if octrl is not None and oname:
+                octrl.queue.add(f"{obj.namespace}/{oname}")
+        # Interest beyond ownership: a controller that OWNS a kind gets every
+        # event of that kind routed through map_child (identity -> no-op when
+        # the child carries ownerReferences, which already routed above).
+        for watcher in self._owns_index.get(obj.KIND, []):
+            if not obj.metadata.owner_references:
+                key = watcher.map_child(obj)
+                if key:
+                    watcher.queue.add(key)
+
+    def _informer_loop(self) -> None:
+        assert self._watch is not None
+        for ev in self._watch:
+            if self._stop.is_set():
+                return
+            try:
+                self._dispatch(ev)
+            except Exception:  # pragma: no cover - defensive
+                log.error("dispatch failed:\n%s", traceback.format_exc())
+
+    def _resync_loop(self) -> None:
+        import time
+
+        last: Dict[str, float] = {}
+        while not self._stop.wait(0.5):
+            now = time.monotonic()
+            for ctrl in self.controllers.values():
+                period = ctrl.RESYNC_PERIOD
+                if period is None:
+                    continue
+                if now - last.get(ctrl.KIND, 0.0) >= period:
+                    last[ctrl.KIND] = now
+                    for obj in self.store.list(ctrl.KIND):
+                        ctrl.queue.add(obj.key)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("manager already started")
+        self._started = True
+        self._watch = self.store.watch(send_initial=True)
+        t = threading.Thread(target=self._informer_loop, name="kfx-informer",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._resync_loop, name="kfx-resync",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        for ctrl in self.controllers.values():
+            t = threading.Thread(target=ctrl.run, args=(self._stop,),
+                                 name=f"kfx-{ctrl.KIND.lower()}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        for ctrl in self.controllers.values():
+            ctrl.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads.clear()
+
+    def __enter__(self) -> "Manager":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

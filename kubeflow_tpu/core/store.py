@@ -1,0 +1,319 @@
+"""Resource store: the apiserver+etcd equivalent.
+
+Thread-safe in-memory store of typed resources with:
+  * optimistic concurrency via resourceVersion (conflict on stale writes),
+  * generation bump on spec changes (status writes don't bump it),
+  * watch streams (ADDED/MODIFIED/DELETED events fanned out to subscribers),
+  * optional sqlite journal so the control plane can restart and resume.
+
+The reference gets all of this from the k8s API machinery (SURVEY.md §1
+L0); here it is ~300 lines because we need exactly the subset the
+controllers observe.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sqlite3
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..api.base import ObjectMeta, Resource, from_manifest, new_uid, utcnow
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class Conflict(Exception):
+    """Stale resourceVersion on update (the 409 equivalent)."""
+
+
+class NotFound(KeyError):
+    """Resource does not exist (the 404 equivalent)."""
+
+
+class AlreadyExists(Exception):
+    """Create of an existing name (the 409 AlreadyExists equivalent)."""
+
+
+class WatchEvent:
+    __slots__ = ("type", "resource")
+
+    def __init__(self, type: str, resource: Resource):
+        self.type = type
+        self.resource = resource
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"WatchEvent({self.type}, {self.resource!r})"
+
+
+class Event:
+    """A k8s Event equivalent: recorded against an involved object."""
+
+    __slots__ = ("timestamp", "type", "reason", "message", "kind", "key")
+
+    def __init__(self, kind: str, key: str, etype: str, reason: str, message: str,
+                 timestamp: Optional[str] = None):
+        self.timestamp = timestamp or utcnow()
+        self.type = etype  # "Normal" | "Warning"
+        self.reason = reason
+        self.message = message
+        self.kind = kind
+        self.key = key
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"timestamp": self.timestamp, "type": self.type,
+                "reason": self.reason, "message": self.message,
+                "kind": self.kind, "key": self.key}
+
+
+class ResourceStore:
+    def __init__(self, journal_path: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], Resource] = {}
+        self._rv = 0
+        self._watchers: List[queue.Queue] = []
+        self._events: List[Event] = []
+        self._journal: Optional[sqlite3.Connection] = None
+        self._journal_lock = threading.Lock()
+        if journal_path:
+            self._open_journal(journal_path)
+
+    # -- journal -----------------------------------------------------------
+    def _open_journal(self, path: str) -> None:
+        conn = sqlite3.connect(path, check_same_thread=False)
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS resources ("
+            " kind TEXT, namespace TEXT, name TEXT, manifest TEXT,"
+            " PRIMARY KEY (kind, namespace, name))")
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS events ("
+            " ts TEXT, kind TEXT, key TEXT, type TEXT, reason TEXT, message TEXT)")
+        conn.commit()
+        self._journal = conn
+        # Recover prior state.
+        for (manifest,) in conn.execute("SELECT manifest FROM resources"):
+            obj = from_manifest(json.loads(manifest))
+            k = self._key(obj)
+            self._objects[k] = obj
+            self._rv = max(self._rv, obj.metadata.resource_version)
+
+    def _journal_put(self, obj: Resource) -> None:
+        if self._journal is None:
+            return
+        with self._journal_lock:
+            self._journal.execute(
+                "INSERT OR REPLACE INTO resources VALUES (?,?,?,?)",
+                (obj.KIND, obj.namespace, obj.name, json.dumps(obj.to_dict())))
+            self._journal.commit()
+
+    def _journal_delete(self, obj: Resource) -> None:
+        if self._journal is None:
+            return
+        with self._journal_lock:
+            self._journal.execute(
+                "DELETE FROM resources WHERE kind=? AND namespace=? AND name=?",
+                (obj.KIND, obj.namespace, obj.name))
+            self._journal.commit()
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _key(obj: Resource) -> Tuple[str, str, str]:
+        return (obj.KIND, obj.metadata.namespace, obj.metadata.name)
+
+    def _notify(self, etype: str, obj: Resource) -> None:
+        ev = WatchEvent(etype, obj.deepcopy())
+        for q in list(self._watchers):
+            q.put(ev)
+
+    # -- CRUD --------------------------------------------------------------
+    def create(self, obj: Resource) -> Resource:
+        obj.validate()
+        with self._lock:
+            k = self._key(obj)
+            if k in self._objects:
+                raise AlreadyExists(f"{obj.KIND} {obj.key} already exists")
+            self._rv += 1
+            stored = obj.deepcopy()
+            m = stored.metadata
+            m.uid = m.uid or new_uid()
+            m.resource_version = self._rv
+            m.generation = 1
+            m.creation_timestamp = m.creation_timestamp or utcnow()
+            self._objects[k] = stored
+            self._journal_put(stored)
+            self._notify(ADDED, stored)
+            return stored.deepcopy()
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        with self._lock:
+            try:
+                return self._objects[(kind, namespace, name)].deepcopy()
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name} not found") from None
+
+    def try_get(self, kind: str, name: str,
+                namespace: str = "default") -> Optional[Resource]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, obj: Resource, subresource: str = "") -> Resource:
+        """Full update with optimistic concurrency. ``subresource='status'``
+        keeps the stored spec (mirroring the /status subresource split)."""
+        with self._lock:
+            k = self._key(obj)
+            if k not in self._objects:
+                raise NotFound(f"{obj.KIND} {obj.key} not found")
+            current = self._objects[k]
+            if (obj.metadata.resource_version
+                    and obj.metadata.resource_version != current.metadata.resource_version):
+                raise Conflict(
+                    f"{obj.KIND} {obj.key}: stale resourceVersion "
+                    f"{obj.metadata.resource_version} != {current.metadata.resource_version}")
+            self._rv += 1
+            stored = obj.deepcopy()
+            sm, cm = stored.metadata, current.metadata
+            sm.uid = cm.uid
+            sm.creation_timestamp = cm.creation_timestamp
+            sm.resource_version = self._rv
+            if subresource == "status":
+                stored.spec = current.deepcopy().spec
+                sm.generation = cm.generation
+            else:
+                spec_changed = stored.spec != current.spec
+                sm.generation = cm.generation + (1 if spec_changed else 0)
+            self._objects[k] = stored
+            self._journal_put(stored)
+            self._notify(MODIFIED, stored)
+            return stored.deepcopy()
+
+    def update_status(self, obj: Resource) -> Resource:
+        return self.update(obj, subresource="status")
+
+    def apply(self, obj: Resource) -> Tuple[Resource, str]:
+        """Server-side-apply-style upsert (the `kubectl apply` path).
+        Returns (stored, "created"|"configured"|"unchanged")."""
+        with self._lock:
+            existing = self.try_get(obj.KIND, obj.name, obj.namespace)
+            if existing is None:
+                return self.create(obj), "created"
+            if existing.spec == obj.spec and \
+               existing.metadata.labels == obj.metadata.labels and \
+               existing.metadata.annotations == obj.metadata.annotations:
+                return existing, "unchanged"
+            merged = existing.deepcopy()
+            merged.spec = obj.deepcopy().spec
+            merged.metadata.labels = dict(obj.metadata.labels)
+            merged.metadata.annotations = dict(obj.metadata.annotations)
+            return self.update(merged), "configured"
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        with self._lock:
+            k = (kind, namespace, name)
+            if k not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = self._objects.pop(k)
+            obj.metadata.deletion_timestamp = utcnow()
+            self._journal_delete(obj)
+            self._notify(DELETED, obj)
+            return obj.deepcopy()
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Resource]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not all(
+                        obj.metadata.labels.get(a) == b
+                        for a, b in label_selector.items()):
+                    continue
+                out.append(obj.deepcopy())
+            return out
+
+    def list_all(self) -> List[Resource]:
+        with self._lock:
+            return [o.deepcopy() for _, o in sorted(self._objects.items())]
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, send_initial: bool = True) -> "Watch":
+        """Subscribe to all changes. With ``send_initial``, current objects
+        are replayed as ADDED first (informer list+watch semantics)."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            if send_initial:
+                for obj in self.list_all():
+                    q.put(WatchEvent(ADDED, obj))
+            self._watchers.append(q)
+        return Watch(self, q)
+
+    def _unwatch(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._watchers:
+                self._watchers.remove(q)
+
+    # -- events ------------------------------------------------------------
+    def record_event(self, obj: Resource, etype: str, reason: str,
+                     message: str) -> None:
+        ev = Event(obj.KIND, obj.key, etype, reason, message)
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > 10000:
+                self._events = self._events[-5000:]
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.execute(
+                    "INSERT INTO events VALUES (?,?,?,?,?,?)",
+                    (ev.timestamp, ev.kind, ev.key, ev.type, ev.reason, ev.message))
+                self._journal.commit()
+
+    def events_for(self, kind: str, key: str) -> List[Event]:
+        with self._lock:
+            return [e for e in self._events if e.kind == kind and e.key == key]
+
+    def close(self) -> None:
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.close()
+            self._journal = None
+
+
+class Watch:
+    """Iterator over watch events; ``stop()`` (or context exit) detaches."""
+
+    def __init__(self, store: ResourceStore, q: queue.Queue):
+        self._store = store
+        self._q = q
+        self._stopped = threading.Event()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._store._unwatch(self._q)
+        self._q.put(None)  # wake any blocked reader
+
+    def __enter__(self) -> "Watch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return None if ev is None or self._stopped.is_set() else ev
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while not self._stopped.is_set():
+            ev = self._q.get()
+            if ev is None or self._stopped.is_set():
+                return
+            yield ev

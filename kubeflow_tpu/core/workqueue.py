@@ -1,0 +1,123 @@
+"""Rate-limited work queue — client-go workqueue semantics.
+
+The reference controllers all share this shape (SURVEY.md §2.1 "common"):
+a de-duplicating queue where a key being processed is marked dirty if
+re-added, plus per-key exponential backoff for failed reconciles.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 16.0):
+        self._cond = threading.Condition()
+        self._queue: List[str] = []          # FIFO of ready keys
+        self._queued: Set[str] = set()       # keys in _queue
+        self._processing: Set[str] = set()   # keys handed out, not yet done()
+        self._dirty: Set[str] = set()        # re-added while processing
+        self._delayed: List[Tuple[float, int, str]] = []  # heap (when, seq, key)
+        self._seq = 0
+        self._failures: Dict[str, int] = {}
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._shutdown = False
+
+    # -- adding ------------------------------------------------------------
+    def add(self, key: str) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if key in self._processing:
+                self._dirty.add(key)
+                return
+            if key not in self._queued:
+                self._queue.append(key)
+                self._queued.add(key)
+                self._cond.notify()
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
+            self._cond.notify()
+
+    def add_rate_limited(self, key: str) -> None:
+        """Re-queue with exponential per-key backoff (failure path)."""
+        with self._cond:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        delay = min(self._base_delay * (2 ** n), self._max_delay)
+        self.add_after(key, delay)
+
+    def forget(self, key: str) -> None:
+        with self._cond:
+            self._failures.pop(key, None)
+
+    def num_requeues(self, key: str) -> int:
+        with self._cond:
+            return self._failures.get(key, 0)
+
+    # -- consuming ---------------------------------------------------------
+    def _promote_delayed_locked(self) -> Optional[float]:
+        """Move due delayed items into the ready queue. Returns seconds
+        until the next delayed item, or None."""
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self._delayed)
+            if key in self._processing:
+                self._dirty.add(key)
+            elif key not in self._queued:
+                self._queue.append(key)
+                self._queued.add(key)
+        return (self._delayed[0][0] - now) if self._delayed else None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Block for the next key. None on timeout or shutdown."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._shutdown:
+                    return None
+                next_delay = self._promote_delayed_locked()
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._queued.discard(key)
+                    self._processing.add(key)
+                    return key
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(timeout=wait)
+
+    def done(self, key: str) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if key not in self._queued and not self._shutdown:
+                    self._queue.append(key)
+                    self._queued.add(key)
+                    self._cond.notify()
+
+    # -- lifecycle ---------------------------------------------------------
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue) + len(self._delayed)

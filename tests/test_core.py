@@ -1,0 +1,271 @@
+"""Controller-engine tests: store semantics (resourceVersion, generation,
+watch), workqueue dedup/backoff, and reconcile dispatch — the fake-clientset
+tier of the reference's test strategy (SURVEY.md §4)."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import JAXJob
+from kubeflow_tpu.core import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    Controller,
+    Manager,
+    NotFound,
+    RateLimitingQueue,
+    ResourceStore,
+    Result,
+)
+
+
+def mkjob(name, ns="default", replicas=1):
+    return JAXJob.from_dict({
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"jaxReplicaSpecs": {"Worker": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [
+                {"name": "c", "command": ["python", "-c", "pass"]}]}},
+        }}},
+    })
+
+
+class TestStore:
+    def test_create_get_roundtrip(self):
+        s = ResourceStore()
+        stored = s.create(mkjob("a"))
+        assert stored.metadata.uid
+        assert stored.metadata.resource_version == 1
+        assert stored.metadata.generation == 1
+        got = s.get("JAXJob", "a")
+        assert got.spec == stored.spec
+
+    def test_create_duplicate(self):
+        s = ResourceStore()
+        s.create(mkjob("a"))
+        with pytest.raises(AlreadyExists):
+            s.create(mkjob("a"))
+
+    def test_update_conflict_on_stale_rv(self):
+        s = ResourceStore()
+        s.create(mkjob("a"))
+        c1 = s.get("JAXJob", "a")
+        c2 = s.get("JAXJob", "a")
+        c1.status["x"] = 1
+        s.update(c1)
+        c2.status["x"] = 2
+        with pytest.raises(Conflict):
+            s.update(c2)
+
+    def test_generation_bumps_only_on_spec_change(self):
+        s = ResourceStore()
+        s.create(mkjob("a"))
+        obj = s.get("JAXJob", "a")
+        obj.status["phase"] = "Running"
+        obj = s.update(obj)
+        assert obj.metadata.generation == 1  # status-only change
+        obj.spec["runPolicy"] = {"backoffLimit": 5}
+        obj = s.update(obj)
+        assert obj.metadata.generation == 2
+
+    def test_update_status_preserves_spec(self):
+        s = ResourceStore()
+        s.create(mkjob("a", replicas=2))
+        obj = s.get("JAXJob", "a")
+        obj.spec["jaxReplicaSpecs"]["Worker"]["replicas"] = 99
+        obj.status["phase"] = "Running"
+        s.update_status(obj)
+        got = s.get("JAXJob", "a")
+        assert got.spec["jaxReplicaSpecs"]["Worker"]["replicas"] == 2
+        assert got.status["phase"] == "Running"
+
+    def test_apply_semantics(self):
+        s = ResourceStore()
+        _, verb = s.apply(mkjob("a"))
+        assert verb == "created"
+        _, verb = s.apply(mkjob("a"))
+        assert verb == "unchanged"
+        _, verb = s.apply(mkjob("a", replicas=3))
+        assert verb == "configured"
+        assert s.get("JAXJob", "a").metadata.generation == 2
+
+    def test_delete_and_notfound(self):
+        s = ResourceStore()
+        s.create(mkjob("a"))
+        s.delete("JAXJob", "a")
+        with pytest.raises(NotFound):
+            s.get("JAXJob", "a")
+        with pytest.raises(NotFound):
+            s.delete("JAXJob", "a")
+
+    def test_list_namespace_and_labels(self):
+        s = ResourceStore()
+        j = mkjob("a", ns="ns1")
+        j.metadata.labels["team"] = "x"
+        s.create(j)
+        s.create(mkjob("b", ns="ns2"))
+        assert [o.name for o in s.list("JAXJob")] == ["a", "b"]
+        assert [o.name for o in s.list("JAXJob", namespace="ns1")] == ["a"]
+        assert [o.name for o in s.list("JAXJob",
+                                       label_selector={"team": "x"})] == ["a"]
+        assert s.list("JAXJob", label_selector={"team": "y"}) == []
+
+    def test_watch_stream(self):
+        s = ResourceStore()
+        s.create(mkjob("pre"))
+        with s.watch() as w:
+            ev = w.next(timeout=1)
+            assert (ev.type, ev.resource.name) == (ADDED, "pre")
+            s.create(mkjob("a"))
+            assert w.next(timeout=1).type == ADDED
+            obj = s.get("JAXJob", "a")
+            obj.status["p"] = 1
+            s.update(obj)
+            assert w.next(timeout=1).type == MODIFIED
+            s.delete("JAXJob", "a")
+            assert w.next(timeout=1).type == DELETED
+
+    def test_journal_recovery(self, tmp_path):
+        path = str(tmp_path / "journal.db")
+        s1 = ResourceStore(journal_path=path)
+        s1.create(mkjob("a", replicas=4))
+        obj = s1.get("JAXJob", "a")
+        obj.status["phase"] = "Running"
+        s1.update(obj)
+        s1.close()
+        s2 = ResourceStore(journal_path=path)
+        got = s2.get("JAXJob", "a")
+        assert got.status["phase"] == "Running"
+        assert got.replica_specs()["Worker"].replicas == 4
+        # rv continues past recovered max
+        s2.create(mkjob("b"))
+        assert s2.get("JAXJob", "b").metadata.resource_version > \
+            got.metadata.resource_version
+
+    def test_store_returns_copies(self):
+        s = ResourceStore()
+        s.create(mkjob("a"))
+        got = s.get("JAXJob", "a")
+        got.spec["jaxReplicaSpecs"]["Worker"]["replicas"] = 42
+        assert s.get("JAXJob", "a").replica_specs()["Worker"].replicas == 1
+
+
+class TestWorkqueue:
+    def test_dedup(self):
+        q = RateLimitingQueue()
+        q.add("k")
+        q.add("k")
+        assert q.get(timeout=0.1) == "k"
+        assert q.get(timeout=0.05) is None
+
+    def test_dirty_requeue_while_processing(self):
+        q = RateLimitingQueue()
+        q.add("k")
+        k = q.get(timeout=0.1)
+        q.add("k")  # while processing -> dirty
+        assert q.get(timeout=0.05) is None  # not yet
+        q.done(k)
+        assert q.get(timeout=0.2) == "k"  # re-delivered after done
+
+    def test_add_after(self):
+        q = RateLimitingQueue()
+        q.add_after("k", 0.15)
+        t0 = time.monotonic()
+        assert q.get(timeout=1.0) == "k"
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_rate_limited_backoff_grows(self):
+        q = RateLimitingQueue(base_delay=0.01, max_delay=1.0)
+        q.add_rate_limited("k")
+        assert q.num_requeues("k") == 1
+        q.add_rate_limited("k")
+        assert q.num_requeues("k") == 2
+        q.forget("k")
+        assert q.num_requeues("k") == 0
+
+    def test_shutdown_unblocks(self):
+        q = RateLimitingQueue()
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.get()))
+        t.start()
+        q.shutdown()
+        t.join(timeout=2)
+        assert out == [None]
+
+
+class CountingController(Controller):
+    KIND = "JAXJob"
+
+    def __init__(self, store, fail_times=0):
+        super().__init__(store)
+        self.seen = []
+        self.fail_times = fail_times
+        self.done_event = threading.Event()
+
+    def reconcile(self, key):
+        self.seen.append(key)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("transient")
+        self.done_event.set()
+        return Result()
+
+
+class TestManager:
+    def test_reconcile_on_create_and_update(self):
+        mgr = Manager()
+        ctrl = CountingController(mgr.store)
+        mgr.register(ctrl)
+        with mgr:
+            mgr.store.create(mkjob("a"))
+            assert ctrl.done_event.wait(2)
+        assert "default/a" in ctrl.seen
+
+    def test_retry_with_backoff_until_success(self):
+        mgr = Manager()
+        ctrl = CountingController(mgr.store, fail_times=2)
+        mgr.register(ctrl)
+        with mgr:
+            mgr.store.create(mkjob("a"))
+            assert ctrl.done_event.wait(5)
+        assert len(ctrl.seen) >= 3  # 2 failures + success
+
+    def test_owner_reference_routing(self):
+        class ParentController(Controller):
+            KIND = "Experiment"
+
+            def __init__(self, store):
+                super().__init__(store)
+                self.keys = []
+                self.got = threading.Event()
+
+            def reconcile(self, key):
+                self.keys.append(key)
+                self.got.set()
+
+        from kubeflow_tpu.api import Experiment
+
+        mgr = Manager()
+        parent = ParentController(mgr.store)
+        mgr.register(parent)
+        with mgr:
+            child = mkjob("child")
+            child.metadata.owner_references = [
+                {"kind": "Experiment", "name": "exp1"}]
+            mgr.store.create(child)
+            assert parent.got.wait(2)
+        assert "default/exp1" in parent.keys
+
+    def test_initial_list_replayed(self):
+        # Objects created BEFORE manager start still get reconciled.
+        mgr = Manager()
+        mgr.store.create(mkjob("pre"))
+        ctrl = CountingController(mgr.store)
+        mgr.register(ctrl)
+        with mgr:
+            assert ctrl.done_event.wait(2)
+        assert "default/pre" in ctrl.seen
