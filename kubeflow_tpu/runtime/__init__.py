@@ -1,0 +1,33 @@
+"""Process-gang runtime: the data plane under the training operators."""
+
+from .gang import (  # noqa: F401
+    FAILED,
+    KILLED,
+    PENDING,
+    RESTARTING,
+    RUNNING,
+    SUCCEEDED,
+    Gang,
+    GangManager,
+    GangStatus,
+    ProcessSpec,
+    ReplicaStatus,
+)
+from .rendezvous import (  # noqa: F401
+    ENV_CHECKPOINT_DIR,
+    ENV_COORDINATOR,
+    ENV_JOB_NAME,
+    ENV_JOB_NAMESPACE,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_REPLICA_INDEX,
+    ENV_REPLICA_TYPE,
+    ENV_WORKDIR,
+    flatten_replicas,
+    jax_env,
+    mpi_hostfile,
+    mpi_worker_env,
+    pytorch_env,
+    tf_config,
+    tf_env,
+)

@@ -1,0 +1,407 @@
+"""Gang process launcher: all-or-nothing start, liveness, whole-gang restart.
+
+This is the data-plane half of the training operators. Where the reference
+creates pods and lets kubelet + a gang scheduler (volcano PodGroup) run
+them (SURVEY.md §2.1 common lib), we launch local OS processes directly:
+
+  * all-or-nothing start — if any member fails to spawn, the gang is torn
+    down (a distributed job must never half-start);
+  * liveness monitoring — a supervisor thread reaps exits;
+  * whole-gang restart with exponential backoff — a dead worker invalidates
+    the collective (jax.distributed world membership is fixed), so failure
+    of one member kills and relaunches all, bounded by backoffLimit; the
+    runner contract resumes from the latest orbax checkpoint (SURVEY.md §5.3/5.4);
+  * chief-exit success semantics — the job succeeds when the chief replica
+    (rank 0 of the elected type) exits 0, like tf-operator's Chief handling;
+  * cleanPodPolicy — what happens to still-running members on completion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api import training as T
+
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
+RESTARTING = "Restarting"
+KILLED = "Killed"
+
+# Exit codes considered retryable under restartPolicy=ExitCode (reference
+# semantics: >128 = killed by signal = retryable infrastructure failure).
+def _retryable_exit(code: int) -> bool:
+    return code > 128 or code < 0
+
+
+@dataclasses.dataclass
+class ProcessSpec:
+    replica_type: str
+    index: int
+    argv: List[str]
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cwd: Optional[str] = None
+
+    @property
+    def id(self) -> str:
+        return f"{self.replica_type.lower()}-{self.index}"
+
+
+@dataclasses.dataclass
+class ReplicaStatus:
+    state: str = PENDING
+    pid: Optional[int] = None
+    exit_code: Optional[int] = None
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class GangStatus:
+    phase: str = PENDING
+    reason: str = ""
+    message: str = ""
+    restart_count: int = 0
+    replicas: Dict[str, ReplicaStatus] = dataclasses.field(default_factory=dict)
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-replica-type {active, succeeded, failed} — the shape of the
+        reference's ReplicaStatuses."""
+        out: Dict[str, Dict[str, int]] = {}
+        for pid, st in self.replicas.items():
+            rtype = pid.rsplit("-", 1)[0]
+            c = out.setdefault(rtype, {"active": 0, "succeeded": 0, "failed": 0})
+            if st.state == RUNNING:
+                c["active"] += 1
+            elif st.state == SUCCEEDED:
+                c["succeeded"] += 1
+            elif st.state in (FAILED, KILLED):
+                c["failed"] += 1
+        return out
+
+
+class Gang:
+    """One supervised process gang (= one training job instance)."""
+
+    GRACE_SECONDS = 3.0
+    RESTART_BASE_DELAY = 0.2
+    RESTART_MAX_DELAY = 30.0
+
+    def __init__(
+        self,
+        name: str,
+        specs: List[ProcessSpec],
+        workdir: str,
+        *,
+        restart_policy: str = T.RESTART_ON_FAILURE,
+        backoff_limit: Optional[int] = 3,
+        active_deadline: Optional[float] = None,
+        clean_policy: str = T.CLEAN_POD_RUNNING,
+        chief_replica_type: str = "",
+        on_change: Optional[Callable[["Gang"], None]] = None,
+        restart_env_hook: Optional[Callable[[int], Dict[str, str]]] = None,
+    ):
+        self.name = name
+        self.specs = specs
+        self.workdir = workdir
+        self.restart_policy = restart_policy
+        self.backoff_limit = backoff_limit
+        self.active_deadline = active_deadline
+        self.clean_policy = clean_policy
+        self.chief_replica_type = chief_replica_type or (
+            specs[0].replica_type if specs else "")
+        self.on_change = on_change
+        # Called with the attempt number before each (re)launch; returns env
+        # overrides (used to re-allocate the jax.distributed coordinator port).
+        self.restart_env_hook = restart_env_hook
+
+        self._lock = threading.RLock()
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._status = GangStatus(
+            replicas={s.id: ReplicaStatus() for s in specs})
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._started_at: Optional[float] = None
+        self.log_dir = os.path.join(workdir, "logs")
+
+    # -- observability -----------------------------------------------------
+    def status(self) -> GangStatus:
+        with self._lock:
+            return GangStatus(
+                phase=self._status.phase,
+                reason=self._status.reason,
+                message=self._status.message,
+                restart_count=self._status.restart_count,
+                replicas={k: dataclasses.replace(v)
+                          for k, v in self._status.replicas.items()},
+            )
+
+    def log_path(self, replica_id: str) -> str:
+        return os.path.join(self.log_dir, f"{replica_id}.log")
+
+    def _notify(self) -> None:
+        if self.on_change is not None:
+            try:
+                self.on_change(self)
+            except Exception:
+                pass
+
+    def _set_phase(self, phase: str, reason: str = "", message: str = "") -> None:
+        with self._lock:
+            self._status.phase = phase
+            self._status.reason = reason
+            self._status.message = message
+        self._notify()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._monitor is not None:
+                raise RuntimeError(f"gang {self.name} already started")
+            self._monitor = threading.Thread(
+                target=self._supervise, name=f"gang-{self.name}", daemon=True)
+        self._monitor.start()
+
+    def _launch_all(self, attempt: int) -> bool:
+        """All-or-nothing spawn. Returns False if any member failed to start."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        overrides = {}
+        if self.restart_env_hook is not None:
+            overrides = self.restart_env_hook(attempt) or {}
+        launched: Dict[str, subprocess.Popen] = {}
+        try:
+            for spec in self.specs:
+                env = dict(os.environ)
+                env.update(spec.env)
+                env.update(overrides)
+                logf = open(self.log_path(spec.id), "ab")
+                logf.write(
+                    f"==== attempt {attempt} {time.strftime('%Y-%m-%dT%H:%M:%S')}"
+                    f" ====\n".encode())
+                logf.flush()
+                p = subprocess.Popen(
+                    spec.argv, env=env, cwd=spec.cwd or self.workdir,
+                    stdout=logf, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+                logf.close()  # child holds the fd
+                launched[spec.id] = p
+        except Exception as e:  # spawn failure -> tear down the partial gang
+            for p in launched.values():
+                _terminate(p, self.GRACE_SECONDS)
+            with self._lock:
+                for rid in self._status.replicas:
+                    self._status.replicas[rid] = ReplicaStatus(state=FAILED)
+                self._status.message = f"spawn failed: {e}"
+            return False
+        now = time.time()
+        with self._lock:
+            self._procs = launched
+            for rid, p in launched.items():
+                self._status.replicas[rid] = ReplicaStatus(
+                    state=RUNNING, pid=p.pid, started_at=now)
+            self._started_at = self._started_at or now
+        return True
+
+    def _supervise(self) -> None:
+        attempt = 0
+        while not self._stop.is_set():
+            if not self._launch_all(attempt):
+                self._set_phase(FAILED, "SpawnFailed", self._status.message)
+                return
+            self._set_phase(RUNNING, "GangRunning",
+                            f"{len(self.specs)} processes running"
+                            + (f" (restart {attempt})" if attempt else ""))
+            outcome = self._watch_attempt()
+            if outcome in (SUCCEEDED, FAILED, KILLED):
+                return
+            # outcome == RESTARTING
+            attempt += 1
+            with self._lock:
+                self._status.restart_count = attempt
+            delay = min(self.RESTART_BASE_DELAY * (2 ** (attempt - 1)),
+                        self.RESTART_MAX_DELAY)
+            self._set_phase(RESTARTING, "GangRestarting",
+                            f"restart {attempt} after {delay:.1f}s backoff")
+            if self._stop.wait(delay):
+                return
+
+    def _watch_attempt(self) -> str:
+        """Poll member processes until a terminal decision for this attempt."""
+        chief_id = f"{self.chief_replica_type.lower()}-0"
+        while True:
+            if self._stop.is_set():
+                self._kill_all()
+                self._set_phase(KILLED, "GangDeleted", "gang deleted")
+                return KILLED
+            if (self.active_deadline is not None and self._started_at
+                    and time.time() - self._started_at > self.active_deadline):
+                self._kill_all()
+                self._set_phase(FAILED, "DeadlineExceeded",
+                                f"activeDeadlineSeconds={self.active_deadline} exceeded")
+                return FAILED
+            exited_fail: Optional[str] = None
+            all_done = True
+            chief_done_ok = False
+            changed = False
+            with self._lock:
+                for rid, p in self._procs.items():
+                    st = self._status.replicas[rid]
+                    code = p.poll()
+                    if code is None:
+                        all_done = False
+                        continue
+                    if st.state == RUNNING:
+                        st.exit_code = code
+                        st.finished_at = time.time()
+                        st.state = SUCCEEDED if code == 0 else FAILED
+                        changed = True
+                    if st.state == FAILED and exited_fail is None:
+                        exited_fail = rid
+                    if rid == chief_id and st.state == SUCCEEDED:
+                        chief_done_ok = True
+            if changed:
+                self._notify()
+            if exited_fail is not None:
+                code = self._status.replicas[exited_fail].exit_code or 0
+                retry = self._should_retry(code)
+                self._kill_all()
+                if retry:
+                    return RESTARTING
+                self._set_phase(
+                    FAILED, "ReplicaFailed",
+                    f"{exited_fail} exited with code {code}; "
+                    f"restartPolicy={self.restart_policy}, "
+                    f"restarts={self._status.restart_count}")
+                return FAILED
+            if chief_done_ok or all_done:
+                if self.clean_policy in (T.CLEAN_POD_RUNNING, T.CLEAN_POD_ALL):
+                    self._kill_all(mark=SUCCEEDED)
+                self._set_phase(SUCCEEDED, "GangSucceeded",
+                                "chief exited 0" if chief_done_ok else
+                                "all replicas exited 0")
+                return SUCCEEDED
+            time.sleep(0.05)
+
+    def _should_retry(self, exit_code: int) -> bool:
+        if self.restart_policy == T.RESTART_NEVER:
+            return False
+        if self.restart_policy == T.RESTART_EXIT_CODE and not _retryable_exit(exit_code):
+            return False
+        if self.backoff_limit is not None and \
+                self._status.restart_count >= self.backoff_limit:
+            return False
+        return True
+
+    def _kill_all(self, mark: str = KILLED) -> None:
+        """Terminate members still running; finished members keep their
+        recorded state. `mark` is the state assigned to the killed ones
+        (SUCCEEDED on cleanPodPolicy teardown after chief success)."""
+        with self._lock:
+            procs = dict(self._procs)
+        for rid, p in procs.items():
+            if p.poll() is None:
+                _terminate(p, self.GRACE_SECONDS)
+                with self._lock:
+                    st = self._status.replicas[rid]
+                    st.state = mark
+                    st.exit_code = p.poll()
+                    st.finished_at = time.time()
+        self._notify()
+
+    def delete(self) -> None:
+        """Stop supervision and kill everything (resource deletion path)."""
+        self._stop.set()
+        self._kill_all()
+        if self._monitor is not None:
+            self._monitor.join(timeout=self.GRACE_SECONDS + 5)
+
+    def kill_replica(self, replica_id: str) -> bool:
+        """Fault-injection hook (SURVEY.md §5.3: `kfx kill-worker`)."""
+        with self._lock:
+            p = self._procs.get(replica_id)
+        if p is not None and p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            return True
+        return False
+
+
+def _terminate(p: subprocess.Popen, grace: float) -> None:
+    try:
+        os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        try:
+            p.terminate()
+        except ProcessLookupError:
+            return
+    deadline = time.time() + grace
+    while time.time() < deadline:
+        if p.poll() is not None:
+            return
+        time.sleep(0.02)
+    try:
+        os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            p.kill()
+        except ProcessLookupError:
+            pass
+    p.wait()
+
+
+class GangManager:
+    """Registry of live gangs keyed by job key — what the operators talk to."""
+
+    def __init__(self, base_workdir: str):
+        self.base_workdir = base_workdir
+        self._lock = threading.Lock()
+        self._gangs: Dict[str, Gang] = {}
+
+    def get(self, key: str) -> Optional[Gang]:
+        with self._lock:
+            return self._gangs.get(key)
+
+    def ensure(self, key: str, factory: Callable[[str], Gang]) -> Gang:
+        """Get the gang for `key`, creating+starting it via `factory` if
+        absent. factory receives the gang workdir."""
+        with self._lock:
+            gang = self._gangs.get(key)
+            if gang is not None:
+                return gang
+        workdir = os.path.join(self.base_workdir, key.replace("/", "_"))
+        os.makedirs(workdir, exist_ok=True)
+        gang = factory(workdir)
+        with self._lock:
+            existing = self._gangs.get(key)
+            if existing is not None:
+                return existing
+            self._gangs[key] = gang
+        gang.start()
+        return gang
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            gang = self._gangs.pop(key, None)
+        if gang is not None:
+            gang.delete()
+
+    def forget(self, key: str) -> None:
+        """Drop a finished gang from the registry without killing it."""
+        with self._lock:
+            self._gangs.pop(key, None)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            gangs = list(self._gangs.values())
+            self._gangs.clear()
+        for g in gangs:
+            g.delete()

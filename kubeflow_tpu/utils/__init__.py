@@ -1,0 +1,3 @@
+"""Shared helpers: ports, logging setup, small misc."""
+
+from .net import free_port, free_ports  # noqa: F401

@@ -1,0 +1,249 @@
+"""Gang runtime tests: rendezvous env contract (the reference's unit-test
+tier for distributed logic, SURVEY.md §4) plus real process-gang behavior —
+success, failure/backoff, whole-gang restart, fault injection, deadline."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.runtime import (
+    Gang,
+    GangManager,
+    ProcessSpec,
+    flatten_replicas,
+    jax_env,
+    mpi_hostfile,
+    mpi_worker_env,
+    pytorch_env,
+    tf_config,
+)
+from kubeflow_tpu.api import training as T
+
+PY = sys.executable
+
+
+def wait_phase(gang, phases, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = gang.status()
+        if st.phase in phases:
+            return st
+        time.sleep(0.02)
+    raise AssertionError(
+        f"gang {gang.name} stuck in {gang.status().phase}, wanted {phases}")
+
+
+class TestRendezvousEnv:
+    def test_flatten_replicas_ranks(self):
+        out = flatten_replicas([("Master", 1), ("Worker", 2)])
+        assert out == [("Master", 0, 0), ("Worker", 0, 1), ("Worker", 1, 2)]
+
+    def test_jax_env(self):
+        env = jax_env("mnist", "default", "127.0.0.1:1234", 4, 2,
+                      "Worker", 2, "/w")
+        assert env["KFX_COORDINATOR_ADDRESS"] == "127.0.0.1:1234"
+        assert env["KFX_NUM_PROCESSES"] == "4"
+        assert env["KFX_PROCESS_ID"] == "2"
+        assert env["KFX_CHECKPOINT_DIR"] == "/w/checkpoints"
+
+    def test_tf_config_shape(self):
+        cfg = json.loads(tf_config(
+            {"Worker": ["h1:1", "h2:2"], "PS": ["h3:3"]}, "Worker", 1))
+        assert cfg["cluster"] == {"worker": ["h1:1", "h2:2"], "ps": ["h3:3"]}
+        assert cfg["task"] == {"type": "worker", "index": 1}
+
+    def test_pytorch_env(self):
+        env = pytorch_env("127.0.0.1", 29500, 2, 1)
+        assert env["MASTER_ADDR"] == "127.0.0.1"
+        assert env["WORLD_SIZE"] == "2"
+        assert env["RANK"] == "1"
+
+    def test_mpi_hostfile(self):
+        hf = mpi_hostfile(["a", "b"], slots_per_worker=2)
+        assert hf == "a slots=2\nb slots=2\n"
+        assert mpi_worker_env(1, 4)["OMPI_COMM_WORLD_RANK"] == "1"
+
+
+def specs_for(cmds):
+    return [ProcessSpec(replica_type="Worker", index=i, argv=argv)
+            for i, argv in enumerate(cmds)]
+
+
+class TestGang:
+    def test_all_succeed(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "print('m=1')"],
+                                    [PY, "-c", "pass"]]),
+                    str(tmp_path), chief_replica_type="Worker")
+        gang.start()
+        st = wait_phase(gang, {"Succeeded", "Failed"})
+        assert st.phase == "Succeeded"
+        assert st.counts()["worker"]["succeeded"] == 2
+        log = open(gang.log_path("worker-0")).read()
+        assert "m=1" in log
+
+    def test_chief_success_terminates_stragglers(self, tmp_path):
+        # chief exits 0 quickly; worker-1 would run 60s — Running clean
+        # policy kills it and the gang succeeds (tf-operator Chief semantics).
+        gang = Gang("g", specs_for([[PY, "-c", "pass"],
+                                    [PY, "-c", "import time; time.sleep(60)"]]),
+                    str(tmp_path), chief_replica_type="Worker",
+                    clean_policy=T.CLEAN_POD_RUNNING)
+        gang.start()
+        st = wait_phase(gang, {"Succeeded", "Failed"})
+        assert st.phase == "Succeeded"
+        assert st.reason == "GangSucceeded"
+
+    def test_failure_never_policy(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "raise SystemExit(3)"],
+                                    [PY, "-c", "import time; time.sleep(60)"]]),
+                    str(tmp_path), restart_policy=T.RESTART_NEVER)
+        gang.start()
+        st = wait_phase(gang, {"Failed"})
+        assert st.reason == "ReplicaFailed"
+        assert "exited with code 3" in st.message
+        assert st.restart_count == 0
+
+    def test_whole_gang_restart_until_backoff_limit(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "raise SystemExit(1)"]]),
+                    str(tmp_path), restart_policy=T.RESTART_ON_FAILURE,
+                    backoff_limit=2)
+        gang.start()
+        st = wait_phase(gang, {"Failed"}, timeout=30)
+        assert st.restart_count == 2  # 1 initial + 2 restarts, then give up
+
+    def test_restart_then_succeed_with_marker(self, tmp_path):
+        # Fails on first attempt, succeeds once the marker file exists —
+        # models crash-then-recover; also exercises restart_env_hook.
+        marker = tmp_path / "marker"
+        code = (f"import os,sys; p={str(marker)!r}; "
+                "sys.exit(0) if os.path.exists(p) else "
+                "(open(p,'w').close(), sys.exit(1))")
+        hooks = []
+        gang = Gang("g", specs_for([[PY, "-c", code]]), str(tmp_path),
+                    restart_policy=T.RESTART_ON_FAILURE, backoff_limit=3,
+                    restart_env_hook=lambda a: hooks.append(a) or {})
+        gang.start()
+        st = wait_phase(gang, {"Succeeded", "Failed"}, timeout=30)
+        assert st.phase == "Succeeded"
+        assert st.restart_count == 1
+        assert hooks == [0, 1]
+
+    def test_exitcode_policy_not_retryable(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "raise SystemExit(1)"]]),
+                    str(tmp_path), restart_policy=T.RESTART_EXIT_CODE,
+                    backoff_limit=5)
+        gang.start()
+        st = wait_phase(gang, {"Failed"})
+        assert st.restart_count == 0  # exit 1 is not retryable under ExitCode
+
+    def test_kill_replica_fault_injection_retryable(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "import time; time.sleep(60)"],
+                                    [PY, "-c", "import time; time.sleep(60)"]]),
+                    str(tmp_path), restart_policy=T.RESTART_EXIT_CODE,
+                    backoff_limit=1)
+        gang.start()
+        wait_phase(gang, {"Running"})
+        assert gang.kill_replica("worker-1")
+        # SIGKILL => negative returncode => retryable => whole-gang restart
+        deadline = time.time() + 10
+        while time.time() < deadline and gang.status().restart_count < 1:
+            time.sleep(0.02)
+        assert gang.status().restart_count >= 1
+        gang.delete()
+
+    def test_active_deadline(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "import time; time.sleep(60)"]]),
+                    str(tmp_path), active_deadline=0.5)
+        gang.start()
+        st = wait_phase(gang, {"Failed"}, timeout=10)
+        assert st.reason == "DeadlineExceeded"
+
+    def test_delete_kills_processes(self, tmp_path):
+        gang = Gang("g", specs_for([[PY, "-c", "import time; time.sleep(60)"]]),
+                    str(tmp_path))
+        gang.start()
+        wait_phase(gang, {"Running"})
+        pid = gang.status().replicas["worker-0"].pid
+        gang.delete()
+        time.sleep(0.2)
+        with pytest.raises(OSError):
+            os.kill(pid, 0)  # process must be gone
+
+
+class TestGangManager:
+    def test_ensure_idempotent_and_delete(self, tmp_path):
+        mgr = GangManager(str(tmp_path))
+        calls = []
+
+        def factory(workdir):
+            calls.append(workdir)
+            return Gang("j", specs_for(
+                [[PY, "-c", "import time; time.sleep(60)"]]), workdir)
+
+        g1 = mgr.ensure("default/j", factory)
+        g2 = mgr.ensure("default/j", factory)
+        assert g1 is g2 and len(calls) == 1
+        wait_phase(g1, {"Running"})
+        mgr.delete("default/j")
+        assert mgr.get("default/j") is None
+        assert wait_phase(g1, {"Killed", "Failed", "Succeeded"},
+                          timeout=5).phase in ("Killed", "Failed", "Succeeded")
+
+    def test_shutdown(self, tmp_path):
+        mgr = GangManager(str(tmp_path))
+        g = mgr.ensure("default/j", lambda wd: Gang(
+            "j", specs_for([[PY, "-c", "import time; time.sleep(60)"]]), wd))
+        wait_phase(g, {"Running"})
+        mgr.shutdown()
+        assert mgr.get("default/j") is None
+
+
+JAX_DISTRIBUTED_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=os.environ["KFX_COORDINATOR_ADDRESS"],
+    num_processes=int(os.environ["KFX_NUM_PROCESSES"]),
+    process_id=int(os.environ["KFX_PROCESS_ID"]),
+)
+import jax.numpy as jnp
+n = jax.process_count()
+pid = jax.process_index()
+# A real cross-process collective: sum of process ids over all hosts.
+from jax.experimental import multihost_utils
+total = multihost_utils.process_allgather(jnp.array([pid]))
+assert total.sum() == n * (n - 1) // 2, total
+print(f"rendezvous_ok rank={pid} world={n}")
+"""
+
+
+@pytest.mark.slow
+class TestJaxDistributedRendezvous:
+    def test_two_process_rendezvous(self, tmp_path):
+        """The north-star substitution, tested honestly: two OS processes
+        rendezvous through jax.distributed and run a collective."""
+        from kubeflow_tpu.utils import free_port
+        from kubeflow_tpu.runtime import jax_env
+
+        coord = f"127.0.0.1:{free_port()}"
+        script = tmp_path / "worker.py"
+        script.write_text(JAX_DISTRIBUTED_WORKER)
+        specs = []
+        for rtype, idx, rank in flatten_replicas([("Worker", 2)]):
+            env = jax_env("rdzv", "default", coord, 2, rank, rtype, idx,
+                          str(tmp_path), platform="cpu")
+            specs.append(ProcessSpec(replica_type=rtype, index=idx,
+                                     argv=[PY, str(script)], env=env))
+        gang = Gang("rdzv", specs, str(tmp_path), chief_replica_type="Worker",
+                    restart_policy=T.RESTART_NEVER)
+        gang.start()
+        st = wait_phase(gang, {"Succeeded", "Failed"}, timeout=120)
+        logs = "".join(open(gang.log_path(f"worker-{i}")).read()
+                       for i in range(2))
+        assert st.phase == "Succeeded", logs
+        assert "rendezvous_ok rank=0 world=2" in logs
+        assert "rendezvous_ok rank=1 world=2" in logs
