@@ -1,0 +1,6 @@
+"""Datasets. This environment has no network, so the MNIST/CIFAR-10
+equivalents are deterministic synthetic sets with the same shapes/cardinality
+and a learnable class structure (class prototypes + noise), so training
+curves and HPO objectives behave like the real thing."""
+
+from .synthetic import Dataset, get_dataset  # noqa: F401

@@ -1,0 +1,10 @@
+"""Model zoo (flax linen), registered by name for the runner/CLI.
+
+TPU-first conventions: compute in bfloat16 with float32 params/reductions,
+channel dims padded to MXU-friendly multiples where it matters, no
+data-dependent python control flow (everything jit-traceable).
+"""
+
+from .mlp import MLP  # noqa: F401
+from .registry import get_model, model_names, register_model  # noqa: F401
+from .resnet import ResNet, ResNet18, ResNet50  # noqa: F401

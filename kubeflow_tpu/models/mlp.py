@@ -1,0 +1,32 @@
+"""MLP classifier — the mnist example model (reference tf-operator mnist
+example parity; here flax + bfloat16 compute)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import register_model
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        # Logits in float32 for a numerically stable softmax/CE.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+@register_model("mlp")
+def _mlp(num_classes: int = 10, hidden: Sequence[int] = (512, 256), **_):
+    return MLP(features=tuple(hidden), num_classes=num_classes)

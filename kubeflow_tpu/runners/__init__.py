@@ -1,0 +1,1 @@
+"""Worker-process entrypoints launched by the gang runtime."""

@@ -1,0 +1,157 @@
+"""JAXJob worker entrypoint.
+
+The process the gang launches for every JAXJob replica. Contract with the
+operator (SURVEY.md §5.8 — the NCCL-rendezvous replacement):
+
+  * rendezvous: reads KFX_COORDINATOR_ADDRESS / KFX_NUM_PROCESSES /
+    KFX_PROCESS_ID and calls ``jax.distributed.initialize`` before any
+    backend use; XLA collectives over ICI/DCN do the rest;
+  * checkpoint/resume: saves orbax checkpoints under KFX_CHECKPOINT_DIR and
+    resumes from the latest on (re)start, so whole-gang restarts lose at
+    most ``--checkpoint-every`` steps;
+  * metrics: prints ``step=N loss=X accuracy=Y`` lines on stdout, which the
+    metrics collector tails (Katib-parity observation pipeline);
+  * exit 0 on completion — chief exit drives job success.
+
+Usage (what example manifests put in containers[0].command):
+    python -m kubeflow_tpu.runners.jax_runner --model=mlp --dataset=mnist \
+        --steps=600 --batch-size=256 --learning-rate=1e-3
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="kfx JAX training runner")
+    p.add_argument("--model", default="mlp")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--steps", type=int, default=600)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--optimizer", default="adam")
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--checkpoint-every", type=int, default=200)
+    p.add_argument("--keep-checkpoints", type=int, default=2)
+    p.add_argument("--eval-samples", type=int, default=2048)
+    p.add_argument("--no-checkpoint", action="store_true")
+    p.add_argument("--export-dir", default="",
+                   help="After training, export params for serving here")
+    p.add_argument("--fail-at-step", type=int, default=-1,
+                   help="Fault injection: crash at this step (tests only)")
+    return p.parse_args(argv)
+
+
+def initialize_distributed() -> int:
+    """Rendezvous via env. Returns process_id. Must run pre-backend-init."""
+    num = int(os.environ.get("KFX_NUM_PROCESSES", "1"))
+    if num <= 1:
+        return 0
+    import jax
+
+    coord = os.environ["KFX_COORDINATOR_ADDRESS"]
+    pid = int(os.environ["KFX_PROCESS_ID"])
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coord, num_processes=num,
+                               process_id=pid)
+    return pid
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    initialize_distributed()
+
+    import jax  # after distributed init
+
+    from kubeflow_tpu.data import get_dataset
+    from kubeflow_tpu.models import get_model
+    from kubeflow_tpu.training import Checkpointer, TrainLoop
+
+    rank = jax.process_index()
+    world = jax.process_count()
+    is_chief = rank == 0
+
+    def log(msg: str) -> None:
+        # All ranks print (per-replica logs); collector reads the chief's.
+        print(msg, flush=True)
+
+    log(f"runner_start model={args.model} dataset={args.dataset} "
+        f"rank={rank} world={world} devices={jax.device_count()} "
+        f"platform={jax.devices()[0].platform}")
+
+    dataset = get_dataset(args.dataset, split="train", seed=args.seed)
+    model = get_model(args.model, num_classes=dataset.num_classes)
+    loop = TrainLoop(model, learning_rate=args.learning_rate,
+                     optimizer=args.optimizer, weight_decay=args.weight_decay,
+                     seed=args.seed)
+    state = loop.init_state(dataset.shape)
+
+    ckpt = None
+    start_step = 0
+    ckpt_dir = os.environ.get("KFX_CHECKPOINT_DIR", "")
+    if ckpt_dir and not args.no_checkpoint:
+        ckpt = Checkpointer(ckpt_dir, save_every=args.checkpoint_every,
+                            keep=args.keep_checkpoints)
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state.step))
+            log(f"resumed_from_checkpoint step={start_step}")
+
+    t_start = time.time()
+    t_last = t_start
+    it = dataset.batches(args.batch_size, shard_index=rank, num_shards=world,
+                         steps=None, epoch_seed=0)
+    # Skip the batches already consumed before the restart so the data
+    # stream continues where the checkpoint left off.
+    for _ in range(start_step):
+        next(it)
+
+    loss = acc = 0.0
+    for step in range(start_step, args.steps):
+        if step == args.fail_at_step:
+            log(f"fault_injection_crash step={step}")
+            sys.stdout.flush()
+            os._exit(17)
+        images, labels = next(it)
+        state, loss, acc = loop.train_step(state, images, labels)
+        now = time.time()
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            dt = (now - t_last) / args.log_every
+            log(f"step={step + 1} loss={loss:.6f} accuracy={acc:.6f} "
+                f"step_time={dt:.4f}")
+            t_last = now
+        if ckpt is not None:
+            ckpt.maybe_save(step + 1, state)
+
+    # Final eval on a fixed set (sharded across processes).
+    eval_ds = get_dataset(args.dataset, split="eval", seed=args.seed)
+    images, labels = eval_ds.eval_arrays(args.eval_samples)
+    shard = slice(rank, None, world)
+    metrics = loop.evaluate(state, images[shard], labels[shard])
+    wall = time.time() - t_start
+    log(f"train_done steps={args.steps} wall_seconds={wall:.2f}")
+    log(f"loss={metrics['loss']:.6f}")
+    log(f"accuracy={metrics['accuracy']:.6f}")
+
+    if ckpt is not None:
+        ckpt.maybe_save(args.steps, state, force=True)
+        ckpt.close()
+
+    if args.export_dir and is_chief:
+        from kubeflow_tpu.serving.export import export_params
+        export_params(args.export_dir, args.model, dataset.shape,
+                      dataset.num_classes, state)
+        log(f"exported_model dir={args.export_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Serving (KFServing parity): model export, servers, InferenceService."""
+
+from .export import export_params, load_exported  # noqa: F401

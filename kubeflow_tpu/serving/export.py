@@ -1,0 +1,51 @@
+"""Model export format: the `storageUri` payload an InferenceService loads.
+
+A directory with:
+  config.json   — model name + input shape + classes (enough to rebuild the
+                  flax module via the registry)
+  params.msgpack — flax-serialized {params, batch_stats}
+
+The reference's storage-initializer downloads from GCS/S3/PVC
+(SURVEY.md §2.1 KFServing controller); here `file://` paths cover the
+no-network environment, and the loader is the seam where other schemes
+would plug in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def export_params(directory: str, model_name: str, input_shape, num_classes: int,
+                  state: Any) -> str:
+    """Write a servable export from a TrainState (or any object with
+    .params / .batch_stats)."""
+    os.makedirs(directory, exist_ok=True)
+    payload = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+    }
+    with open(os.path.join(directory, "params.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump({"model": model_name,
+                   "input_shape": list(input_shape),
+                   "num_classes": int(num_classes)}, f)
+    return directory
+
+
+def load_exported(uri: str) -> Tuple[Dict, Any]:
+    """Load an export. Returns (config, variables={params, batch_stats}).
+    Accepts a bare path or file:// URI."""
+    path = uri[len("file://"):] if uri.startswith("file://") else uri
+    with open(os.path.join(path, "config.json")) as f:
+        config = json.load(f)
+    with open(os.path.join(path, "params.msgpack"), "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return config, payload

@@ -1,0 +1,33 @@
+"""Early pytest plugin (loaded via ``addopts = -p kubeflow_tpu.testenv``) that
+re-execs pytest with the corrected JAX environment.
+
+Why: this machine's axon TPU sitecustomize imports jax and registers the
+TPU plugin at interpreter start, which pins the platform and breaks
+--xla_force_host_platform_device_count. Env fixes inside conftest come too
+late (jax is already imported), so the whole process is re-exec'd once with
+JAX_PLATFORMS=cpu, an 8-device CPU host platform, and the axon hook
+disabled. The re-exec happens at plugin *import* time — before pytest's
+fd-level capture plugin starts swallowing output (its
+pytest_load_initial_conftests wrapper runs ahead of other plugins' hooks,
+so a hook-based re-exec would inherit the redirected fds and appear to
+print nothing). The suite then runs on a virtual 8-device CPU mesh per the
+driver contract; the real TPU is exercised only by bench.py.
+"""
+
+import os
+import sys
+
+_WANT = {
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "JAX_ENABLE_X64": "0",
+}
+
+if os.environ.get("KFX_TEST_REEXEC") != "1":
+    os.environ.update(_WANT)
+    os.environ["KFX_TEST_REEXEC"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest", *sys.argv[1:]], os.environ)

@@ -1,0 +1,8 @@
+"""Training library: sharded train loop + orbax checkpoint/resume.
+
+This is what runs *inside* the gang workers (the reference keeps this in
+user containers; here it ships as a first-class library the JAXJob
+examples use)."""
+
+from .checkpoint import Checkpointer  # noqa: F401
+from .loop import TrainLoop, TrainMetrics  # noqa: F401

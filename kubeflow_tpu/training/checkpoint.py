@@ -1,0 +1,61 @@
+"""Orbax checkpoint/resume — first-class in the JAXJob runner contract
+(SURVEY.md §5.4: supervisor restarts resume from the latest checkpoint;
+the reference leaves this entirely to user code + PVC mounts).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    """Thin wrapper over an orbax CheckpointManager.
+
+    Saves every ``save_every`` steps (plus on demand), keeps the last
+    ``keep`` checkpoints, and restores the latest on resume. Works in
+    multi-process runs: orbax coordinates writers through the
+    jax.distributed client, so all processes call save()/restore()
+    collectively on a shared filesystem.
+    """
+
+    def __init__(self, directory: str, save_every: int = 100, keep: int = 2,
+                 async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        self.save_every = save_every
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=keep,
+            enable_async_checkpointing=async_save,
+        )
+        self.manager = ocp.CheckpointManager(self.directory, options=options)
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        if not force and (self.save_every <= 0 or step % self.save_every != 0):
+            return False
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        return True
+
+    def restore_latest(self, target: Any) -> Optional[Any]:
+        """Restore the newest checkpoint into the structure of ``target``
+        (an abstract or concrete state pytree). None if no checkpoint."""
+        step = self.manager.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        return self.manager.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()

@@ -1,0 +1,182 @@
+"""Data-parallel training loop, GSPMD style.
+
+TPU-first mechanics (vs the reference's in-container Horovod/DDP loops):
+  * one global jit'd step over a `Mesh` with the batch sharded on the
+    ``data`` axis and params replicated — XLA inserts the gradient
+    all-reduce (the NCCL ring's job) over ICI/DCN;
+  * donated state buffers so the optimizer update is in-place in HBM;
+  * bfloat16 compute / float32 state;
+  * per-process input shards assembled into global arrays with
+    ``jax.make_array_from_process_local_data`` (multi-host safe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class TrainMetrics:
+    step: int
+    loss: float
+    accuracy: float
+    seconds: float
+
+    def line(self) -> str:
+        """The stdout contract the metrics collector parses (SURVEY.md §5.5)."""
+        return (f"step={self.step} loss={self.loss:.6f} "
+                f"accuracy={self.accuracy:.6f} step_time={self.seconds:.4f}")
+
+
+class TrainLoop:
+    """Builds and runs the sharded step for a flax classifier model."""
+
+    def __init__(self, model, learning_rate: float = 1e-3,
+                 optimizer: str = "adam", weight_decay: float = 0.0,
+                 mesh: Optional[Mesh] = None, seed: int = 0):
+        self.model = model
+        self.mesh = mesh or Mesh(np.array(jax.devices()), ("data",))
+        self.seed = seed
+        self.tx = _make_optimizer(optimizer, learning_rate, weight_decay)
+        self.repl = NamedSharding(self.mesh, P())          # replicated
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+        self._train_step = None
+        self._eval_step = None
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, sample_shape: Tuple[int, ...]) -> TrainState:
+        rng = jax.random.PRNGKey(self.seed)
+        dummy = jnp.zeros((1,) + tuple(sample_shape), jnp.float32)
+        variables = self.model.init(rng, dummy, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           batch_stats=batch_stats,
+                           opt_state=self.tx.init(params))
+        return jax.device_put(state, self.repl)
+
+    # -- steps -------------------------------------------------------------
+    def _build_train_step(self):
+        model, tx = self.model, self.tx
+
+        def loss_fn(params, batch_stats, images, labels):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            out = model.apply(variables, images, train=True,
+                              mutable=["batch_stats"] if batch_stats else [])
+            logits, new_stats = out if isinstance(out, tuple) else (out, {})
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            acc = (logits.argmax(-1) == labels).mean()
+            return loss, (acc, new_stats.get("batch_stats", {}))
+
+        def step(state: TrainState, images, labels):
+            (loss, (acc, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.batch_stats,
+                                       images, labels)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(step=state.step + 1, params=params,
+                                      batch_stats=new_stats,
+                                      opt_state=opt_state)
+            return new_state, loss, acc
+
+        return jax.jit(
+            step,
+            in_shardings=(self.repl, self.batch_sharding, self.batch_sharding),
+            out_shardings=(self.repl, self.repl, self.repl),
+            donate_argnums=(0,),
+        )
+
+    def _build_eval_step(self):
+        model = self.model
+
+        def step(state: TrainState, images, labels):
+            variables = {"params": state.params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            logits = model.apply(variables, images, train=False)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            acc = (logits.argmax(-1) == labels).mean()
+            return loss, acc
+
+        return jax.jit(
+            step,
+            in_shardings=(self.repl, self.batch_sharding, self.batch_sharding),
+            out_shardings=(self.repl, self.repl),
+        )
+
+    # -- input assembly ----------------------------------------------------
+    def global_batch(self, images: np.ndarray, labels: np.ndarray):
+        """Assemble this process's shard into a global sharded array."""
+        if jax.process_count() == 1:
+            return (jax.device_put(images, self.batch_sharding),
+                    jax.device_put(labels, self.batch_sharding))
+        return (jax.make_array_from_process_local_data(self.batch_sharding, images),
+                jax.make_array_from_process_local_data(self.batch_sharding, labels))
+
+    # -- driving -----------------------------------------------------------
+    def train_step(self, state: TrainState, images: np.ndarray,
+                   labels: np.ndarray) -> Tuple[TrainState, float, float]:
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        g_images, g_labels = self.global_batch(images, labels)
+        state, loss, acc = self._train_step(state, g_images, g_labels)
+        return state, float(loss), float(acc)
+
+    def evaluate(self, state: TrainState, images: np.ndarray,
+                 labels: np.ndarray, batch_size: int = 512) -> Dict[str, float]:
+        """Evaluate over (process-local) arrays. In multi-process runs each
+        process passes its own disjoint shard; metrics are averaged over the
+        global batch by the sharded reduction inside the step."""
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        n_dev = self.mesh.size
+        per = max(batch_size // n_dev, 1) * n_dev
+        losses, accs, count = [], [], 0
+        for i in range(0, len(images) - per + 1, per):
+            li, ll = images[i:i + per], labels[i:i + per]
+            g_images = jax.device_put(li, self.batch_sharding) \
+                if jax.process_count() == 1 else \
+                jax.make_array_from_process_local_data(self.batch_sharding, li)
+            g_labels = jax.device_put(ll, self.batch_sharding) \
+                if jax.process_count() == 1 else \
+                jax.make_array_from_process_local_data(self.batch_sharding, ll)
+            loss, acc = self._eval_step(state, g_images, g_labels)
+            losses.append(float(loss))
+            accs.append(float(acc))
+            count += per
+        return {"loss": float(np.mean(losses)) if losses else float("nan"),
+                "accuracy": float(np.mean(accs)) if accs else float("nan"),
+                "count": count}
+
+
+def _make_optimizer(name: str, lr: float, weight_decay: float) -> optax.GradientTransformation:
+    name = name.lower()
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adamw":
+        return optax.adamw(lr, weight_decay=weight_decay or 1e-4)
+    if name == "sgd":
+        return optax.sgd(lr, momentum=0.9)
+    if name == "lamb":
+        return optax.lamb(lr, weight_decay=weight_decay)
+    raise KeyError(f"unknown optimizer {name!r} (adam|adamw|sgd|lamb)")

@@ -1,20 +1,10 @@
-"""Test configuration: force an 8-device CPU 'slice' BEFORE jax imports.
-
-Multi-chip sharding paths are validated on a virtual CPU mesh
-(xla_force_host_platform_device_count), per the driver contract; the real
-(emulated) TPU is exercised only by bench.py.
-"""
+"""Test configuration. The heavy lifting (re-exec with a CPU 8-device JAX
+environment) happens in the early plugin ``tests/kfx_testenv.py`` — see its
+docstring; env fixes here would come too late because the machine's axon
+sitecustomize imports jax at interpreter start."""
 
 import os
 import sys
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
