@@ -117,6 +117,11 @@ def main(argv=None) -> int:
     loss = acc = 0.0
     for step in range(start_step, args.steps):
         if step == args.fail_at_step:
+            if ckpt is not None:
+                # The injected fault models a crash *after* the last scheduled
+                # save became durable; without this the async commit races the
+                # exit and resume would nondeterministically lose it.
+                ckpt.wait()
             log(f"fault_injection_crash step={step}")
             sys.stdout.flush()
             os._exit(17)

@@ -143,8 +143,13 @@ class TestCheckpoint:
         ckpt.close()
 
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def _runner_env(tmp_path, extra=None):
     env = dict(os.environ)
+    prior = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prior if prior else "")
     env.update({
         "JAX_PLATFORMS": "cpu",
         "PALLAS_AXON_POOL_IPS": "",
