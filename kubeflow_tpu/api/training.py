@@ -123,6 +123,9 @@ class TrainingJob(Resource):
     VALID_REPLICA_TYPES: List[str] = []
     # Replica type elected "chief" for success semantics (first match wins).
     CHIEF_PRIORITY: List[str] = []
+    # Replica types allowed to omit containers[0].command (they only host
+    # processes — e.g. MPI workers, whose pods run sshd in the reference).
+    ARGV_OPTIONAL_TYPES: List[str] = []
 
     def replica_specs(self) -> Dict[str, ReplicaSpec]:
         raw = self.spec.get(self.REPLICA_SPECS_FIELD) or {}
@@ -160,7 +163,7 @@ class TrainingJob(Resource):
                     f"not in {self.VALID_REPLICA_TYPES}",
                 )
             rs.validate(f"spec.{self.REPLICA_SPECS_FIELD}.{rtype}")
-            if not rs.argv():
+            if not rs.argv() and rtype not in self.ARGV_OPTIONAL_TYPES:
                 raise ValidationError(
                     f"spec.{self.REPLICA_SPECS_FIELD}.{rtype}.template",
                     "containers[0].command/args required (process argv)",
@@ -239,6 +242,7 @@ class MPIJob(TrainingJob):
     REPLICA_SPECS_FIELD = "mpiReplicaSpecs"
     VALID_REPLICA_TYPES = ["Launcher", "Worker"]
     CHIEF_PRIORITY = ["Launcher"]
+    ARGV_OPTIONAL_TYPES = ["Worker"]
     # slotsPerWorker lives at spec top level in the reference API.
 
     def slots_per_worker(self) -> int:

@@ -1,0 +1,334 @@
+"""kfx — the platform CLI (kubectl+kfctl-shaped UX, SURVEY.md §7).
+
+Two modes:
+
+* **run mode** (`kfx run -f job.yaml`): embeds a control plane, applies the
+  manifests, waits for every training job in them to finish, streams the
+  chief log, exits 0/1 on Succeeded/Failed. This is the path the baseline
+  configs measure (apply→completion wall-clock).
+* **server mode** (`kfx server`): a persistent control plane with a REST
+  apiserver; other kfx invocations detect it via KFX_SERVER and become
+  thin HTTP clients (the kubectl model). Implemented in
+  kubeflow_tpu.apiserver.
+
+Verbs: apply, run, get, describe, delete, logs, events, kill-replica,
+server, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .api.base import Resource, resource_class
+from .api.training import TrainingJob
+from .controlplane import ControlPlane, default_home
+
+
+def _fmt_age(created: str) -> str:
+    import datetime
+
+    if not created:
+        return "?"
+    try:
+        t = datetime.datetime.strptime(
+            created, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return "?"
+    s = int((datetime.datetime.now(datetime.timezone.utc) - t).total_seconds())
+    if s < 60:
+        return f"{s}s"
+    if s < 3600:
+        return f"{s // 60}m{s % 60}s"
+    return f"{s // 3600}h{(s % 3600) // 60}m"
+
+
+def _job_state(obj: Resource) -> str:
+    order = ["Failed", "Succeeded", "Restarting", "Suspended", "Running",
+             "Created"]
+    for c in order:
+        if obj.has_condition(c):
+            return c
+    return "Pending"
+
+
+def _print_table(rows: List[List[str]], headers: List[str]) -> None:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+
+
+class KfxCLI:
+    """CLI against a ControlPlane (embedded, or remote when KFX_SERVER is
+    set — see kubeflow_tpu.apiserver.Client, which matches this surface)."""
+
+    def __init__(self, cp: ControlPlane):
+        self.cp = cp
+
+    # -- verbs --------------------------------------------------------------
+    def apply(self, paths: List[str]) -> List[Resource]:
+        out = []
+        for path in paths:
+            for obj, verb in self.cp.apply_file(path):
+                print(f"{obj.KIND.lower()}/{obj.name} {verb}")
+                out.append(obj)
+        return out
+
+    def run(self, paths: List[str], timeout: float, follow: bool = True) -> int:
+        applied = self.apply(paths)
+        jobs = [o for o in applied if isinstance(o, TrainingJob)]
+        if not jobs:
+            print("nothing to wait for (no training jobs in manifests)")
+            return 0
+        rc = 0
+        for job in jobs:
+            final = self._wait_streaming(job, timeout, follow)
+            state = _job_state(final)
+            print(f"{job.KIND.lower()}/{job.name} {state.lower()}")
+            if state != "Succeeded":
+                rc = 1
+        return rc
+
+    def _wait_streaming(self, job: TrainingJob, timeout: float,
+                        follow: bool) -> TrainingJob:
+        """Wait for completion while tailing the chief log to stdout."""
+        deadline = time.monotonic() + timeout
+        offset = 0
+        while True:
+            obj = self.cp.store.try_get(job.KIND, job.name, job.namespace)
+            if obj is None:
+                raise SystemExit(f"{job.KIND} {job.key} disappeared")
+            if follow:
+                offset = self._tail(obj, offset)
+            if isinstance(obj, TrainingJob) and obj.is_finished():
+                if follow:
+                    time.sleep(0.2)  # final flush
+                    self._tail(obj, offset)
+                return obj
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"timeout: {job.KIND} {job.key} still "
+                    f"{_job_state(obj)} after {timeout}s")
+            time.sleep(0.2)
+
+    def _tail(self, job: TrainingJob, offset: int) -> int:
+        try:
+            text = self.cp.job_logs(job.KIND, job.name, job.namespace)
+        except (FileNotFoundError, KeyError):
+            return offset
+        if len(text) > offset:
+            sys.stdout.write(text[offset:])
+            sys.stdout.flush()
+        return len(text)
+
+    def get(self, kind: str, name: Optional[str], namespace: str,
+            output: str) -> int:
+        cls = resource_class(kind)
+        if name:
+            objs = [self.cp.store.get(cls.KIND, name, namespace)]
+        else:
+            objs = self.cp.store.list(cls.KIND, namespace)
+        if output == "json":
+            docs = [o.to_dict() for o in objs]
+            print(json.dumps(docs[0] if name else docs, indent=2))
+        elif output == "yaml":
+            from .api.manifest import dump_manifest
+
+            print("---\n".join(dump_manifest(o) for o in objs), end="")
+        else:
+            rows = [[o.name, _job_state(o),
+                     str(o.status.get("restartCount", 0)),
+                     _fmt_age(o.metadata.creation_timestamp)] for o in objs]
+            _print_table(rows, ["NAME", "STATE", "RESTARTS", "AGE"])
+        return 0
+
+    def describe(self, kind: str, name: str, namespace: str) -> int:
+        cls = resource_class(kind)
+        obj = self.cp.store.get(cls.KIND, name, namespace)
+        from .api.manifest import dump_manifest
+
+        print(dump_manifest(obj), end="")
+        events = self.cp.store.events_for(cls.KIND, f"{namespace}/{name}")
+        if events:
+            print("events:")
+            for e in events:
+                print(f"  {e.timestamp} {e.type} {e.reason}: {e.message}")
+        return 0
+
+    def delete(self, kind: str, name: str, namespace: str) -> int:
+        cls = resource_class(kind)
+        self.cp.store.delete(cls.KIND, name, namespace)
+        print(f"{cls.KIND.lower()}/{name} deleted")
+        return 0
+
+    def logs(self, kind: str, name: str, namespace: str, replica: str) -> int:
+        cls = resource_class(kind)
+        print(self.cp.job_logs(cls.KIND, name, namespace, replica), end="")
+        return 0
+
+    def events(self, kind: str, name: str, namespace: str) -> int:
+        cls = resource_class(kind)
+        for e in self.cp.store.events_for(cls.KIND, f"{namespace}/{name}"):
+            print(f"{e.timestamp} {e.type} {e.reason}: {e.message}")
+        return 0
+
+    def kill_replica(self, kind: str, name: str, namespace: str,
+                     replica: str) -> int:
+        """Fault-injection hook (SURVEY.md §5.3: `kfx kill-worker`)."""
+        gang = self.cp.gangs.get(f"{kind.lower()}/{namespace}/{name}")
+        if gang is None:
+            print(f"no running gang for {kind} {namespace}/{name}",
+                  file=sys.stderr)
+            return 1
+        if gang.kill_replica(replica):
+            print(f"killed {replica}")
+            return 0
+        print(f"replica {replica} not running", file=sys.stderr)
+        return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kfx",
+                                description="TPU-native ML platform CLI")
+    p.add_argument("--home", default=None,
+                   help=f"state dir (default {default_home()})")
+    p.add_argument("-n", "--namespace", default="default")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("apply", help="apply resource manifests")
+    sp.add_argument("-f", "--filename", action="append", required=True)
+    sp.add_argument("--wait", action="store_true",
+                    help="wait for training jobs to finish")
+    sp.add_argument("--timeout", type=float, default=3600.0)
+
+    sp = sub.add_parser("run", help="apply + wait + stream logs")
+    sp.add_argument("-f", "--filename", action="append", required=True)
+    sp.add_argument("--timeout", type=float, default=3600.0)
+    sp.add_argument("--no-follow", action="store_true")
+
+    sp = sub.add_parser("get", help="list/get resources")
+    sp.add_argument("kind")
+    sp.add_argument("name", nargs="?")
+    sp.add_argument("-o", "--output", choices=["table", "json", "yaml"],
+                    default="table")
+
+    sp = sub.add_parser("describe", help="full resource + events")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+
+    sp = sub.add_parser("delete", help="delete a resource")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+
+    sp = sub.add_parser("logs", help="print replica logs")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    sp.add_argument("--replica", default="",
+                    help="replica id, e.g. worker-1 (default: chief)")
+
+    sp = sub.add_parser("events", help="print resource events")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+
+    sp = sub.add_parser("kill-replica", help="fault injection: kill a replica")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    sp.add_argument("replica")
+
+    sp = sub.add_parser("server", help="run the persistent control plane")
+    sp.add_argument("--port", type=int, default=8134)
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _main(argv)
+    except SystemExit:
+        raise
+    except (KeyboardInterrupt, BrokenPipeError):  # pragma: no cover
+        return 130
+    except Exception as e:  # surface clean one-line errors, not tracebacks
+        import yaml
+
+        from .api.base import ValidationError
+        from .core.store import AlreadyExists, Conflict, NotFound
+
+        if isinstance(e, (ValidationError, NotFound, Conflict, AlreadyExists,
+                          KeyError, FileNotFoundError, TimeoutError,
+                          yaml.YAMLError)):
+            msg = e.args[0] if e.args else str(e)
+            print(f"error: {msg}", file=sys.stderr)
+            return 1
+        raise
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "version":
+        from . import __version__
+
+        print(f"kfx {__version__}")
+        return 0
+    if args.cmd == "server":
+        try:
+            from .apiserver import serve_forever
+        except ImportError:
+            print("error: server mode is not available in this build",
+                  file=sys.stderr)
+            return 1
+        return serve_forever(home=args.home, port=args.port)
+
+    with ControlPlane(home=args.home, journal=True) as cp:
+        cli = KfxCLI(cp)
+        if args.cmd == "apply":
+            if args.wait:
+                return cli.run(args.filename, args.timeout, follow=False)
+            cli.apply(args.filename)
+            # Without a persistent server, fire-and-forget gangs would die
+            # with this process; warn honestly.
+            jobs = [o for o in cp.store.list_all()
+                    if isinstance(o, TrainingJob) and not o.is_finished()]
+            if jobs:
+                print("note: no kfx server running; waiting for "
+                      "applied jobs (use `kfx run` or `kfx server`)")
+                return _wait_jobs(cli, jobs, args.timeout)
+            return 0
+        if args.cmd == "run":
+            return cli.run(args.filename, args.timeout,
+                           follow=not args.no_follow)
+        if args.cmd == "get":
+            return cli.get(args.kind, args.name, args.namespace, args.output)
+        if args.cmd == "describe":
+            return cli.describe(args.kind, args.name, args.namespace)
+        if args.cmd == "delete":
+            return cli.delete(args.kind, args.name, args.namespace)
+        if args.cmd == "logs":
+            return cli.logs(args.kind, args.name, args.namespace, args.replica)
+        if args.cmd == "events":
+            return cli.events(args.kind, args.name, args.namespace)
+        if args.cmd == "kill-replica":
+            return cli.kill_replica(args.kind, args.name, args.namespace,
+                                    args.replica)
+    return 0
+
+
+def _wait_jobs(cli: KfxCLI, jobs: List[TrainingJob], timeout: float) -> int:
+    rc = 0
+    for job in jobs:
+        final = cli._wait_streaming(job, timeout, follow=False)
+        if _job_state(final) != "Succeeded":
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

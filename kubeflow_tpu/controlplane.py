@@ -1,0 +1,159 @@
+"""ControlPlane: the assembled platform — store + manager + all operators.
+
+The reference equivalent is `kfctl apply` bringing up every controller
+deployment on a cluster (SURVEY.md §3 CS5). Here the platform is a single
+process hosting the reconcile loops, with gangs as local child processes.
+The CLI (`kfx`) and the tests both embed one of these.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Tuple
+
+from .api.base import Resource
+from .api.manifest import load_manifest_file, load_manifests
+from .api.training import TrainingJob
+from .core.controller import Manager
+from .core.store import ResourceStore
+from .operators import training_controllers
+from .runtime.gang import GangManager
+
+
+def default_home() -> str:
+    return os.environ.get("KFX_HOME") or os.path.join(
+        os.path.expanduser("~"), ".kfx")
+
+
+class ControlPlane:
+    """Hosts the store and every registered controller.
+
+    ``journal=True`` persists resources to sqlite under the home dir so a
+    restarted control plane resumes reconciliation (store recovery replays
+    objects; unfinished jobs get fresh gangs — the reference gets the same
+    from informer re-list on controller restart).
+    """
+
+    def __init__(self, home: Optional[str] = None, journal: bool = False,
+                 worker_platform: Optional[str] = None):
+        self.home = os.path.abspath(home or default_home())
+        os.makedirs(self.home, exist_ok=True)
+        journal_path = os.path.join(self.home, "state.db") if journal else None
+        self.store = ResourceStore(journal_path=journal_path)
+        self.gangs = GangManager(os.path.join(self.home, "gangs"))
+        self.manager = Manager(self.store)
+        self._register_controllers(worker_platform)
+        self._started = False
+
+    def _register_controllers(self, worker_platform: Optional[str]) -> None:
+        for ctrl in training_controllers(self.store, self.gangs,
+                                         worker_platform):
+            self.manager.register(ctrl)
+        # Serving / HPO / platform controllers register here as they land.
+        try:
+            from .operators.hpo import hpo_controllers
+
+            for ctrl in hpo_controllers(self.store):
+                self.manager.register(ctrl)
+        except ImportError:
+            pass
+        try:
+            from .operators.serving import serving_controllers
+
+            for ctrl in serving_controllers(self.store, self.home):
+                self.manager.register(ctrl)
+        except ImportError:
+            pass
+        try:
+            from .operators.platform import platform_controllers
+
+            for ctrl in platform_controllers(self.store, self.gangs):
+                self.manager.register(ctrl)
+        except ImportError:
+            pass
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ControlPlane":
+        self.manager.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            self.manager.stop()
+            self._started = False
+        self.gangs.shutdown()
+        self.store.close()
+
+    def __enter__(self) -> "ControlPlane":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- user-facing operations (the kubectl verbs) -------------------------
+    def apply(self, resources: List[Resource]) -> List[Tuple[Resource, str]]:
+        out = []
+        for obj in resources:
+            obj.validate()
+            out.append(self.store.apply(obj))
+        return out
+
+    def apply_file(self, path: str) -> List[Tuple[Resource, str]]:
+        return self.apply(load_manifest_file(path))
+
+    def apply_text(self, text: str) -> List[Tuple[Resource, str]]:
+        return self.apply(load_manifests(text))
+
+    def wait_for_job(self, kind: str, name: str, namespace: str = "default",
+                     timeout: float = 600.0) -> TrainingJob:
+        """Block until the job reaches Succeeded/Failed (or timeout)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            obj = self.store.try_get(kind, name, namespace)
+            if obj is None:
+                raise KeyError(f"{kind} {namespace}/{name} disappeared")
+            assert isinstance(obj, TrainingJob)
+            if obj.is_finished():
+                return obj
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{kind} {namespace}/{name} not finished after {timeout}s;"
+                    f" conditions={[c.to_dict() for c in obj.conditions]}")
+            time.sleep(0.1)
+
+    def wait_for_condition(self, kind: str, name: str, ctype: str,
+                           namespace: str = "default",
+                           timeout: float = 600.0) -> Resource:
+        deadline = time.monotonic() + timeout
+        while True:
+            obj = self.store.try_get(kind, name, namespace)
+            if obj is not None and obj.has_condition(ctype):
+                return obj
+            if time.monotonic() > deadline:
+                conds = [] if obj is None else \
+                    [c.to_dict() for c in obj.conditions]
+                raise TimeoutError(
+                    f"{kind} {namespace}/{name} lacks condition {ctype} "
+                    f"after {timeout}s; conditions={conds}")
+            time.sleep(0.1)
+
+    def job_logs(self, kind: str, name: str, namespace: str = "default",
+                 replica: str = "") -> str:
+        """Read a replica's log file (chief replica if unspecified)."""
+        obj = self.store.get(kind, name, namespace)
+        assert isinstance(obj, TrainingJob)
+        gkey = f"{kind.lower()}/{namespace}/{name}"
+        gang = self.gangs.get(gkey)
+        rid = replica or f"{obj.chief_replica_type().lower()}-0"
+        if gang is None:
+            # Finished gang was forgotten; its workdir is stable.
+            path = os.path.join(self.gangs.workdir_for(gkey), "logs",
+                                f"{rid}.log")
+        else:
+            path = gang.log_path(rid)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no log at {path}")
+        with open(path, "r", errors="replace") as f:
+            return f.read()

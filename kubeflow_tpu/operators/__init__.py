@@ -1,0 +1,10 @@
+"""Operators: the per-kind reconcilers (the reference's L3+ controllers)."""
+
+from .training import (  # noqa: F401
+    JAXJobController,
+    MPIJobController,
+    PyTorchJobController,
+    TFJobController,
+    TrainingControllerBase,
+    training_controllers,
+)

@@ -1,0 +1,35 @@
+"""MPIJob-compatible JAX worker: Horovod-era env in, XLA collectives out.
+
+Acceptance config #3 (BASELINE.md): "MPIJob Horovod ResNet on CIFAR-10".
+Horovod's job was ring-allreduce over MPI/NCCL; the TPU-native equivalent
+is ``jax.distributed`` + XLA collectives (SURVEY.md §5.8). This adapter
+maps the OpenMPI rank env (set by the mpirun shim or the MPIJob operator)
+onto the KFX rendezvous contract and delegates to the JAX runner — one
+training stack, three rendezvous dialects.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .jax_runner import main as jax_main
+
+
+def main(argv=None) -> int:
+    rank = os.environ.get("OMPI_COMM_WORLD_RANK", "0")
+    size = os.environ.get("OMPI_COMM_WORLD_SIZE", "1")
+    os.environ["KFX_PROCESS_ID"] = rank
+    os.environ["KFX_NUM_PROCESSES"] = size
+    # The mpirun shim exports a shared coordinator address; without one
+    # (single rank) the runner stays single-process.
+    if int(size) > 1 and "KFX_COORDINATOR_ADDRESS" not in os.environ:
+        print("mpi_jax_runner: OMPI_COMM_WORLD_SIZE>1 but no "
+              "KFX_COORDINATOR_ADDRESS (launch via the mpirun shim)",
+              file=sys.stderr)
+        return 2
+    return jax_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

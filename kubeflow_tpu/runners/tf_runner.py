@@ -1,0 +1,111 @@
+"""TFJob-compatible worker: TensorFlow training driven by ``TF_CONFIG``.
+
+Acceptance config #1 (BASELINE.md): the tf-operator mnist example shape.
+The operator injects TF_CONFIG (cluster spec + task); this runner gives it
+to ``tf.distribute`` exactly as the reference example scripts do —
+single-worker runs use the default strategy, multi-worker runs use
+MultiWorkerMirroredStrategy over the TF gRPC cluster.
+
+Prints the same stdout metric contract as the JAX runner so the metrics
+collector and HPO objective parsing are framework-agnostic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="kfx TF training runner")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--eval-samples", type=int, default=2048)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    # Keep TF off any accelerator plugin; this compat path is CPU-only
+    # (reference config #1 is explicitly CPU).
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import tensorflow as tf
+
+    from kubeflow_tpu.data import get_dataset
+
+    tf_config = json.loads(os.environ.get("TF_CONFIG", "{}"))
+    cluster = tf_config.get("cluster", {})
+    task = tf_config.get("task", {"type": "worker", "index": 0})
+    n_workers = sum(len(v) for k, v in cluster.items()
+                    if k in ("worker", "chief", "master"))
+    if n_workers > 1:
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+    else:
+        strategy = tf.distribute.get_strategy()  # no-op strategy
+
+    print(f"runner_start framework=tf dataset={args.dataset} "
+          f"task={task.get('type')}:{task.get('index')} "
+          f"n_workers={max(n_workers, 1)}", flush=True)
+
+    ds = get_dataset(args.dataset)
+    with strategy.scope():
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=ds.shape),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(256, activation="relu"),
+            tf.keras.layers.Dense(128, activation="relu"),
+            tf.keras.layers.Dense(ds.num_classes),
+        ])
+        opt = tf.keras.optimizers.Adam(args.learning_rate)
+        loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True)
+
+    @tf.function
+    def train_step(images, labels):
+        with tf.GradientTape() as tape:
+            logits = model(images, training=True)
+            loss = loss_fn(labels, logits)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        acc = tf.reduce_mean(tf.cast(
+            tf.equal(tf.argmax(logits, -1, output_type=tf.int32), labels),
+            tf.float32))
+        return loss, acc
+
+    t0 = time.time()
+    t_last = t0
+    it = ds.batches(args.batch_size)
+    loss = acc = 0.0
+    for step in range(args.steps):
+        images, labels = next(it)
+        loss, acc = train_step(tf.constant(images), tf.constant(labels))
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            now = time.time()
+            dt = (now - t_last) / args.log_every
+            print(f"step={step + 1} loss={float(loss):.6f} "
+                  f"accuracy={float(acc):.6f} step_time={dt:.4f}", flush=True)
+            t_last = now
+
+    eval_ds = get_dataset(args.dataset, split="eval")
+    images, labels = eval_ds.eval_arrays(args.eval_samples)
+    logits = model(tf.constant(images), training=False)
+    eval_loss = float(loss_fn(tf.constant(labels), logits))
+    eval_acc = float(tf.reduce_mean(tf.cast(tf.equal(
+        tf.argmax(logits, -1, output_type=tf.int32), tf.constant(labels)),
+        tf.float32)))
+    wall = time.time() - t0
+    print(f"train_done steps={args.steps} wall_seconds={wall:.2f}", flush=True)
+    print(f"loss={eval_loss:.6f}", flush=True)
+    print(f"accuracy={eval_acc:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

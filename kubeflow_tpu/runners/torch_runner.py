@@ -1,0 +1,97 @@
+"""PyTorchJob-compatible worker: torch DDP driven by MASTER_ADDR/RANK env.
+
+Acceptance config #2 (BASELINE.md): 2-worker distributed MNIST. The
+reference rendezvouses NCCL inside GPU pods; this runner consumes the
+identical env contract (MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK, injected
+by the PyTorchJob operator) with the gloo backend on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="kfx torch training runner")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--backend", default="gloo")
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--eval-samples", type=int, default=2048)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    import numpy as np
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+
+    from kubeflow_tpu.data import get_dataset
+
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    rank = int(os.environ.get("RANK", "0"))
+    distributed = world > 1
+    if distributed:
+        dist.init_process_group(backend=args.backend, rank=rank,
+                                world_size=world)
+
+    print(f"runner_start framework=torch dataset={args.dataset} "
+          f"rank={rank} world={world} backend={args.backend}", flush=True)
+
+    ds = get_dataset(args.dataset)
+    in_dim = int(np.prod(ds.shape))
+    model = nn.Sequential(
+        nn.Flatten(), nn.Linear(in_dim, 256), nn.ReLU(),
+        nn.Linear(256, 128), nn.ReLU(), nn.Linear(128, ds.num_classes))
+    if distributed:
+        model = nn.parallel.DistributedDataParallel(model)
+    opt = torch.optim.Adam(model.parameters(), lr=args.learning_rate)
+    loss_fn = nn.CrossEntropyLoss()
+
+    t0 = time.time()
+    t_last = t0
+    it = ds.batches(args.batch_size, shard_index=rank, num_shards=world)
+    loss_v = acc_v = 0.0
+    for step in range(args.steps):
+        images, labels = next(it)
+        x = torch.from_numpy(images).float()
+        y = torch.from_numpy(labels).long()
+        opt.zero_grad()
+        logits = model(x)
+        loss = loss_fn(logits, y)
+        loss.backward()  # DDP all-reduces grads here (the NCCL ring's job)
+        opt.step()
+        loss_v = float(loss.detach())
+        acc_v = float((logits.argmax(-1) == y).float().mean())
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            now = time.time()
+            dt = (now - t_last) / args.log_every
+            print(f"step={step + 1} loss={loss_v:.6f} accuracy={acc_v:.6f} "
+                  f"step_time={dt:.4f}", flush=True)
+            t_last = now
+
+    eval_ds = get_dataset(args.dataset, split="eval")
+    images, labels = eval_ds.eval_arrays(args.eval_samples)
+    with torch.no_grad():
+        logits = model(torch.from_numpy(images).float())
+        y = torch.from_numpy(labels).long()
+        eval_loss = float(loss_fn(logits, y))
+        eval_acc = float((logits.argmax(-1) == y).float().mean())
+    wall = time.time() - t0
+    print(f"train_done steps={args.steps} wall_seconds={wall:.2f}", flush=True)
+    print(f"loss={eval_loss:.6f}", flush=True)
+    print(f"accuracy={eval_acc:.6f}", flush=True)
+    if distributed:
+        dist.destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

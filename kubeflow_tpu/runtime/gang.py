@@ -370,6 +370,11 @@ class GangManager:
         with self._lock:
             return self._gangs.get(key)
 
+    def workdir_for(self, key: str) -> str:
+        """The (stable) workdir a gang for `key` uses — also valid for
+        finished gangs that were forgotten (log retrieval)."""
+        return os.path.join(self.base_workdir, key.replace("/", "_"))
+
     def ensure(self, key: str, factory: Callable[[str], Gang]) -> Gang:
         """Get the gang for `key`, creating+starting it via `factory` if
         absent. factory receives the gang workdir."""
@@ -377,7 +382,7 @@ class GangManager:
             gang = self._gangs.get(key)
             if gang is not None:
                 return gang
-        workdir = os.path.join(self.base_workdir, key.replace("/", "_"))
+        workdir = self.workdir_for(key)
         os.makedirs(workdir, exist_ok=True)
         gang = factory(workdir)
         with self._lock:
