@@ -1,0 +1,157 @@
+"""Benchmark entrypoint (driver contract): prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures the north-star config (BASELINE.md): the stock MNIST JAXJob
+completing end-to-end through `kfx` resource semantics — apply → reconcile
+→ gang launch → sharded training → Succeeded — on the real attached TPU.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md: upstream
+Kubeflow ships pass/fail smoke tests only; BASELINE.json "published": {}).
+The acceptance contract is "GPU-job wall-clock parity" for this example;
+PARITY_BUDGET_S below is the documented stand-in for the reference GPU
+wall-clock (one minute for the mnist training-operator example), so
+vs_baseline = PARITY_BUDGET_S / measured (>1.0 = faster than parity).
+
+Usage: python bench.py [--steps N] [--batch-size N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+PARITY_BUDGET_S = 60.0
+
+MANIFEST = """
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata:
+  name: bench-mnist
+  namespace: default
+spec:
+  runPolicy:
+    backoffLimit: 0
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+          - name: jax
+            command: ["{python}", "-m", "kubeflow_tpu.runners.jax_runner"]
+            args:
+            - "--model=mlp"
+            - "--dataset=mnist"
+            - "--steps={steps}"
+            - "--batch-size={batch_size}"
+            - "--log-every=100"
+            - "--scan-steps=50"
+            - "--no-checkpoint"
+"""
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=600)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--timeout", type=float, default=1200.0)
+    args = p.parse_args()
+
+    import tempfile
+
+    from kubeflow_tpu.controlplane import ControlPlane
+
+    home = tempfile.mkdtemp(prefix="kfx-bench-")
+    # worker_platform="" -> the worker inherits the machine's default JAX
+    # platform (the attached TPU); single worker, whole chip.
+    t0 = time.time()
+    with ControlPlane(home=home, worker_platform="") as cp:
+        cp.apply_text(MANIFEST.format(python=sys.executable,
+                                      steps=args.steps,
+                                      batch_size=args.batch_size))
+        job = cp.wait_for_job("JAXJob", "bench-mnist", timeout=args.timeout)
+        wall = time.time() - t0
+        log = cp.job_logs("JAXJob", "bench-mnist")
+    if not job.has_condition("Succeeded"):
+        print(json.dumps({"metric": "mnist_jaxjob_wall_clock_s",
+                          "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+                          "error": "job failed", "log_tail": log[-2000:]}))
+        return 1
+
+    acc = None
+    for line in log.splitlines():
+        if line.startswith("accuracy="):
+            acc = float(line.split("=", 1)[1])
+
+    serving = _bench_serving_p50()
+    out = {
+        "metric": "mnist_jaxjob_wall_clock_s",
+        "value": round(wall, 2),
+        "unit": "s",
+        "vs_baseline": round(PARITY_BUDGET_S / wall, 3),
+        "steps": args.steps,
+        "batch_size": args.batch_size,
+        "final_accuracy": acc,
+    }
+    out.update(serving)
+    print(json.dumps(out))
+    return 0
+
+
+def _bench_serving_p50(n_requests: int = 200) -> dict:
+    """Secondary metric (BASELINE config #5): InferenceService p50 latency
+    for single-instance predicts against the in-process model server."""
+    try:
+        import numpy as np
+
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.serving.export import export_params
+        from kubeflow_tpu.serving.server import JaxPredictor, ModelServer
+        from kubeflow_tpu.training import TrainLoop
+
+        import json as _json
+        import tempfile
+        import urllib.request
+
+        ds = get_dataset("cifar10")
+        model = get_model("resnet18", num_classes=ds.num_classes)
+        loop = TrainLoop(model)
+        state = loop.init_state(ds.shape)
+        exp = tempfile.mkdtemp(prefix="kfx-bench-isvc-")
+        export_params(exp, "resnet18", ds.shape, ds.num_classes, state)
+        predictor = JaxPredictor(exp, name="resnet", max_batch_size=8)
+        predictor.load()
+        server = ModelServer(port=0)
+        server.register(predictor)
+        server.start()
+        x = np.zeros((1,) + ds.shape, np.float32).tolist()
+        payload = _json.dumps({"instances": x}).encode()
+        url = f"http://127.0.0.1:{server.port}/v1/models/resnet:predict"
+        lat = []
+        for _ in range(n_requests):
+            t = time.perf_counter()
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=30).read()
+            lat.append((time.perf_counter() - t) * 1000)
+        server.stop()
+        lat.sort()
+        return {
+            "serving_p50_ms": round(lat[len(lat) // 2], 2),
+            "serving_p99_ms": round(lat[int(len(lat) * 0.99)], 2),
+            "serving_model": "resnet18-cifar10",
+        }
+    except Exception as e:  # secondary metric must not sink the bench
+        return {"serving_error": str(e)[:200]}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,17 @@ def utcnow() -> str:
     )
 
 
+def parse_utc(ts: str) -> datetime.datetime:
+    """Inverse of utcnow() — the one place that knows the wire format."""
+    return datetime.datetime.strptime(
+        ts, "%Y-%m-%dT%H:%M:%S.%fZ").replace(tzinfo=datetime.timezone.utc)
+
+
+def age_seconds(ts: str) -> float:
+    return (datetime.datetime.now(datetime.timezone.utc)
+            - parse_utc(ts)).total_seconds()
+
+
 class ValidationError(ValueError):
     """Spec failed validation (the admission-webhook equivalent)."""
 

@@ -72,6 +72,27 @@ class InferenceService(Resource):
         batcher annotation equivalent, promoted to a first-class field)."""
         return self.predictor().get("batcher")
 
+    # -- revisions (default / canary) --------------------------------------
+    def revision_spec(self, revision: str) -> Optional[Dict[str, Any]]:
+        """Predictor-shaped spec for a revision: "default" is
+        spec.predictor, "canary" is the optional spec.canary (the
+        v1alpha2-era default+canary split)."""
+        if revision == "default":
+            return self.predictor() or None
+        if revision == "canary":
+            return self.spec.get("canary") or None
+        raise KeyError(f"unknown revision {revision!r}")
+
+    def canary_traffic_percent_split(self) -> int:
+        """Percent of traffic routed to the canary revision. Accepted at
+        spec level (v1alpha2 shape) or inside predictor; defaults to 0 —
+        a new canary takes no traffic until promoted."""
+        if self.spec.get("canary") is None:
+            return 0
+        v = self.spec.get("canaryTrafficPercent",
+                          self.predictor().get("canaryTrafficPercent", 0))
+        return int(v)
+
     def validate(self) -> None:
         super().validate()
         if not self.predictor():
@@ -87,6 +108,11 @@ class InferenceService(Resource):
         if not 0 <= pct <= 100:
             raise ValidationError("spec.predictor.canaryTrafficPercent",
                                   "must be in [0, 100]")
+        if self.spec.get("canary") is not None:
+            split = self.canary_traffic_percent_split()
+            if not 0 <= split <= 100:
+                raise ValidationError("spec.canaryTrafficPercent",
+                                      "must be in [0, 100]")
         if self.min_replicas() < 0 or self.max_replicas() < self.min_replicas():
             raise ValidationError("spec.predictor.minReplicas/maxReplicas",
                                   "0 <= min <= max required")

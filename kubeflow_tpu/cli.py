@@ -30,17 +30,14 @@ from .controlplane import ControlPlane, default_home
 
 
 def _fmt_age(created: str) -> str:
-    import datetime
+    from .api.base import age_seconds
 
     if not created:
         return "?"
     try:
-        t = datetime.datetime.strptime(
-            created, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
-            tzinfo=datetime.timezone.utc)
+        s = int(age_seconds(created))
     except ValueError:
         return "?"
-    s = int((datetime.datetime.now(datetime.timezone.utc) - t).total_seconds())
     if s < 60:
         return f"{s}s"
     if s < 3600:
@@ -120,13 +117,14 @@ class KfxCLI:
 
     def _tail(self, job: TrainingJob, offset: int) -> int:
         try:
-            text = self.cp.job_logs(job.KIND, job.name, job.namespace)
-        except (FileNotFoundError, KeyError):
+            text, offset = self.cp.job_logs_from(
+                job.KIND, job.name, job.namespace, "", offset)
+        except KeyError:
             return offset
-        if len(text) > offset:
-            sys.stdout.write(text[offset:])
+        if text:
+            sys.stdout.write(text)
             sys.stdout.flush()
-        return len(text)
+        return offset
 
     def get(self, kind: str, name: Optional[str], namespace: str,
             output: str) -> int:
@@ -292,11 +290,17 @@ def _main(argv: Optional[List[str]] = None) -> int:
         if args.cmd == "apply":
             if args.wait:
                 return cli.run(args.filename, args.timeout, follow=False)
-            cli.apply(args.filename)
+            applied = cli.apply(args.filename)
             # Without a persistent server, fire-and-forget gangs would die
-            # with this process; warn honestly.
-            jobs = [o for o in cp.store.list_all()
-                    if isinstance(o, TrainingJob) and not o.is_finished()]
+            # with this process; wait for the jobs applied HERE (not
+            # suspended ones, not leftovers from prior invocations).
+            jobs = []
+            for o in applied:
+                if not isinstance(o, TrainingJob) or o.is_finished():
+                    continue
+                if o.run_policy().suspend:
+                    continue
+                jobs.append(o)
             if jobs:
                 print("note: no kfx server running; waiting for "
                       "applied jobs (use `kfx run` or `kfx server`)")

@@ -83,6 +83,10 @@ class ControlPlane:
         if self._started:
             self.manager.stop()
             self._started = False
+        for ctrl in self.manager.controllers.values():
+            shutdown = getattr(ctrl, "shutdown", None)
+            if callable(shutdown):
+                shutdown()
         self.gangs.shutdown()
         self.store.close()
 
@@ -139,21 +143,36 @@ class ControlPlane:
                     f"after {timeout}s; conditions={conds}")
             time.sleep(0.1)
 
-    def job_logs(self, kind: str, name: str, namespace: str = "default",
-                 replica: str = "") -> str:
-        """Read a replica's log file (chief replica if unspecified)."""
+    def _replica_log_path(self, kind: str, name: str, namespace: str,
+                          replica: str) -> str:
         obj = self.store.get(kind, name, namespace)
         assert isinstance(obj, TrainingJob)
         gkey = f"{kind.lower()}/{namespace}/{name}"
         gang = self.gangs.get(gkey)
         rid = replica or f"{obj.chief_replica_type().lower()}-0"
-        if gang is None:
-            # Finished gang was forgotten; its workdir is stable.
-            path = os.path.join(self.gangs.workdir_for(gkey), "logs",
-                                f"{rid}.log")
-        else:
-            path = gang.log_path(rid)
+        if gang is not None:
+            return gang.log_path(rid)
+        # Finished gang was forgotten; its workdir is stable.
+        return os.path.join(self.gangs.workdir_for(gkey), "logs",
+                            f"{rid}.log")
+
+    def job_logs(self, kind: str, name: str, namespace: str = "default",
+                 replica: str = "") -> str:
+        """Read a replica's full log (chief replica if unspecified)."""
+        path = self._replica_log_path(kind, name, namespace, replica)
         if not os.path.exists(path):
             raise FileNotFoundError(f"no log at {path}")
-        with open(path, "r", errors="replace") as f:
-            return f.read()
+        with open(path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def job_logs_from(self, kind: str, name: str, namespace: str,
+                      replica: str, offset: int) -> Tuple[str, int]:
+        """Incremental tail: read from byte ``offset``, return (new text,
+        next offset) — pollers don't re-read the whole file."""
+        path = self._replica_log_path(kind, name, namespace, replica)
+        if not os.path.exists(path):
+            return "", offset
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        return data.decode(errors="replace"), offset + len(data)

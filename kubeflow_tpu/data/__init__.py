@@ -3,4 +3,5 @@ equivalents are deterministic synthetic sets with the same shapes/cardinality
 and a learnable class structure (class prototypes + noise), so training
 curves and HPO objectives behave like the real thing."""
 
+from .lm import LMDataset, get_lm_dataset  # noqa: F401
 from .synthetic import Dataset, get_dataset  # noqa: F401

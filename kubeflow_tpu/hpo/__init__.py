@@ -1,0 +1,11 @@
+"""HPO engine — Katib parity (SURVEY.md §2.1/§2.2).
+
+algorithms.py  suggestion algorithms (random/grid/tpe/bayesian/cmaes/
+               hyperband) behind one interface
+service.py     gRPC Suggestion service hosting the algorithms (the
+               reference's per-algorithm suggestion deployments)
+collector.py   stdout-regex metrics collector + sqlite observation store
+               (metrics-collector sidecar + db-manager equivalents)
+"""
+
+from .algorithms import get_algorithm, algorithm_names  # noqa: F401

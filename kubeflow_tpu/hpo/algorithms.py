@@ -1,0 +1,374 @@
+"""Suggestion algorithms — the Katib suggestion-service zoo in numpy.
+
+Interface (mirrors Katib's GetSuggestions RPC, SURVEY.md §2.2): an
+algorithm sees the experiment's parameter space and every observed trial
+(assignments + objective value), and returns the next batch of parameter
+assignments. All algorithms are deterministic given (seed, history).
+
+Implemented: random, grid, tpe (Bergstra-style two-density), bayesian
+(GP + expected improvement), cmaes ((μ/λ) covariance adaptation),
+hyperband (successive-halving brackets via a resource parameter).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Assignment = Dict[str, str]
+
+
+class ParamSpace:
+    """Vectorised view of the experiment's parameters: continuous/int
+    params map to [0,1] (log-scaled when the span warrants it),
+    discrete/categorical to index space."""
+
+    def __init__(self, parameters: List[Dict[str, Any]]):
+        self.params = parameters
+        for p in self.params:
+            if p.get("parameterType") in ("int", "double"):
+                fs = p["feasibleSpace"]
+                lo, hi = float(fs["min"]), float(fs["max"])
+                p["_lo"], p["_hi"] = lo, hi
+                p["_log"] = lo > 0 and hi / max(lo, 1e-300) >= 100
+            else:
+                p["_list"] = list(p["feasibleSpace"]["list"])
+
+    @property
+    def names(self) -> List[str]:
+        return [p["name"] for p in self.params]
+
+    def dim(self) -> int:
+        return len(self.params)
+
+    # -- unit-cube encoding -------------------------------------------------
+    def encode(self, assignment: Assignment) -> np.ndarray:
+        out = np.zeros(self.dim())
+        for i, p in enumerate(self.params):
+            raw = assignment[p["name"]]
+            if p.get("parameterType") in ("int", "double"):
+                v = float(raw)
+                if p["_log"]:
+                    out[i] = (math.log(v) - math.log(p["_lo"])) / (
+                        math.log(p["_hi"]) - math.log(p["_lo"]))
+                else:
+                    out[i] = (v - p["_lo"]) / (p["_hi"] - p["_lo"] or 1.0)
+            else:
+                lst = p["_list"]
+                try:
+                    idx = lst.index(type(lst[0])(raw)) if lst else 0
+                except (ValueError, TypeError):
+                    idx = 0
+                out[i] = (idx + 0.5) / len(lst)
+        return np.clip(out, 0.0, 1.0)
+
+    def decode(self, x: np.ndarray) -> Assignment:
+        out: Assignment = {}
+        for i, p in enumerate(self.params):
+            u = float(np.clip(x[i], 0.0, 1.0))
+            if p.get("parameterType") == "double":
+                out[p["name"]] = repr(self._cont(p, u))
+            elif p.get("parameterType") == "int":
+                out[p["name"]] = str(int(round(self._cont(p, u))))
+            else:
+                lst = p["_list"]
+                idx = min(int(u * len(lst)), len(lst) - 1)
+                out[p["name"]] = str(lst[idx])
+        return out
+
+    def _cont(self, p, u: float) -> float:
+        if p["_log"]:
+            return math.exp(math.log(p["_lo"]) + u * (
+                math.log(p["_hi"]) - math.log(p["_lo"])))
+        return p["_lo"] + u * (p["_hi"] - p["_lo"])
+
+    def sample(self, rng: np.random.Generator) -> Assignment:
+        return self.decode(rng.random(self.dim()))
+
+
+class Algorithm:
+    """Base: subclasses implement suggest()."""
+
+    name = ""
+
+    def __init__(self, parameters: List[Dict[str, Any]],
+                 settings: Optional[Dict[str, str]] = None,
+                 objective_type: str = "maximize", seed: int = 0):
+        self.space = ParamSpace(parameters)
+        self.settings = settings or {}
+        self.maximize = objective_type != "minimize"
+        self.seed = int(self.settings.get("random_state", seed))
+
+    def _rng(self, salt: int) -> np.random.Generator:
+        return np.random.default_rng(
+            np.random.SeedSequence([self.seed, salt, 0xA160]))
+
+    def _observed(self, trials: List[Dict[str, Any]]
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """(X [n, d] unit-cube, y [n]) from completed trials; y flipped so
+        HIGHER is always better internally."""
+        xs, ys = [], []
+        for t in trials:
+            if t.get("value") is None:
+                continue
+            xs.append(self.space.encode(t["assignments"]))
+            ys.append(float(t["value"]))
+        if not xs:
+            return np.zeros((0, self.space.dim())), np.zeros((0,))
+        y = np.asarray(ys)
+        return np.stack(xs), (y if self.maximize else -y)
+
+    def suggest(self, trials: List[Dict[str, Any]], count: int
+                ) -> List[Assignment]:
+        raise NotImplementedError
+
+
+class RandomSearch(Algorithm):
+    name = "random"
+
+    def suggest(self, trials, count):
+        rng = self._rng(len(trials))
+        return [self.space.sample(rng) for _ in range(count)]
+
+
+class GridSearch(Algorithm):
+    """Cartesian grid; continuous params discretised into `grid_points`
+    (default 4, per-param override via settings '<name>_points')."""
+
+    name = "grid"
+
+    def _axis(self, p) -> List[str]:
+        if p.get("parameterType") in ("int", "double"):
+            n = int(self.settings.get(f"{p['name']}_points",
+                                      self.settings.get("grid_points", 4)))
+            us = np.linspace(0.0, 1.0, n)
+            vals = []
+            for u in us:
+                v = self.space._cont(p, float(u))
+                vals.append(str(int(round(v)))
+                            if p["parameterType"] == "int" else repr(v))
+            # ints may collide after rounding
+            return list(dict.fromkeys(vals))
+        return [str(v) for v in p["_list"]]
+
+    def suggest(self, trials, count):
+        axes = [self._axis(p) for p in self.space.params]
+        grid = itertools.product(*axes)
+        seen = {tuple(sorted(t["assignments"].items())) for t in trials}
+        out = []
+        for combo in grid:
+            a = dict(zip(self.space.names, combo))
+            if tuple(sorted(a.items())) in seen:
+                continue
+            out.append(a)
+            if len(out) >= count:
+                break
+        return out
+
+
+class TPE(Algorithm):
+    """Tree-structured Parzen estimator: split history at the γ-quantile,
+    model good/bad densities with per-dim Gaussian KDEs, pick candidates
+    maximising l(x)/g(x)."""
+
+    name = "tpe"
+    n_startup = 5
+    n_candidates = 64
+    gamma = 0.25
+
+    def _kde_logpdf(self, centers: np.ndarray, x: np.ndarray) -> np.ndarray:
+        """Sum over dims of 1-D KDE log densities. centers [m, d], x [k, d]."""
+        if len(centers) == 0:
+            return np.zeros(len(x))
+        bw = max(1.0 / max(len(centers), 1) ** 0.5, 0.1)
+        # [k, m, d]
+        diff = (x[:, None, :] - centers[None, :, :]) / bw
+        comp = -0.5 * diff ** 2 - math.log(bw * math.sqrt(2 * math.pi))
+        # logsumexp over centers, sum over dims
+        m = comp.max(axis=1, keepdims=True)
+        lse = m[:, 0, :] + np.log(
+            np.exp(comp - m).sum(axis=1) / len(centers))
+        return lse.sum(axis=1)
+
+    def suggest(self, trials, count):
+        X, y = self._observed(trials)
+        rng = self._rng(len(trials))
+        out = []
+        for c in range(count):
+            if len(y) < self.n_startup:
+                out.append(self.space.sample(rng))
+                continue
+            n_good = max(1, int(math.ceil(self.gamma * len(y))))
+            order = np.argsort(-y)  # best first (internal maximise)
+            good, bad = X[order[:n_good]], X[order[n_good:]]
+            cand = rng.random((self.n_candidates, self.space.dim()))
+            # seed candidates near good points too
+            jitter = good[rng.integers(0, len(good), self.n_candidates // 2)]
+            jitter = np.clip(
+                jitter + rng.normal(0, 0.1, jitter.shape), 0, 1)
+            cand = np.concatenate([cand, jitter], 0)
+            score = self._kde_logpdf(good, cand) - self._kde_logpdf(bad, cand)
+            out.append(self.space.decode(cand[int(np.argmax(score))]))
+        return out
+
+
+class BayesianOptimization(Algorithm):
+    """GP (RBF kernel) posterior + expected-improvement acquisition,
+    argmax over a random candidate set — skopt-parity behavior, numpy."""
+
+    name = "bayesianoptimization"
+    n_startup = 5
+    n_candidates = 256
+    length_scale = 0.25
+    noise = 1e-6
+
+    def _gp_posterior(self, X, y, Xs):
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+        K = k(X, X) + self.noise * np.eye(len(X))
+        Ks = k(X, Xs)
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y - y.mean()))
+        mu = Ks.T @ alpha + y.mean()
+        v = np.linalg.solve(L, Ks)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var)
+
+    def suggest(self, trials, count):
+        X, y = self._observed(trials)
+        rng = self._rng(len(trials))
+        out = []
+        for c in range(count):
+            if len(y) < self.n_startup:
+                out.append(self.space.sample(rng))
+                continue
+            cand = rng.random((self.n_candidates, self.space.dim()))
+            mu, sigma = self._gp_posterior(X, y, cand)
+            best = y.max()
+            z = (mu - best) / sigma
+            phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+            Phi = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+            ei = (mu - best) * Phi + sigma * phi
+            pick = cand[int(np.argmax(ei))]
+            out.append(self.space.decode(pick))
+            # avoid duplicate picks within one batch
+            X = np.concatenate([X, pick[None]], 0)
+            y = np.concatenate([y, [mu[int(np.argmax(ei))]]])
+        return out
+
+
+class CMAES(Algorithm):
+    """(μ/λ) evolution strategy with diagonal covariance adaptation —
+    the practical core of Katib's cmaes service."""
+
+    name = "cmaes"
+
+    def suggest(self, trials, count):
+        X, y = self._observed(trials)
+        rng = self._rng(len(trials))
+        d = self.space.dim()
+        if len(y) < 4:
+            return [self.space.sample(rng) for _ in range(count)]
+        lam = max(4, len(y) // 2)
+        order = np.argsort(-y)
+        mu = max(2, lam // 2)
+        elite = X[order[:mu]]
+        w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+        w = w / w.sum()
+        mean = (elite * w[:, None]).sum(0)
+        var = ((elite - mean) ** 2 * w[:, None]).sum(0) + 1e-4
+        return [self.space.decode(
+            np.clip(mean + rng.normal(0, np.sqrt(var) * 1.2, d), 0, 1))
+            for _ in range(count)]
+
+
+class Hyperband(Algorithm):
+    """Successive halving: suggestions carry a resource assignment (the
+    `resource_name` setting, e.g. steps/epochs) that doubles as rungs
+    drop the worst half. Bracket state is derived from trial history."""
+
+    name = "hyperband"
+
+    def __init__(self, parameters, settings=None, objective_type="maximize",
+                 seed: int = 0):
+        params = list(parameters)
+        settings = settings or {}
+        self.resource_name = settings.get("resource_name", "steps")
+        self.r_min = int(settings.get("r_min", 50))
+        self.r_max = int(settings.get("r_max", 800))
+        self.eta = int(settings.get("eta", 2))
+        # strip the resource param from the searched space if present
+        params = [p for p in params if p["name"] != self.resource_name]
+        super().__init__(params, settings, objective_type, seed)
+
+    def suggest(self, trials, count):
+        rng = self._rng(len(trials))
+        # group completed trials by rung (resource used)
+        by_rung: Dict[int, List[Dict[str, Any]]] = {}
+        for t in trials:
+            if t.get("value") is None:
+                continue
+            r = int(float(t["assignments"].get(self.resource_name,
+                                               self.r_min)))
+            by_rung.setdefault(r, []).append(t)
+        out = []
+        # promote: for the highest rung with >= eta finished, take the top
+        # 1/eta not yet promoted
+        for r in sorted(by_rung, reverse=True):
+            nxt = r * self.eta
+            if nxt > self.r_max:
+                continue
+            done = by_rung[r]
+            promoted = {self._key(t["assignments"])
+                        for t in by_rung.get(nxt, [])}
+            sign = 1.0 if self.maximize else -1.0
+            ranked = sorted(done, key=lambda t: -sign * float(t["value"]))
+            for t in ranked[: max(1, len(done) // self.eta)]:
+                a = dict(t["assignments"])
+                if self._key(a) in promoted:
+                    continue
+                a[self.resource_name] = str(nxt)
+                out.append(a)
+                if len(out) >= count:
+                    return out
+        # fill with fresh base-rung samples
+        while len(out) < count:
+            a = self.space.sample(rng)
+            a[self.resource_name] = str(self.r_min)
+            out.append(a)
+        return out
+
+    def _key(self, a: Assignment) -> str:
+        items = sorted((k, v) for k, v in a.items()
+                       if k != self.resource_name)
+        return hashlib.md5(repr(items).encode()).hexdigest()
+
+
+_ALGORITHMS = {cls.name: cls for cls in
+               (RandomSearch, GridSearch, TPE, BayesianOptimization, CMAES,
+                Hyperband)}
+# Katib aliases
+_ALGORITHMS["bayesian"] = BayesianOptimization
+_ALGORITHMS["skopt"] = BayesianOptimization
+
+
+def algorithm_names() -> List[str]:
+    return sorted(set(_ALGORITHMS))
+
+
+def get_algorithm(name: str, parameters: List[Dict[str, Any]],
+                  settings: Optional[Dict[str, str]] = None,
+                  objective_type: str = "maximize", seed: int = 0
+                  ) -> Algorithm:
+    try:
+        cls = _ALGORITHMS[name]
+    except KeyError:
+        raise KeyError(f"unknown algorithm {name!r}; have "
+                       f"{algorithm_names()}") from None
+    return cls(parameters, settings, objective_type, seed)

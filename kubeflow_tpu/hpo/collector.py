@@ -1,0 +1,101 @@
+"""Metrics collection: stdout-regex parser + sqlite observation store.
+
+Reference split (SURVEY.md §2.2/§5.5): a metrics-collector sidecar parses
+the training container's stdout for `objectiveMetricName` and pushes
+observation logs over gRPC to db-manager, which persists them in MySQL.
+Here the collector parses the chief replica's log file and the store is
+sqlite — same contract (per-trial time series, latest/min/max extraction),
+no external database.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+# `loss=1.23` / `accuracy = 0.9` / `step=10 loss=0.5 acc=0.4` styles, the
+# Katib StdOut collector's default `([\w|-]+)\s*=\s*(value)` contract.
+_METRIC_RE = re.compile(
+    r"([\w.\-/]+)\s*=\s*([+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)")
+
+
+def parse_metrics_text(text: str, wanted: List[str]) -> List[Dict]:
+    """Extract observations for `wanted` metric names from log text.
+    Returns [{name, value, step}] in encounter order; `step` is the last
+    `step=` seen before the metric (0 if none)."""
+    out: List[Dict] = []
+    step = 0
+    for line in text.splitlines():
+        matches = _METRIC_RE.findall(line)
+        for name, value in matches:
+            if name == "step":
+                step = int(float(value))
+        for name, value in matches:
+            if name in wanted:
+                out.append({"name": name, "value": float(value),
+                            "step": step})
+    return out
+
+
+def summarize(observations: List[Dict]) -> Dict[str, Dict[str, float]]:
+    """Per-metric {latest, min, max} — the shape Katib reports in
+    trial.status.observation."""
+    out: Dict[str, Dict[str, float]] = {}
+    for ob in observations:
+        m = out.setdefault(ob["name"], {"latest": ob["value"],
+                                        "min": ob["value"],
+                                        "max": ob["value"]})
+        m["latest"] = ob["value"]
+        m["min"] = min(m["min"], ob["value"])
+        m["max"] = max(m["max"], ob["value"])
+    return out
+
+
+class ObservationStore:
+    """sqlite-backed observation log (db-manager parity)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS observations ("
+            " trial TEXT, name TEXT, value REAL, step INTEGER, ts REAL)")
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS idx_obs_trial ON observations(trial)")
+        self._conn.commit()
+
+    def report(self, trial: str, observations: List[Dict]) -> None:
+        """ReportObservationLog equivalent (idempotent per trial: replaces
+        prior rows so re-collection after restart can't double-count)."""
+        now = time.time()
+        with self._lock:
+            self._conn.execute("DELETE FROM observations WHERE trial=?",
+                               (trial,))
+            self._conn.executemany(
+                "INSERT INTO observations VALUES (?,?,?,?,?)",
+                [(trial, ob["name"], ob["value"], ob.get("step", 0), now)
+                 for ob in observations])
+            self._conn.commit()
+
+    def get(self, trial: str, name: Optional[str] = None) -> List[Dict]:
+        """GetObservationLog equivalent."""
+        q = "SELECT name, value, step FROM observations WHERE trial=?"
+        args = [trial]
+        if name:
+            q += " AND name=?"
+            args.append(name)
+        with self._lock:
+            rows = self._conn.execute(q + " ORDER BY rowid", args).fetchall()
+        return [{"name": n, "value": v, "step": s} for n, v, s in rows]
+
+    def latest(self, trial: str, name: str) -> Optional[float]:
+        obs = self.get(trial, name)
+        return obs[-1]["value"] if obs else None
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

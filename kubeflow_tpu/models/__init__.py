@@ -8,3 +8,9 @@ data-dependent python control flow (everything jit-traceable).
 from .mlp import MLP  # noqa: F401
 from .registry import get_model, model_names, register_model  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet50  # noqa: F401
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    param_logical_axes,
+    preset_config,
+)

@@ -1,0 +1,308 @@
+"""Decoder-only transformer LM — the TPU-native flagship model family.
+
+Design targets the MXU and GSPMD, not any reference implementation (the
+reference has no model code at all — SURVEY.md §2.3):
+
+  * all FLOPs live in einsums with static shapes; bf16 compute, f32 params;
+  * heads/mlp dims annotated with logical axes so `parallel.mesh` rules
+    shard them Megatron-style over the "model" axis (tp) and the embedding
+    dim over "data" (fsdp);
+  * optional mixture-of-experts FFN with dense one-hot dispatch (a matmul,
+    so routing also rides the MXU) and experts sharded over "data" (ep);
+  * `nn.scan` over a stacked layer body → one compiled block regardless of
+    depth (compile time stays flat as layers grow);
+  * `nn.remat` option for activation rematerialisation (HBM ↔ FLOPs);
+  * RoPE positions, pre-LN, SwiGLU.
+
+Logical axes used: vocab, embed, heads, kv, mlp, expert, expert_mlp,
+layers. `param_logical_axes()` derives them from param paths so the train
+loop can build NamedShardings without flax partitioning metadata plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    head_dim: int = 64
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    # MoE: 0 = dense FFN; >0 = that many experts in every layer.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    # Megatron-style sequence parallelism: between matmul regions the
+    # residual stream is sharded over the "model" axis on the seq dim
+    # (annotation only — XLA inserts the all-gather/reduce-scatter pairs).
+    sp: bool = False
+
+    @property
+    def qkv_features(self) -> int:
+        return self.n_heads * self.head_dim
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10_000.0
+         ) -> jnp.ndarray:
+    """Rotary embeddings over the last dim. x: [B, S, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
+        return (y * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        proj = lambda name, feats: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name)
+        q = proj("query", (cfg.n_heads, cfg.head_dim))(x)
+        k = proj("key", (cfg.n_heads, cfg.head_dim))(x)
+        v = proj("value", (cfg.n_heads, cfg.head_dim))(x)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        q = q / np.sqrt(cfg.head_dim)
+
+        # Dense causal attention (XLA fuses the softmax chain). The
+        # long-context context-parallel path lives in
+        # parallel/ring_attention.py behind its own sharded train loop.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        mask = nn.make_causal_mask(jnp.zeros((B, S)), dtype=jnp.bool_)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+        return nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                               name="out")(out)
+
+
+class DenseFFN(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        wi = nn.Dense(2 * cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="wi")(x)
+        gate, up = jnp.split(wi, 2, axis=-1)
+        h = nn.silu(gate) * up  # SwiGLU
+        return nn.Dense(x.shape[-1], use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="wo")(h)
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed experts with dense one-hot dispatch.
+
+    Dispatch/combine are einsums against a one-hot routing tensor — no
+    gather/scatter, so the whole layer is MXU work and shards cleanly:
+    experts over "data" (ep), expert mlp dim over "model" (tp).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, D = x.shape
+        E, K = cfg.n_experts, cfg.expert_top_k
+        gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                               param_dtype=jnp.float32, name="gate")(
+            x.astype(jnp.float32))
+        weights, idx = jax.lax.top_k(jax.nn.softmax(gate_logits, -1), K)
+        weights = weights / jnp.sum(weights, -1, keepdims=True)
+        # [B, S, K, E] one-hot expert assignment, combined with routing
+        # weights into a single dispatch tensor [B, S, E].
+        one_hot = jax.nn.one_hot(idx, E, dtype=cfg.dtype)
+        combine = jnp.einsum("bsk,bske->bse", weights.astype(cfg.dtype),
+                             one_hot)
+        dispatch = (combine > 0).astype(cfg.dtype)
+
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (E, D, 2 * cfg.d_ff), cfg.param_dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (E, cfg.d_ff, D), cfg.param_dtype)
+        # Every expert sees every token, masked by dispatch — the dense-MoE
+        # formulation (exact for small E; capacity-dropping variant is a
+        # serving-time optimisation, not needed for correctness).
+        xe = jnp.einsum("bsd,bse->ebsd", x, dispatch)
+        h = jnp.einsum("ebsd,edf->ebsf", xe, wi.astype(cfg.dtype))
+        gate_h, up = jnp.split(h, 2, axis=-1)
+        h = nn.silu(gate_h) * up
+        ye = jnp.einsum("ebsf,efd->ebsd", h, wo.astype(cfg.dtype))
+        y = jnp.einsum("ebsd,bse->bsd", ye, combine)
+
+        # Load-balancing auxiliary loss (Switch-style), stashed for the
+        # train loop via a mutable collection.
+        me = jnp.mean(one_hot[..., 0, :].astype(jnp.float32), axis=(0, 1))
+        ce = jnp.mean(jax.nn.softmax(gate_logits, -1), axis=(0, 1))
+        self.sow("aux_loss", "moe", E * jnp.sum(me * ce))
+        return y
+
+
+class Block(nn.Module):
+    """One decoder layer. Scan-shaped: returns (carry, per-layer output)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+
+        def sp_shard(y):
+            if not cfg.sp:
+                return y
+            from ..parallel.mesh import AXIS_DATA, AXIS_MODEL
+            from jax.sharding import PartitionSpec as P
+
+            return jax.lax.with_sharding_constraint(
+                y, P(AXIS_DATA, AXIS_MODEL, None))
+
+        x = sp_shard(x)
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.dtype, name="ln1")(x), positions)
+        x = sp_shard(x)
+        ffn = MoEFFN(cfg, name="moe") if cfg.n_experts > 0 else \
+            DenseFFN(cfg, name="mlp")
+        x = x + ffn(RMSNorm(cfg.dtype, name="ln2")(x))
+        return x, None
+
+
+class TransformerLM(nn.Module):
+    """Returns logits [B, S, vocab]. Call with tokens [B, S] (int32)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="embed")(tokens)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        ScanBlock = nn.scan(
+            block,
+            variable_axes={"params": 0, "aux_loss": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,  # positions broadcast to every layer
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = ScanBlock(cfg, name="layers")(x, positions)
+
+        x = RMSNorm(cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Logical axes from param paths
+# ---------------------------------------------------------------------------
+
+_AXES_BY_SUFFIX: Dict[Tuple[str, ...], Tuple[Optional[str], ...]] = {
+    ("embed", "embedding"): ("vocab", "embed"),
+    ("attn", "query", "kernel"): ("embed", "heads", "kv"),
+    ("attn", "key", "kernel"): ("embed", "heads", "kv"),
+    ("attn", "value", "kernel"): ("embed", "heads", "kv"),
+    ("attn", "out", "kernel"): ("heads", "kv", "embed"),
+    ("mlp", "wi", "kernel"): ("embed", "mlp"),
+    ("mlp", "wo", "kernel"): ("mlp", "embed"),
+    ("moe", "gate", "kernel"): ("embed", None),
+    ("moe", "wi"): ("expert", "embed", "expert_mlp"),
+    ("moe", "wo"): ("expert", "expert_mlp", "embed"),
+    ("lm_head", "kernel"): ("embed", "vocab"),
+}
+
+
+def param_logical_axes(params) -> Any:
+    """Pytree (same structure as params) of logical-axis tuples.
+
+    Layer-stacked params (under "layers", produced by nn.scan) get a
+    leading "layers" axis prepended.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = []
+    for path, leaf in flat:
+        names = tuple(getattr(p, "key", str(p)) for p in path)
+        stacked = "layers" in names
+        axes: Optional[Tuple[Optional[str], ...]] = None
+        for suffix, spec in _AXES_BY_SUFFIX.items():
+            if names[-len(suffix):] == suffix:
+                axes = spec
+                break
+        if axes is None:
+            # norms / biases / anything unmatched: replicated
+            axes = (None,) * (leaf.ndim - (1 if stacked else 0))
+        if stacked:
+            axes = ("layers",) + axes
+        assert len(axes) == leaf.ndim, (names, axes, leaf.shape)
+        leaves.append(axes)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def make_transformer(**kw) -> TransformerLM:
+    """Build a TransformerLM from config keywords. Not in the classifier
+    registry: LMs take int token inputs and run through lm_runner /
+    LMTrainLoop, not the image-classifier TrainLoop."""
+    return TransformerLM(TransformerConfig(**kw))
+
+
+# Named size presets (flagship ladder).
+PRESETS: Dict[str, Dict[str, int]] = {
+    "tiny": dict(d_model=128, n_heads=4, head_dim=32, n_layers=2, d_ff=512,
+                 vocab_size=1024, max_seq_len=256),
+    "small": dict(d_model=512, n_heads=8, head_dim=64, n_layers=8, d_ff=2048,
+                  vocab_size=32_000, max_seq_len=2048),
+    "base": dict(d_model=1024, n_heads=16, head_dim=64, n_layers=24,
+                 d_ff=4096, vocab_size=32_000, max_seq_len=4096),
+    "large": dict(d_model=2048, n_heads=16, head_dim=128, n_layers=24,
+                  d_ff=8192, vocab_size=32_000, max_seq_len=4096),
+}
+
+
+def preset_config(name: str, **overrides) -> TransformerConfig:
+    base = dict(PRESETS[name])
+    base.update(overrides)
+    return TransformerConfig(**base)

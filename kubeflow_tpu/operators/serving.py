@@ -1,0 +1,296 @@
+"""InferenceService operator: reconciles serving resources onto local
+model-server processes behind a traffic router.
+
+Reference shape (SURVEY.md §2.1/§3 CS3): KFServing controller → Knative
+Service per component → pods with storage-initializer + server, Istio
+splitting default/canary traffic, KPA scaling on concurrency. Here:
+
+  * each revision (default / canary) runs ``minReplicas`` supervised
+    server subprocesses (independent respawn — one replica dying must not
+    restart the others, unlike a training gang);
+  * a Router per InferenceService does the Istio duty: percentage canary
+    split + round-robin over live replicas;
+  * readiness = the server's /v1/models/{name} probe; status conditions
+    PredictorReady/Ready and status.url follow it;
+  * minReplicas=0 scale-to-zero: the router's cold-request hook re-spawns
+    a replica on demand (Knative activator-lite).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..api.serving import (
+    ISVC_PREDICTOR_READY,
+    ISVC_READY,
+    InferenceService,
+)
+from ..core.controller import Controller, Result
+from ..core.store import Conflict, NotFound, ResourceStore
+from ..serving.router import Router
+from ..utils.net import free_port
+from ..utils.proc import inject_pythonpath
+
+@dataclasses.dataclass
+class _Replica:
+    proc: subprocess.Popen
+    port: int
+    ready: bool = False
+
+
+class _Revision:
+    """Supervised replica set for one revision of one InferenceService."""
+
+    def __init__(self, name: str, model_name: str, model_dir: str,
+                 workdir: str, batcher: Optional[dict]):
+        self.name = name
+        self.model_name = model_name
+        self.model_dir = model_dir
+        self.workdir = workdir
+        self.batcher = batcher
+        self.replicas: List[_Replica] = []
+        self.restarts = 0
+
+    def spawn(self) -> None:
+        port = free_port()
+        argv = [sys.executable, "-m", "kubeflow_tpu.serving.server",
+                f"--model-dir={self.model_dir}", f"--name={self.model_name}",
+                f"--port={port}"]
+        if self.batcher:
+            argv += [f"--max-batch-size={self.batcher.get('maxBatchSize', 32)}",
+                     "--batcher-max-latency-ms="
+                     f"{self.batcher.get('maxLatencyMs', 2.0)}"]
+        os.makedirs(self.workdir, exist_ok=True)
+        env = inject_pythonpath(dict(os.environ))
+        logf = open(os.path.join(
+            self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
+        proc = subprocess.Popen(argv, env=env, stdout=logf,
+                                stderr=subprocess.STDOUT)
+        logf.close()
+        self.replicas.append(_Replica(proc=proc, port=port))
+
+    def reap_and_respawn(self, want: int) -> None:
+        """Keep `want` replicas alive; dead ones are replaced individually."""
+        alive = []
+        for r in self.replicas:
+            if r.proc.poll() is None:
+                alive.append(r)
+            else:
+                self.restarts += 1
+        self.replicas = alive
+        while len(self.replicas) < want:
+            self.spawn()
+        while len(self.replicas) > want:
+            r = self.replicas.pop()
+            r.proc.terminate()
+
+    def probe(self) -> int:
+        """Refresh readiness; returns number of ready replicas."""
+        n = 0
+        for r in self.replicas:
+            if not r.ready:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{r.port}/v1/models/"
+                            f"{self.model_name}", timeout=1.0) as resp:
+                        r.ready = json.load(resp).get("ready", False)
+                except OSError:
+                    r.ready = False
+            if r.ready:
+                n += 1
+        return n
+
+    def endpoints(self) -> List[str]:
+        return [f"127.0.0.1:{r.port}" for r in self.replicas if r.ready]
+
+    def teardown(self) -> None:
+        for r in self.replicas:
+            if r.proc.poll() is None:
+                r.proc.terminate()
+        deadline = time.time() + 3
+        for r in self.replicas:
+            while r.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if r.proc.poll() is None:
+                r.proc.kill()
+        self.replicas.clear()
+
+
+class _IsvcRuntime:
+    def __init__(self):
+        self.router: Optional[Router] = None
+        self.revisions: Dict[str, _Revision] = {}
+        self.cold_hit = False
+
+
+class InferenceServiceController(Controller):
+    KIND = "InferenceService"
+    RESYNC_PERIOD = 1.0
+
+    def __init__(self, store: ResourceStore, home: str):
+        super().__init__(store)
+        self.home = home
+        self._lock = threading.Lock()
+        self._runtimes: Dict[str, _IsvcRuntime] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def on_delete(self, obj) -> None:
+        self._teardown(obj.key)
+
+    def _teardown(self, key: str) -> None:
+        with self._lock:
+            rt = self._runtimes.pop(key, None)
+        if rt is None:
+            return
+        for rev in rt.revisions.values():
+            rev.teardown()
+        if rt.router is not None:
+            rt.router.stop()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            keys = list(self._runtimes)
+        for k in keys:
+            self._teardown(k)
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, key: str) -> Optional[Result]:
+        isvc = self.get_resource(key)
+        if isvc is None:
+            self._teardown(key)
+            return None
+        assert isinstance(isvc, InferenceService)
+
+        with self._lock:
+            rt = self._runtimes.get(key)
+            if rt is None:
+                rt = _IsvcRuntime()
+                self._runtimes[key] = rt
+
+        if rt.router is None:
+            rt.router = Router().start()
+            ctrl, k = self, key
+
+            def cold():
+                with ctrl._lock:
+                    r = ctrl._runtimes.get(k)
+                if r is not None:
+                    r.cold_hit = True
+                ctrl.queue.add(k)
+
+            rt.router.on_cold_request = cold
+            self.record_event(isvc, "Normal", "RouterStarted",
+                              f"router on 127.0.0.1:{rt.router.port}")
+
+        all_ready = True
+        for rev_name in ("default", "canary"):
+            spec = isvc.revision_spec(rev_name)
+            rev = rt.revisions.get(rev_name)
+            if spec is None:
+                if rev is not None:
+                    rev.teardown()
+                    del rt.revisions[rev_name]
+                continue
+            model_dir = _resolve_storage_uri(spec_storage_uri(spec))
+            if rev is None or rev.model_dir != model_dir:
+                if rev is not None:
+                    rev.teardown()
+                rev = _Revision(
+                    name=rev_name,
+                    model_name=isvc.name,
+                    model_dir=model_dir,
+                    workdir=os.path.join(self.home, "serving",
+                                         key.replace("/", "_")),
+                    batcher=spec.get("batcher"),
+                )
+                rt.revisions[rev_name] = rev
+                self.record_event(isvc, "Normal", "RevisionCreated",
+                                  f"{rev_name} -> {model_dir}")
+            want = int(spec.get("minReplicas", 1))
+            if want == 0 and rt.cold_hit:
+                want = 1  # activator: scale from zero on traffic
+            rev.reap_and_respawn(want)
+            ready = rev.probe()
+            if ready < max(want, 1) and want > 0:
+                all_ready = False
+
+        # Router wiring + traffic split.
+        default_rev = rt.revisions.get("default")
+        canary_rev = rt.revisions.get("canary")
+        if default_rev is not None:
+            rt.router.default.set_endpoints(default_rev.endpoints())
+        if canary_rev is not None:
+            rt.router.canary.set_endpoints(canary_rev.endpoints())
+            rt.router.canary_percent = isvc.canary_traffic_percent_split()
+        else:
+            rt.router.canary_percent = 0
+
+        self._sync_status(isvc, rt, all_ready)
+        return Result(requeue=True, requeue_after=0.25) if not all_ready \
+            else None
+
+    def _sync_status(self, isvc: InferenceService, rt: _IsvcRuntime,
+                     all_ready: bool) -> None:
+        fresh = self.get_resource(isvc.key)
+        if fresh is None:
+            return
+        isvc = fresh
+        url = f"http://127.0.0.1:{rt.router.port}"
+        ready_counts = {name: len(rev.endpoints())
+                        for name, rev in rt.revisions.items()}
+        changed = False
+        if isvc.status.get("url") != url:
+            isvc.status["url"] = url
+            changed = True
+        if isvc.status.get("readyReplicas") != ready_counts:
+            isvc.status["readyReplicas"] = ready_counts
+            changed = True
+        status = "True" if all_ready else "False"
+        for ctype in (ISVC_PREDICTOR_READY, ISVC_READY):
+            if not isvc.has_condition(ctype, status):
+                isvc.set_condition(ctype, status,
+                                   "RevisionsReady" if all_ready
+                                   else "RevisionsNotReady", "")
+                changed = True
+        if changed:
+            try:
+                self.store.update_status(isvc)
+            except (Conflict, NotFound):
+                self.queue.add(isvc.key)
+
+    # -- helpers ------------------------------------------------------------
+    def router_url(self, key: str) -> Optional[str]:
+        with self._lock:
+            rt = self._runtimes.get(key)
+        return None if rt is None or rt.router is None else \
+            f"http://127.0.0.1:{rt.router.port}"
+
+
+def spec_storage_uri(spec: dict) -> str:
+    for fw in ("jax", "sklearn", "xgboost", "pytorch", "tensorflow", "onnx",
+               "triton"):
+        if fw in spec:
+            return str(spec[fw].get("storageUri", ""))
+    return str(spec.get("storageUri", ""))
+
+
+def _resolve_storage_uri(uri: str) -> str:
+    """storage-initializer equivalent: resolve a URI to a local dir.
+    file:// and bare paths are native; other schemes would download here."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if "://" in uri:
+        raise ValueError(f"unsupported storageUri scheme: {uri}")
+    return uri
+
+
+def serving_controllers(store: ResourceStore, home: str) -> List[Controller]:
+    return [InferenceServiceController(store, home)]

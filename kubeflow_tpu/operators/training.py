@@ -40,23 +40,12 @@ from ..core.store import ResourceStore
 from ..runtime import gang as G
 from ..runtime import rendezvous as rdv
 from ..utils.net import free_port
+from ..utils.proc import inject_pythonpath
 
 # Sleep-forever placeholder for replica templates with no command (the
 # reference's MPI workers run sshd and just host processes).
 _PLACEHOLDER_ARGV = [sys.executable, "-c",
                      "import time\nwhile True: time.sleep(3600)"]
-
-# Parent directory of the kubeflow_tpu package: injected into every worker's
-# PYTHONPATH so `python -m kubeflow_tpu.runners...` commands resolve even
-# when the package is not pip-installed (gangs run from their own workdir).
-_PKG_PARENT = os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))
-
-
-def _inject_pythonpath(env: Dict[str, str]) -> None:
-    prior = env.get("PYTHONPATH") or os.environ.get("PYTHONPATH", "")
-    parts = [_PKG_PARENT] + ([prior] if prior else [])
-    env["PYTHONPATH"] = os.pathsep.join(parts)
 
 
 def _phase_condition(phase: str) -> Optional[Tuple[str, str, str]]:
@@ -157,7 +146,7 @@ class TrainingControllerBase(Controller):
         def factory(workdir: str) -> G.Gang:
             specs, env_hook = ctrl.build_specs(job, workdir)
             for spec in specs:
-                _inject_pythonpath(spec.env)
+                inject_pythonpath(spec.env)
             # restartPolicy comes from the chief replica's spec (the
             # reference tracks it per replica; one gang = one policy here,
             # chief's wins as it decides success anyway).
@@ -247,13 +236,9 @@ class TrainingControllerBase(Controller):
         done = job.status.get("completionTime")
         if not done:
             return None
-        import datetime
+        from ..api.base import age_seconds
 
-        fin = datetime.datetime.strptime(
-            done, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
-            tzinfo=datetime.timezone.utc)
-        age = (datetime.datetime.now(datetime.timezone.utc) - fin
-               ).total_seconds()
+        age = age_seconds(done)
         if age >= ttl:
             from ..core.store import NotFound
 

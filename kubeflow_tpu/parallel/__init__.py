@@ -1,0 +1,25 @@
+"""Parallelism library: mesh construction, sharding rules, pipeline and
+ring-attention primitives.
+
+The reference has no model-parallel math of its own (SURVEY.md §2.3 —
+Kubeflow orchestrates containers; NCCL/Horovod live inside them). On TPU,
+parallelism is a compiler concern: pick a mesh, annotate shardings, let
+XLA insert collectives over ICI/DCN. This package owns that vocabulary
+for the whole framework:
+
+  axis "data"   — batch (dp); parameters optionally sharded here too (fsdp)
+                  and MoE experts ride it (ep)
+  axis "model"  — tensor parallelism (tp); sequence parallelism (sp) shards
+                  activations' sequence dim on this axis between matmuls
+  axis "stage"  — pipeline parallelism (pp) via shard_map + ppermute
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_STAGE,
+    MeshPlan,
+    logical_sharding,
+    make_mesh,
+    param_sharding_rules,
+)

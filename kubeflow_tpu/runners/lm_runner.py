@@ -1,0 +1,160 @@
+"""Flagship LM training worker: transformer over the full parallelism
+stack (dp/fsdp/tp/sp/ep/pp) on a device mesh.
+
+Same process contract as jax_runner (rendezvous env, checkpoint/resume,
+stdout metric lines), but the model is the TransformerLM family and the
+mesh plan is selectable from the manifest:
+
+    python -m kubeflow_tpu.runners.lm_runner --preset=small --tp=4 --fsdp \
+        --steps=1000 --batch-size=32 --seq-len=2048
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="kfx LM training runner")
+    p.add_argument("--preset", default="tiny",
+                   help="transformer size preset (tiny|small|base|large)")
+    p.add_argument("--dataset", default="lm-tiny")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=0,
+                   help="override dataset/preset sequence length")
+    p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=50)
+    p.add_argument("--tp", type=int, default=0, help="tensor parallel ways")
+    p.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    p.add_argument("--fsdp", action="store_true")
+    p.add_argument("--sp", action="store_true", help="sequence parallelism")
+    p.add_argument("--experts", type=int, default=0, help="MoE experts (ep)")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--microbatches", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-every", type=int, default=200)
+    p.add_argument("--keep-checkpoints", type=int, default=2)
+    p.add_argument("--no-checkpoint", action="store_true")
+    p.add_argument("--fail-at-step", type=int, default=-1)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from .jax_runner import enable_compile_cache, initialize_distributed
+
+    initialize_distributed()
+
+    import jax
+
+    enable_compile_cache()
+
+    from ..data.lm import get_lm_dataset
+    from ..models.transformer import preset_config
+    from ..parallel.lm_train import LMHyperParams, LMTrainLoop
+    from ..parallel.mesh import make_mesh
+    from ..training import Checkpointer
+
+    rank = jax.process_index()
+    world = jax.process_count()
+
+    if args.sp and args.pp > 1:
+        print("error: --sp with --pp>1 is not supported "
+              "(sequence parallelism composes with tp in the non-pipelined "
+              "loop only)", file=sys.stderr)
+        return 2
+    ds = get_lm_dataset(args.dataset, seed=args.seed,
+                        seq_len=args.seq_len or None)
+    cfg = preset_config(
+        args.preset,
+        vocab_size=ds.vocab_size,
+        max_seq_len=ds.seq_len,
+        n_experts=args.experts,
+        sp=args.sp,
+        remat=args.remat,
+    )
+    mesh, plan = make_mesh(tp=args.tp or None, pp=args.pp,
+                           fsdp=args.fsdp)
+    hp = LMHyperParams(learning_rate=args.learning_rate,
+                       warmup_steps=args.warmup_steps,
+                       total_steps=args.steps, seed=args.seed)
+    if plan.pp > 1:
+        from ..parallel.pipeline import PipelinedLMTrainLoop
+
+        loop = PipelinedLMTrainLoop(cfg, mesh, plan, hp,
+                                    n_microbatches=args.microbatches or None)
+    else:
+        loop = LMTrainLoop(cfg, mesh, plan, hp)
+
+    n_params = None  # filled after init
+    print(f"runner_start model=transformer-{args.preset} "
+          f"dataset={args.dataset} rank={rank} world={world} "
+          f"devices={jax.device_count()} plan=pp{plan.pp}/dp{plan.dp}/"
+          f"tp{plan.tp}{'/fsdp' if plan.fsdp else ''}"
+          f"{'/sp' if cfg.sp else ''}"
+          f"{f'/ep{cfg.n_experts}' if cfg.n_experts else ''} "
+          f"seq_len={ds.seq_len}", flush=True)
+
+    state = loop.init_state()
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    print(f"model_params={n_params}", flush=True)
+
+    ckpt = None
+    start_step = 0
+    ckpt_dir = os.environ.get("KFX_CHECKPOINT_DIR", "")
+    if ckpt_dir and not args.no_checkpoint:
+        ckpt = Checkpointer(ckpt_dir, save_every=args.checkpoint_every,
+                            keep=args.keep_checkpoints)
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state.step))
+            print(f"resumed_from_checkpoint step={start_step}", flush=True)
+
+    it = ds.batches(args.batch_size, shard_index=rank, num_shards=world)
+    for _ in range(start_step):
+        next(it)
+
+    t_start = time.time()
+    t_last = t_start
+    tokens_per_step = args.batch_size * ds.seq_len
+    loss = acc = 0.0
+    for step in range(start_step, args.steps):
+        if step == args.fail_at_step:
+            if ckpt is not None:
+                ckpt.wait()
+            print(f"fault_injection_crash step={step}", flush=True)
+            os._exit(17)
+        state, loss, acc = loop.train_step(state, next(it))
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            now = time.time()
+            dt = (now - t_last) / args.log_every
+            tps = tokens_per_step / dt if dt > 0 else 0.0
+            print(f"step={step + 1} loss={loss:.6f} accuracy={acc:.6f} "
+                  f"step_time={dt:.4f} tokens_per_s={tps:.0f}", flush=True)
+            t_last = now
+        if ckpt is not None:
+            ckpt.maybe_save(step + 1, state)
+
+    eval_toks = ds.eval_batch(args.batch_size)
+    metrics = loop.evaluate(state, eval_toks)
+    wall = time.time() - t_start
+    print(f"train_done steps={args.steps} wall_seconds={wall:.2f}",
+          flush=True)
+    print(f"loss={metrics['loss']:.6f}", flush=True)
+    print(f"accuracy={metrics['accuracy']:.6f}", flush=True)
+    print(f"entropy_floor={ds.entropy_floor():.6f}", flush=True)
+
+    if ckpt is not None:
+        ckpt.maybe_save(args.steps, state, force=True)
+        ckpt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

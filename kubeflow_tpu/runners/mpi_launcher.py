@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 # transport knobs that have no meaning for local processes).
 _IGNORED_WITH_ARG = {
     "--hostfile", "-hostfile", "--machinefile", "-machinefile",
+    "-H", "--host", "-host",
     "-bind-to", "--bind-to", "-map-by", "--map-by",
     "-rf", "--rankfile", "--prefix", "-wdir", "--wdir",
 }

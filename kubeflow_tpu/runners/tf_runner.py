@@ -67,21 +67,38 @@ def main(argv=None) -> int:
         loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
             from_logits=True)
 
-    @tf.function
-    def train_step(images, labels):
+    def step_fn(images, labels):
         with tf.GradientTape() as tape:
             logits = model(images, training=True)
             loss = loss_fn(labels, logits)
         grads = tape.gradient(loss, model.trainable_variables)
+        # In replica context apply_gradients all-reduces across workers
+        # (the NCCL ring's job in the reference's GPU pods).
         opt.apply_gradients(zip(grads, model.trainable_variables))
         acc = tf.reduce_mean(tf.cast(
             tf.equal(tf.argmax(logits, -1, output_type=tf.int32), labels),
             tf.float32))
         return loss, acc
 
+    @tf.function
+    def train_step(images, labels):
+        loss, acc = strategy.run(step_fn, args=(images, labels))
+        return (strategy.reduce(tf.distribute.ReduceOp.MEAN, loss, axis=None),
+                strategy.reduce(tf.distribute.ReduceOp.MEAN, acc, axis=None))
+
+    # Each worker consumes its disjoint shard of the global batch (same
+    # contract as the JAX runner's data-parallel input pipeline). Chief
+    # (if any) takes shard 0, workers follow.
+    n_chief = len(cluster.get("chief", [])) + len(cluster.get("master", []))
+    if n_workers > 1 and task.get("type") in ("worker",):
+        task_index = n_chief + int(task.get("index", 0))
+    else:
+        task_index = int(task.get("index", 0)) if n_workers > 1 else 0
+    shards = max(n_workers, 1)
     t0 = time.time()
     t_last = t0
-    it = ds.batches(args.batch_size)
+    it = ds.batches(args.batch_size, shard_index=task_index,
+                    num_shards=shards)
     loss = acc = 0.0
     for step in range(args.steps):
         images, labels = next(it)

@@ -1,0 +1,133 @@
+"""Traffic router: the Istio/Knative-ingress duty for InferenceServices.
+
+The reference splits default/canary traffic in the Istio VirtualService
+the KFServing controller writes (SURVEY.md §3 CS3). Here the router is a
+small HTTP proxy owned by the operator: deterministic hash-free
+percentage split between default and canary backends, round-robin across
+replicas, 503 with Retry-After while a backend scales from zero.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import random
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+
+class BackendSet:
+    """Round-robin over the live replica endpoints of one revision."""
+
+    def __init__(self, endpoints: Optional[List[str]] = None):
+        self._lock = threading.Lock()
+        self._endpoints = list(endpoints or [])
+        self._rr = itertools.count()
+
+    def set_endpoints(self, endpoints: List[str]) -> None:
+        with self._lock:
+            self._endpoints = list(endpoints)
+
+    def pick(self) -> Optional[str]:
+        with self._lock:
+            if not self._endpoints:
+                return None
+            return self._endpoints[next(self._rr) % len(self._endpoints)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._endpoints)
+
+
+class Router:
+    """HTTP proxy with default/canary percentage split."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 rng: Optional[random.Random] = None):
+        self.default = BackendSet()
+        self.canary = BackendSet()
+        self.canary_percent = 0
+        self._rng = rng or random.Random(0xC0FFEE)
+        # Called when a request arrives and no replica is live
+        # (scale-from-zero activator hook).
+        self.on_cold_request: Optional[Callable[[], None]] = None
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                router._proxy(self, has_body=False)
+
+            def do_POST(self):
+                router._proxy(self, has_body=True)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def _pick_backend(self) -> Optional[str]:
+        use_canary = (len(self.canary) > 0
+                      and self._rng.random() * 100 < self.canary_percent)
+        backend = (self.canary if use_canary else self.default).pick()
+        if backend is None:  # fall through to the other set
+            backend = (self.default if use_canary else self.canary).pick()
+        return backend
+
+    def _proxy(self, h, has_body: bool) -> None:
+        backend = self._pick_backend()
+        if backend is None:
+            if self.on_cold_request is not None:
+                try:
+                    self.on_cold_request()
+                except Exception:
+                    pass
+            body = json.dumps({"error": "no live replicas"}).encode()
+            h.send_response(503)
+            h.send_header("Retry-After", "1")
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+            return
+        data = b""
+        if has_body:
+            length = int(h.headers.get("Content-Length", 0))
+            data = h.rfile.read(length) if length else b""
+        host, _, port = backend.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        try:
+            conn.request(h.command, h.path, body=data or None,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            h.send_response(resp.status)
+            h.send_header("Content-Type",
+                          resp.getheader("Content-Type", "application/json"))
+            h.send_header("Content-Length", str(len(payload)))
+            h.end_headers()
+            h.wfile.write(payload)
+        except OSError as e:
+            body = json.dumps({"error": f"backend {backend}: {e}"}).encode()
+            h.send_response(502)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+        finally:
+            conn.close()
+
+    def start(self) -> "Router":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="kfx-router")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,21 @@
+"""Child-process environment helpers shared by the operators."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+# Parent directory of the kubeflow_tpu package: injected into worker
+# PYTHONPATHs so `python -m kubeflow_tpu...` commands resolve even when the
+# package is not pip-installed (workers run from their own workdirs).
+PKG_PARENT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def inject_pythonpath(env: Dict[str, str]) -> Dict[str, str]:
+    """Prepend the package parent to env's PYTHONPATH (falling back to the
+    current process's) in place; returns env for chaining."""
+    prior = env.get("PYTHONPATH") or os.environ.get("PYTHONPATH", "")
+    parts = [PKG_PARENT] + ([prior] if prior and prior != PKG_PARENT else [])
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env

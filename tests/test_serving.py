@@ -1,0 +1,232 @@
+"""Serving stack tests: V1 protocol server, bucketed jit predict,
+micro-batcher, router canary split, and the InferenceService operator
+end-to-end (train -> export -> apply -> predict -> canary)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+PY = sys.executable
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+@pytest.fixture(scope="module")
+def export_dir(tmp_path_factory):
+    """Train a tiny mlp and export it once for all serving tests."""
+    import jax
+
+    from kubeflow_tpu.data import get_dataset
+    from kubeflow_tpu.models import get_model
+    from kubeflow_tpu.serving.export import export_params
+    from kubeflow_tpu.training import TrainLoop
+
+    out = tmp_path_factory.mktemp("export")
+    ds = get_dataset("mnist")
+    model = get_model("mlp", num_classes=ds.num_classes)
+    loop = TrainLoop(model)
+    state = loop.init_state(ds.shape)
+    for images, labels in ds.batches(128, steps=20):
+        state, *_ = loop.train_step(state, images, labels)
+    export_params(str(out), "mlp", ds.shape, ds.num_classes, state)
+    return str(out)
+
+
+class TestModelServer:
+    @pytest.fixture(scope="class")
+    def server(self, export_dir):
+        from kubeflow_tpu.serving.server import JaxPredictor, ModelServer
+
+        predictor = JaxPredictor(export_dir, name="mnist", max_batch_size=16)
+        predictor.load()
+        srv = ModelServer(port=0)
+        srv.register(predictor)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def test_v1_protocol_surface(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        assert _get(f"{base}/healthz")[0] == 200
+        status, body = _get(f"{base}/v1/models")
+        assert status == 200 and body["models"] == ["mnist"]
+        status, body = _get(f"{base}/v1/models/mnist")
+        assert status == 200 and body["ready"] is True
+
+    def test_predict_correctness(self, server, export_dir):
+        from kubeflow_tpu.data import get_dataset
+
+        ds = get_dataset("mnist", split="eval")
+        images, labels = ds.eval_arrays(32)
+        base = f"http://127.0.0.1:{server.port}"
+        status, body = _post(f"{base}/v1/models/mnist:predict",
+                             {"instances": images.tolist()})
+        assert status == 200
+        preds = np.asarray(body["predictions"])
+        assert preds.shape == (32,)
+        # trained model beats chance comfortably
+        assert (preds == labels).mean() > 0.5
+        assert len(body["probabilities"][0]) == ds.num_classes
+
+    def test_bucket_padding_odd_batch(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        x = np.zeros((3, 28, 28, 1), np.float32)
+        status, body = _post(f"{base}/v1/models/mnist:predict",
+                             {"instances": x.tolist()})
+        assert status == 200 and len(body["predictions"]) == 3
+
+    def test_errors(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/v1/models/nope:predict", {"instances": [[0.0]]})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/v1/models/mnist:predict", {"wrong": 1})
+        assert e.value.code == 400
+
+
+class TestMicroBatcher:
+    def test_concurrent_requests_batched(self, export_dir):
+        import threading
+
+        from kubeflow_tpu.serving.server import JaxPredictor, MicroBatcher
+
+        predictor = JaxPredictor(export_dir, name="m", max_batch_size=32)
+        predictor.load()
+        calls = []
+        orig = predictor.predict
+
+        def spy(instances):
+            calls.append(instances.shape[0])
+            return orig(instances)
+
+        predictor.predict = spy
+        batcher = MicroBatcher(predictor, max_batch_size=32,
+                               max_latency_ms=50.0)
+        results = [None] * 8
+
+        def hit(i):
+            x = np.zeros((1, 28, 28, 1), np.float32)
+            results[i] = batcher.predict(x)
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batcher.close()
+        assert all(r is not None and len(r["predictions"]) == 1
+                   for r in results)
+        # far fewer device dispatches than requests
+        assert len(calls) < 8
+        assert sum(calls) == 8
+
+
+class TestRouter:
+    def test_canary_split_and_cold(self):
+        from kubeflow_tpu.serving.router import Router
+        from kubeflow_tpu.serving.server import ModelServer, Predictor
+
+        class Echo(Predictor):
+            def __init__(self, name, tag):
+                self.name = name
+                self.tag = tag
+                self.ready = True
+
+            def load(self):
+                pass
+
+            def predict(self, instances):
+                return {"predictions": [self.tag] * instances.shape[0]}
+
+        s1 = ModelServer(port=0)
+        s1.register(Echo("m", "default"))
+        s1.start()
+        s2 = ModelServer(port=0)
+        s2.register(Echo("m", "canary"))
+        s2.start()
+        router = Router().start()
+        try:
+            # cold: no backends yet
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"http://127.0.0.1:{router.port}/v1/models/m:predict",
+                      {"instances": [[0.0]]})
+            assert e.value.code == 503
+            router.default.set_endpoints([f"127.0.0.1:{s1.port}"])
+            router.canary.set_endpoints([f"127.0.0.1:{s2.port}"])
+            router.canary_percent = 30
+            tags = []
+            for _ in range(200):
+                _, body = _post(
+                    f"http://127.0.0.1:{router.port}/v1/models/m:predict",
+                    {"instances": [[0.0]]})
+                tags.append(body["predictions"][0])
+            frac = tags.count("canary") / len(tags)
+            assert 0.15 < frac < 0.45, frac
+        finally:
+            router.stop()
+            s1.stop()
+            s2.stop()
+
+
+@pytest.mark.slow
+class TestInferenceServiceE2E:
+    def test_apply_predict_canary_update(self, export_dir, tmp_path):
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: mnist
+spec:
+  predictor:
+    minReplicas: 1
+    jax:
+      storageUri: file://{export_dir}
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "mnist",
+                                         "Ready", timeout=120)
+            url = isvc.status["url"]
+            x = np.zeros((2, 28, 28, 1), np.float32)
+            status, body = _post(f"{url}/v1/models/mnist:predict",
+                                 {"instances": x.tolist()}, timeout=60)
+            assert status == 200 and len(body["predictions"]) == 2
+
+            # Add a canary revision at 50% using the same export.
+            fresh = cp.store.get("InferenceService", "mnist")
+            fresh.spec["canary"] = {"minReplicas": 1,
+                                    "jax": {"storageUri": export_dir}}
+            fresh.spec["canaryTrafficPercent"] = 50
+            cp.store.update(fresh)
+            import time
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                cur = cp.store.get("InferenceService", "mnist")
+                if cur.status.get("readyReplicas", {}).get("canary"):
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("canary never became ready")
+            status, _ = _post(f"{url}/v1/models/mnist:predict",
+                              {"instances": x.tolist()}, timeout=60)
+            assert status == 200
