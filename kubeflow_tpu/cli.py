@@ -80,21 +80,37 @@ class KfxCLI:
 
     def run(self, paths: List[str], timeout: float, follow: bool = True) -> int:
         applied = self.apply(paths)
-        jobs = [o for o in applied if isinstance(o, TrainingJob)]
-        if not jobs:
-            print("nothing to wait for (no training jobs in manifests)")
+        waitable = [o for o in applied
+                    if isinstance(o, TrainingJob) or o.KIND == "Experiment"]
+        if not waitable:
+            print("nothing to wait for (no training jobs or experiments "
+                  "in manifests)")
             return 0
         rc = 0
-        for job in jobs:
-            final = self._wait_streaming(job, timeout, follow)
+        for obj in waitable:
+            final = self._wait_streaming(
+                obj, timeout, follow and isinstance(obj, TrainingJob))
             state = _job_state(final)
-            print(f"{job.KIND.lower()}/{job.name} {state.lower()}")
+            print(f"{obj.KIND.lower()}/{obj.name} {state.lower()}")
             if state != "Succeeded":
                 rc = 1
+            if final.KIND == "Experiment":
+                best = final.status.get("currentOptimalTrial")
+                if best:
+                    metrics = best.get("observation", {}).get("metrics", [])
+                    print(f"best trial: {best.get('bestTrialName')} "
+                          f"{metrics} "
+                          f"{best.get('parameterAssignments')}")
         return rc
 
-    def _wait_streaming(self, job: TrainingJob, timeout: float,
-                        follow: bool) -> TrainingJob:
+    @staticmethod
+    def _is_terminal(obj: Resource) -> bool:
+        if isinstance(obj, TrainingJob):
+            return obj.is_finished()
+        return obj.has_condition("Succeeded") or obj.has_condition("Failed")
+
+    def _wait_streaming(self, job: Resource, timeout: float,
+                        follow: bool) -> Resource:
         """Wait for completion while tailing the chief log to stdout."""
         deadline = time.monotonic() + timeout
         offset = 0
@@ -104,7 +120,7 @@ class KfxCLI:
                 raise SystemExit(f"{job.KIND} {job.key} disappeared")
             if follow:
                 offset = self._tail(obj, offset)
-            if isinstance(obj, TrainingJob) and obj.is_finished():
+            if self._is_terminal(obj):
                 if follow:
                     time.sleep(0.2)  # final flush
                     self._tail(obj, offset)

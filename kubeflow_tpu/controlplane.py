@@ -51,13 +51,14 @@ class ControlPlane:
                                          worker_platform):
             self.manager.register(ctrl)
         # Serving / HPO / platform controllers register here as they land.
-        try:
-            from .operators.hpo import hpo_controllers
+        from .hpo.collector import ObservationStore
+        from .operators.hpo import hpo_controllers
 
-            for ctrl in hpo_controllers(self.store):
-                self.manager.register(ctrl)
-        except ImportError:
-            pass
+        self.observations = ObservationStore(
+            os.path.join(self.home, "observations.db"))
+        for ctrl in hpo_controllers(self.store, self.gangs,
+                                    self.observations):
+            self.manager.register(ctrl)
         try:
             from .operators.serving import serving_controllers
 
@@ -88,6 +89,7 @@ class ControlPlane:
             if callable(shutdown):
                 shutdown()
         self.gangs.shutdown()
+        self.observations.close()
         self.store.close()
 
     def __enter__(self) -> "ControlPlane":

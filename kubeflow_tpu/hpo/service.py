@@ -1,0 +1,148 @@
+"""Suggestion service: the algorithm zoo behind a real gRPC boundary.
+
+Katib runs one gRPC suggestion deployment per algorithm and the
+experiment controller calls `GetSuggestions` across the process boundary
+(SURVEY.md §3 CS2). This keeps that architecture — a separate service
+process reachable over gRPC — with JSON message bodies instead of
+protoc-generated stubs (grpcio is installed; grpcio-tools is not, and the
+wire contract is ours on both ends).
+
+Service:  kfx.Suggestion / GetSuggestions, ValidateAlgorithmSettings
+Request:  {"algorithm": ..., "parameters": [...], "objectiveType": ...,
+           "trials": [{"assignments": {...}, "value": 1.0}], "count": N,
+           "settings": {...}, "seed": 0}
+Response: {"assignments": [{name: value}, ...]} | {"error": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import List, Optional
+
+import grpc
+
+from .algorithms import algorithm_names, get_algorithm
+
+SERVICE = "kfx.Suggestion"
+
+
+def _json_serializer(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _json_deserializer(data: bytes):
+    return json.loads(data.decode())
+
+
+class SuggestionServicer:
+    """Stateless: every call re-derives from the full trial history, like
+    Katib suggestion services fed by the experiment controller."""
+
+    def get_suggestions(self, request, context):
+        try:
+            algo = get_algorithm(
+                request.get("algorithm", "random"),
+                request["parameters"],
+                settings=request.get("settings"),
+                objective_type=request.get("objectiveType", "maximize"),
+                seed=int(request.get("seed", 0)),
+            )
+            assignments = algo.suggest(request.get("trials", []),
+                                       int(request.get("count", 1)))
+            return {"assignments": assignments}
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(str(e))
+            return {"error": str(e)}
+
+    def validate(self, request, context):
+        name = request.get("algorithm", "")
+        if name not in algorithm_names():
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(
+                f"unknown algorithm {name!r}; have {algorithm_names()}")
+            return {"error": "unknown algorithm"}
+        return {"ok": True}
+
+
+def make_server(port: int = 0, host: str = "127.0.0.1") -> "SuggestionServer":
+    servicer = SuggestionServicer()
+    handlers = grpc.method_handlers_generic_handler(SERVICE, {
+        "GetSuggestions": grpc.unary_unary_rpc_method_handler(
+            servicer.get_suggestions,
+            request_deserializer=_json_deserializer,
+            response_serializer=_json_serializer),
+        "ValidateAlgorithmSettings": grpc.unary_unary_rpc_method_handler(
+            servicer.validate,
+            request_deserializer=_json_deserializer,
+            response_serializer=_json_serializer),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((handlers,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    return SuggestionServer(server, bound)
+
+
+class SuggestionServer:
+    def __init__(self, server: grpc.Server, port: int):
+        self._server = server
+        self.port = port
+
+    def start(self) -> "SuggestionServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class SuggestionClient:
+    """Typed client for the JSON-gRPC service."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(address)
+        self._get = self._channel.unary_unary(
+            f"/{SERVICE}/GetSuggestions",
+            request_serializer=_json_serializer,
+            response_deserializer=_json_deserializer)
+        self._validate = self._channel.unary_unary(
+            f"/{SERVICE}/ValidateAlgorithmSettings",
+            request_serializer=_json_serializer,
+            response_deserializer=_json_deserializer)
+
+    def get_suggestions(self, algorithm: str, parameters: list,
+                        trials: list, count: int,
+                        objective_type: str = "maximize",
+                        settings: Optional[dict] = None,
+                        seed: int = 0, timeout: float = 30.0) -> List[dict]:
+        resp = self._get({
+            "algorithm": algorithm, "parameters": parameters,
+            "trials": trials, "count": count,
+            "objectiveType": objective_type,
+            "settings": settings or {}, "seed": seed,
+        }, timeout=timeout)
+        return resp["assignments"]
+
+    def validate(self, algorithm: str, timeout: float = 10.0) -> bool:
+        return bool(self._validate({"algorithm": algorithm},
+                                   timeout=timeout).get("ok"))
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+# Shared in-process server for embedded control planes (one per process,
+# started lazily): the gRPC boundary is kept, the deployment is local.
+_shared_lock = threading.Lock()
+_shared: Optional[SuggestionServer] = None
+
+
+def shared_suggestion_address() -> str:
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = make_server().start()
+        return f"127.0.0.1:{_shared.port}"

@@ -1,0 +1,568 @@
+"""HPO operators: Experiment / Suggestion / Trial controllers.
+
+Katib's control flow (SURVEY.md §3 CS2), rebuilt on the local engine:
+
+  Experiment ─creates→ Suggestion (algorithm service handle)
+             ─gRPC GetSuggestions→ parameter assignments
+             ─renders trialTemplate→ Trial ─creates→ training job (CS1)
+  metrics collector parses the chief log → observation → objective compare
+  → loop until maxTrialCount / goal; medianstop can kill laggards early.
+
+Differences from the reference are mechanical, not semantic: the
+suggestion service is the in-process gRPC server (same wire boundary),
+the trial job is any registered training kind, and observations live in
+sqlite instead of MySQL.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..api import katib as K
+from ..api.base import Resource, from_manifest, utcnow
+from ..core.controller import Controller, Result
+from ..core.store import AlreadyExists, Conflict, NotFound, ResourceStore
+from ..hpo.collector import ObservationStore, parse_metrics_text, summarize
+from ..hpo.service import SuggestionClient, shared_suggestion_address
+from ..runtime.gang import GangManager
+
+EXPERIMENT_LABEL = "katib.kubeflow.org/experiment"
+
+_TRAINING_KINDS = ("JAXJob", "TFJob", "PyTorchJob", "MPIJob")
+
+
+def render_trial_spec(template: Dict[str, Any],
+                      trial_parameters: List[Dict[str, str]],
+                      assignments: Dict[str, str]) -> Dict[str, Any]:
+    """Substitute ${trialParameters.<name>} through the trialSpec manifest
+    (Katib's trial rendering contract)."""
+    by_name = {}
+    for tp in trial_parameters:
+        ref = tp.get("reference", tp["name"])
+        if ref in assignments:
+            by_name[tp["name"]] = assignments[ref]
+
+    def subst(node):
+        if isinstance(node, str):
+            def repl(m):
+                key = m.group(1)
+                if key not in by_name:
+                    raise KeyError(
+                        f"trialSpec references ${{trialParameters.{key}}} "
+                        f"but no assignment provides it")
+                return by_name[key]
+
+            return re.sub(r"\$\{trialParameters\.([\w.\-]+)\}", repl, node)
+        if isinstance(node, dict):
+            return {k: subst(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [subst(v) for v in node]
+        return node
+
+    return subst(copy.deepcopy(template))
+
+
+class TrialController(Controller):
+    """Trial → underlying training job → observation."""
+
+    KIND = "Trial"
+    OWNS = list(_TRAINING_KINDS)
+    RESYNC_PERIOD = 2.0
+
+    def __init__(self, store: ResourceStore, gangs: GangManager,
+                 observations: ObservationStore):
+        super().__init__(store)
+        self.gangs = gangs
+        self.observations = observations
+        # trial key -> (log byte offset, last objective value) for the
+        # incremental early-stopping tail.
+        self._live_tail: Dict[str, Any] = {}
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _owned_by_trial(job: Resource, trial: K.Trial) -> bool:
+        return any(ref.get("kind") == "Trial"
+                   and ref.get("name") == trial.name
+                   for ref in job.metadata.owner_references)
+
+    def _job_for(self, trial: K.Trial) -> Optional[Resource]:
+        """The trial's job — only if actually owned by it (a pre-existing
+        unrelated job sharing the name must never be adopted/deleted)."""
+        kind = trial.run_spec().get("kind")
+        if not kind:
+            return None
+        job = self.store.try_get(kind, trial.name, trial.namespace)
+        if job is not None and not self._owned_by_trial(job, trial):
+            return None
+        return job
+
+    def _chief_log(self, job) -> str:
+        gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
+        rid = f"{job.chief_replica_type().lower()}-0"
+        gang = self.gangs.get(gkey)
+        path = gang.log_path(rid) if gang is not None else os.path.join(
+            self.gangs.workdir_for(gkey), "logs", f"{rid}.log")
+        if not os.path.exists(path):
+            return ""
+        with open(path, "r", errors="replace") as f:
+            return f.read()
+
+    def on_delete(self, obj: Resource) -> None:
+        assert isinstance(obj, K.Trial)
+        kind = (obj.spec.get("runSpec") or {}).get("kind")
+        if not kind:
+            return
+        job = self.store.try_get(kind, obj.name, obj.namespace)
+        if job is not None and self._owned_by_trial(job, obj):
+            try:
+                self.store.delete(kind, obj.name, obj.namespace)
+            except NotFound:
+                pass
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, key: str) -> Optional[Result]:
+        trial = self.get_resource(key)
+        if trial is None:
+            return None
+        assert isinstance(trial, K.Trial)
+        if trial.has_condition(K.TRIAL_SUCCEEDED) or \
+                trial.has_condition(K.TRIAL_FAILED) or \
+                trial.has_condition(K.TRIAL_EARLY_STOPPED):
+            return None
+
+        job = self._job_for(trial)
+        if job is None:
+            run_spec = copy.deepcopy(trial.run_spec())
+            meta = run_spec.setdefault("metadata", {})
+            meta["name"] = trial.name
+            meta["namespace"] = trial.namespace
+            meta.setdefault("labels", {})[EXPERIMENT_LABEL] = \
+                trial.metadata.labels.get(EXPERIMENT_LABEL, "")
+            meta["ownerReferences"] = [{"kind": "Trial", "name": trial.name}]
+            child = from_manifest(run_spec)
+            child.validate()
+            try:
+                self.store.create(child)
+            except AlreadyExists:
+                # Name collision with a job this trial does NOT own: fail
+                # the trial rather than adopt (or later delete) it.
+                self._write_status(trial.key, None, [
+                    (K.TRIAL_FAILED, "True", "JobNameConflict"),
+                    (K.TRIAL_RUNNING, "False", "JobNameConflict")])
+                self.record_event(
+                    trial, "Warning", "JobNameConflict",
+                    f"unrelated {run_spec.get('kind')} named {trial.name} "
+                    f"already exists")
+                return None
+            self._set_cond(trial, K.TRIAL_RUNNING, "True", "JobCreated")
+            self.record_event(trial, "Normal", "JobCreated",
+                              f"{run_spec.get('kind')} {trial.name} created")
+            return None
+
+        if not job.is_finished():
+            return None
+
+        # Job finished: collect metrics from the chief log.
+        metric_names = [trial.objective_metric()] + list(
+            (trial.spec.get("objective") or {}).get(
+                "additionalMetricNames") or [])
+        metric_names = [m for m in metric_names if m]
+        text = self._chief_log(job)
+        observations = parse_metrics_text(text, metric_names)
+        self.observations.report(trial.key, observations)
+        summary = summarize(observations)
+        observation = {"metrics": [
+            {"name": name, **vals} for name, vals in summary.items()]}
+
+        if job.has_condition("Succeeded"):
+            if trial.objective_metric() and \
+                    trial.objective_metric() not in summary:
+                conds = [(K.TRIAL_METRICS_UNAVAILABLE, "True",
+                          "NoObjectiveInLog"),
+                         (K.TRIAL_FAILED, "True", "MetricsUnavailable")]
+            else:
+                conds = [(K.TRIAL_SUCCEEDED, "True", "JobSucceeded")]
+        else:
+            conds = [(K.TRIAL_FAILED, "True", "JobFailed")]
+        conds.append((K.TRIAL_RUNNING, "False", "JobFinished"))
+        self._write_status(trial.key, observation, conds)
+        return None
+
+    def _set_cond(self, trial: K.Trial, ctype: str, status: str,
+                  reason: str) -> None:
+        self._write_status(trial.key, None, [(ctype, status, reason)])
+
+    def _write_status(self, key: str, observation, conds) -> None:
+        """One read-modify-write for any number of conditions — partial
+        writes must never clobber each other's conditions."""
+        fresh = self.get_resource(key)
+        if fresh is None:
+            return
+        if observation is not None:
+            fresh.status["observation"] = observation
+        for ctype, status, reason in conds:
+            fresh.set_condition(ctype, status, reason, "")
+        try:
+            self.store.update_status(fresh)
+        except (Conflict, NotFound):
+            self.queue.add(key)
+
+    # early stopping hook (called by the experiment controller)
+    def live_objective(self, trial: K.Trial, metric: str) -> Optional[float]:
+        """Latest objective value from the live chief log, read
+        incrementally (byte offset remembered per trial) so frequent
+        early-stopping checks don't rescan growing logs."""
+        job = self._job_for(trial)
+        if job is None:
+            return None
+        gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
+        rid = f"{job.chief_replica_type().lower()}-0"
+        gang = self.gangs.get(gkey)
+        path = gang.log_path(rid) if gang is not None else os.path.join(
+            self.gangs.workdir_for(gkey), "logs", f"{rid}.log")
+        offset, last = self._live_tail.get(trial.key, (0, None))
+        if not os.path.exists(path):
+            return last
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        if data:
+            obs = parse_metrics_text(data.decode(errors="replace"), [metric])
+            if obs:
+                last = obs[-1]["value"]
+            self._live_tail[trial.key] = (offset + len(data), last)
+        return last
+
+    def stop_early(self, trial: K.Trial) -> None:
+        kind = trial.run_spec().get("kind")
+        if kind and self._job_for(trial) is not None:  # only if owned
+            try:
+                self.store.delete(kind, trial.name, trial.namespace)
+            except NotFound:
+                pass
+        fresh = self.get_resource(trial.key)
+        if fresh is None:
+            return
+        fresh.set_condition(K.TRIAL_EARLY_STOPPED, "True", "MedianStop", "")
+        fresh.set_condition(K.TRIAL_RUNNING, "False", "EarlyStopped", "")
+        try:
+            self.store.update_status(fresh)
+        except (Conflict, NotFound):
+            self.queue.add(trial.key)
+
+
+class ExperimentController(Controller):
+    KIND = "Experiment"
+    OWNS = ["Trial"]
+    RESYNC_PERIOD = 1.0
+
+    # Consecutive suggestion-call failures before the experiment fails
+    # (Katib marks experiments with broken algorithms Failed, not Running).
+    MAX_SUGGESTION_FAILURES = 3
+
+    def __init__(self, store: ResourceStore, trial_ctrl: TrialController,
+                 suggestion_address: Optional[str] = None):
+        super().__init__(store)
+        self.trial_ctrl = trial_ctrl
+        self._addr = suggestion_address
+        self._client: Optional[SuggestionClient] = None
+        self._lock = threading.Lock()
+        self._suggestion_failures: Dict[str, int] = {}
+        self._exhausted: set = set()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+
+    def _suggestions(self) -> SuggestionClient:
+        with self._lock:
+            if self._client is None:
+                self._client = SuggestionClient(
+                    self._addr or shared_suggestion_address())
+            return self._client
+
+    def on_delete(self, obj: Resource) -> None:
+        for trial in self.store.list(
+                "Trial", obj.namespace,
+                label_selector={EXPERIMENT_LABEL: obj.name}):
+            try:
+                self.store.delete("Trial", trial.name, trial.namespace)
+            except NotFound:
+                pass
+        try:
+            self.store.delete("Suggestion", obj.name, obj.namespace)
+        except NotFound:
+            pass
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, key: str) -> Optional[Result]:
+        exp = self.get_resource(key)
+        if exp is None:
+            return None
+        assert isinstance(exp, K.Experiment)
+        if exp.has_condition(K.EXP_SUCCEEDED) or \
+                exp.has_condition(K.EXP_FAILED):
+            return None
+
+        self._ensure_suggestion_resource(exp)
+        trials = self.store.list(
+            "Trial", exp.namespace,
+            label_selector={EXPERIMENT_LABEL: exp.name})
+        finished = [t for t in trials if _trial_finished(t)]
+        running = [t for t in trials if not _trial_finished(t)]
+        succeeded = [t for t in trials
+                     if t.has_condition(K.TRIAL_SUCCEEDED)]
+        failed = [t for t in trials if t.has_condition(K.TRIAL_FAILED)]
+        early = [t for t in trials
+                 if t.has_condition(K.TRIAL_EARLY_STOPPED)]
+
+        best = self._best(exp, succeeded)
+        self._update_exp_status(exp, trials, running, succeeded, failed,
+                                early, best)
+
+        # Terminal checks.
+        goal = exp.objective_goal()
+        if best is not None and goal is not None and \
+                _reaches_goal(exp, best[1], goal):
+            self._finish(exp, K.EXP_GOAL_REACHED, K.EXP_SUCCEEDED,
+                         f"goal {goal} reached by {best[0]}")
+            return None
+        if len(failed) >= exp.max_failed_trial_count():
+            self._finish(exp, K.EXP_FAILED, K.EXP_FAILED,
+                         f"{len(failed)} trials failed")
+            return None
+        if len(trials) >= exp.max_trial_count() and not running:
+            self._finish(exp, K.EXP_SUCCEEDED, K.EXP_SUCCEEDED,
+                         "max trials completed")
+            return None
+        if exp.key in self._exhausted and not running and trials:
+            # The algorithm has nothing left (e.g. grid fully enumerated)
+            # and every spawned trial finished.
+            self._finish(exp, K.EXP_SUCCEEDED, K.EXP_SUCCEEDED,
+                         f"search space exhausted after {len(trials)} trials")
+            return None
+        if self._suggestion_failures.get(exp.key, 0) >= \
+                self.MAX_SUGGESTION_FAILURES:
+            self._finish(exp, K.EXP_FAILED, K.EXP_FAILED,
+                         "suggestion service failed repeatedly "
+                         f"(algorithm {exp.algorithm_name()!r})")
+            return None
+
+        self._maybe_early_stop(exp, running, succeeded)
+
+        want = min(exp.parallel_trial_count() - len(running),
+                   exp.max_trial_count() - len(trials))
+        if want > 0:
+            self._spawn_trials(exp, trials, want)
+        return Result(requeue=True, requeue_after=0.5)
+
+    # -- pieces -------------------------------------------------------------
+    def _ensure_suggestion_resource(self, exp: K.Experiment) -> None:
+        if self.store.try_get("Suggestion", exp.name,
+                              exp.namespace) is not None:
+            return
+        sug = K.Suggestion(spec={
+            "algorithm": {"algorithmName": exp.algorithm_name()},
+            "requests": 0,
+        })
+        sug.metadata.name = exp.name
+        sug.metadata.namespace = exp.namespace
+        sug.metadata.labels[EXPERIMENT_LABEL] = exp.name
+        try:
+            self.store.create(sug)
+            self.record_event(exp, "Normal", "SuggestionCreated",
+                              f"algorithm {exp.algorithm_name()}")
+        except AlreadyExists:
+            pass
+
+    def _history(self, exp: K.Experiment,
+                 trials: List[Resource]) -> List[Dict[str, Any]]:
+        metric = exp.objective_metric()
+        hist = []
+        for t in trials:
+            assert isinstance(t, K.Trial)
+            hist.append({
+                "assignments": t.assignments_dict(),
+                "value": t.final_metric(metric),
+            })
+        return hist
+
+    def _spawn_trials(self, exp: K.Experiment, trials: List[Resource],
+                      want: int) -> None:
+        history = self._history(exp, trials)
+        try:
+            assignments = self._suggestions().get_suggestions(
+                exp.algorithm_name(), exp.parameters(), history, want,
+                objective_type=exp.objective_type(),
+                settings=exp.algorithm_settings())
+        except Exception as e:
+            n = self._suggestion_failures.get(exp.key, 0) + 1
+            self._suggestion_failures[exp.key] = n
+            self.record_event(exp, "Warning", "SuggestionFailed",
+                              f"attempt {n}: {e}")
+            return
+        self._suggestion_failures.pop(exp.key, None)
+        if not assignments:
+            # Algorithm has nothing left (e.g. grid fully enumerated):
+            # the terminal check completes the experiment once idle.
+            self._exhausted.add(exp.key)
+            return
+        self._exhausted.discard(exp.key)
+        existing = {t.name for t in trials}
+        idx = len(trials)
+        for a in assignments:
+            name = f"{exp.name}-{idx:04d}"
+            while name in existing:
+                idx += 1
+                name = f"{exp.name}-{idx:04d}"
+            idx += 1
+            run_spec = render_trial_spec(
+                exp.trial_template()["trialSpec"],
+                exp.trial_parameters(), a)
+            trial = K.Trial(spec={
+                "parameterAssignments": [
+                    {"name": k, "value": v} for k, v in a.items()],
+                "runSpec": run_spec,
+                "objective": exp.objective(),
+            })
+            trial.metadata.name = name
+            trial.metadata.namespace = exp.namespace
+            trial.metadata.labels[EXPERIMENT_LABEL] = exp.name
+            trial.metadata.owner_references = [
+                {"kind": "Experiment", "name": exp.name}]
+            try:
+                self.store.create(trial)
+            except AlreadyExists:
+                continue
+        self._bump_suggestion(exp, len(assignments), assignments)
+
+    def _bump_suggestion(self, exp: K.Experiment, n: int,
+                         assignments: List[Dict[str, str]]) -> None:
+        sug = self.store.try_get("Suggestion", exp.name, exp.namespace)
+        if sug is None:
+            return
+        sug.spec["requests"] = int(sug.spec.get("requests", 0)) + n
+        sug.status.setdefault("suggestions", []).extend(assignments)
+        try:
+            self.store.update(sug)
+        except (Conflict, NotFound):
+            pass
+
+    def _best(self, exp: K.Experiment, succeeded: List[Resource]):
+        metric = exp.objective_metric()
+        sign = 1.0 if exp.objective_type() == K.OBJECTIVE_MAXIMIZE else -1.0
+        best = None
+        for t in succeeded:
+            assert isinstance(t, K.Trial)
+            v = t.final_metric(metric)
+            if v is None:
+                continue
+            if best is None or sign * v > sign * best[1]:
+                best = (t.name, v, t.assignments_dict())
+        return best
+
+    def _maybe_early_stop(self, exp: K.Experiment, running: List[Resource],
+                          succeeded: List[Resource]) -> None:
+        es = exp.early_stopping()
+        if not es or \
+                (es.get("algorithmName") or "medianstop") != "medianstop":
+            return
+        settings = {s["name"]: s.get("value") for s in
+                    es.get("algorithmSettings") or []}
+        min_trials = int(settings.get("min_trials_required", 3))
+        if len(succeeded) < min_trials:
+            return
+        metric = exp.objective_metric()
+        sign = 1.0 if exp.objective_type() == K.OBJECTIVE_MAXIMIZE else -1.0
+        finals = sorted(sign * t.final_metric(metric) for t in succeeded
+                        if isinstance(t, K.Trial)
+                        and t.final_metric(metric) is not None)
+        if not finals:
+            return
+        median = finals[len(finals) // 2]
+        for t in running:
+            assert isinstance(t, K.Trial)
+            if not t.has_condition(K.TRIAL_RUNNING):
+                continue
+            live = self.trial_ctrl.live_objective(t, metric)
+            if live is not None and sign * live < median:
+                self.trial_ctrl.stop_early(t)
+                self.record_event(
+                    exp, "Normal", "TrialEarlyStopped",
+                    f"{t.name}: {metric}={live} below median")
+
+    def _update_exp_status(self, exp, trials, running, succeeded, failed,
+                           early, best) -> None:
+        fresh = self.get_resource(exp.key)
+        if fresh is None:
+            return
+        status = {
+            "trials": len(trials),
+            "trialsRunning": len(running),
+            "trialsSucceeded": len(succeeded),
+            "trialsFailed": len(failed),
+            "trialsEarlyStopped": len(early),
+        }
+        if best is not None:
+            status["currentOptimalTrial"] = {
+                "bestTrialName": best[0],
+                "observation": {"metrics": [
+                    {"name": exp.objective_metric(), "latest": best[1]}]},
+                "parameterAssignments": [
+                    {"name": k, "value": v} for k, v in best[2].items()],
+            }
+        changed = any(fresh.status.get(k) != v for k, v in status.items())
+        if not fresh.has_condition(K.EXP_RUNNING):
+            fresh.set_condition(K.EXP_RUNNING, "True", "ExperimentRunning",
+                                "")
+            changed = True
+        if changed:
+            fresh.status.update(status)
+            try:
+                self.store.update_status(fresh)
+            except (Conflict, NotFound):
+                self.queue.add(exp.key)
+
+    def _finish(self, exp: K.Experiment, cond: str, terminal: str,
+                message: str) -> None:
+        fresh = self.get_resource(exp.key)
+        if fresh is None:
+            return
+        fresh.set_condition(cond, "True", cond, message)
+        if terminal != cond:
+            fresh.set_condition(terminal, "True", cond, message)
+        fresh.set_condition(K.EXP_RUNNING, "False", cond, "")
+        fresh.status["completionTime"] = utcnow()
+        try:
+            self.store.update_status(fresh)
+        except (Conflict, NotFound):
+            self.queue.add(exp.key)
+        self.record_event(exp, "Normal", cond, message)
+
+
+def _trial_finished(t: Resource) -> bool:
+    return (t.has_condition(K.TRIAL_SUCCEEDED)
+            or t.has_condition(K.TRIAL_FAILED)
+            or t.has_condition(K.TRIAL_EARLY_STOPPED))
+
+
+def _reaches_goal(exp: K.Experiment, value: float, goal: float) -> bool:
+    if exp.objective_type() == K.OBJECTIVE_MAXIMIZE:
+        return value >= goal
+    return value <= goal
+
+
+def hpo_controllers(store: ResourceStore, gangs: GangManager = None,
+                    observations: Optional[ObservationStore] = None):
+    if gangs is None:
+        raise TypeError("hpo_controllers requires the gang manager")
+    obs = observations or ObservationStore()
+    trial = TrialController(store, gangs, obs)
+    exp = ExperimentController(store, trial)
+    return [trial, exp]
