@@ -69,6 +69,16 @@ def make_mesh(n_devices: Optional[int] = None, *, tp: Optional[int] = None,
     """
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            msg = (f"requested a {n_devices}-device mesh but only "
+                   f"{len(devs)} devices are "
+                   + ("in the given `devices` sequence" if devices is not None
+                      else f"visible on platform "
+                           f"{devs[0].platform if devs else '?'}; for a "
+                           f"virtual mesh set JAX_PLATFORMS=cpu and "
+                           f"XLA_FLAGS=--xla_force_host_platform_device_"
+                           f"count={n_devices} before the first jax import"))
+            raise ValueError(msg)
         devs = devs[:n_devices]
     n = len(devs)
     pp_, dp_, tp_ = _factor(n, tp, pp)

@@ -17,12 +17,9 @@ driver contract; the real TPU is exercised only by bench.py.
 import os
 import sys
 
-_WANT = {
-    "JAX_PLATFORMS": "cpu",
-    "PALLAS_AXON_POOL_IPS": "",
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-    "JAX_ENABLE_X64": "0",
-}
+from kubeflow_tpu.vmeshenv import virtual_mesh_env
+
+_WANT = virtual_mesh_env(8)
 
 if os.environ.get("KFX_TEST_REEXEC") != "1":
     os.environ.update(_WANT)

@@ -1,0 +1,19 @@
+"""The one recipe for a virtual n-device CPU mesh on this machine.
+
+The axon TPU sitecustomize imports jax at interpreter start and pins the
+platform, so these variables must be in the environment *before* the first
+jax import — consumers either re-exec (``testenv.py``) or spawn a
+subprocess (``__graft_entry__.dryrun_multichip``). Kept import-light (no
+jax, no package siblings) so both can use it safely.
+"""
+
+from typing import Dict
+
+
+def virtual_mesh_env(n_devices: int = 8) -> Dict[str, str]:
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_devices}",
+        "JAX_ENABLE_X64": "0",
+    }
