@@ -216,7 +216,18 @@ class InferenceServiceController(Controller):
                                   f"{rev_name} -> {model_dir}")
             want = int(spec.get("minReplicas", 1))
             if want == 0 and rt.cold_hit:
-                want = 1  # activator: scale from zero on traffic
+                # Activator: scale from zero on traffic — and back to zero
+                # once the router has seen no requests for the idle window
+                # (Knative KPA scale-down analogue). The idle clock only
+                # counts against a replica that reached readiness: killing
+                # one mid-load would flap forever under slow model loads.
+                idle_s = float(spec.get("scaleToZeroIdleSeconds", 60.0))
+                idle = time.monotonic() - rt.router.last_request_time
+                has_ready = any(r.ready for r in rev.replicas)
+                if idle_s > 0 and has_ready and idle >= idle_s:
+                    rt.cold_hit = False
+                else:
+                    want = 1
             rev.reap_and_respawn(want)
             ready = rev.probe()
             if ready < max(want, 1) and want > 0:

@@ -167,13 +167,20 @@ def main(argv=None) -> int:
             s += k
 
     def _prefetch():
-        for s, k in _plan_chunks():
-            if k <= 1:
-                prefetch_q.put((s, k, next(it)))
-            else:
-                batches = [next(it) for _ in range(k)]
-                prefetch_q.put((s, k, (np.stack([b[0] for b in batches]),
-                                       np.stack([b[1] for b in batches]))))
+        # Any failure is pushed through the queue and re-raised by the
+        # consumer — a dead prefetch thread must never leave the main
+        # loop blocked forever on an empty queue.
+        try:
+            for s, k in _plan_chunks():
+                if k <= 1:
+                    prefetch_q.put((s, k, next(it)))
+                else:
+                    batches = [next(it) for _ in range(k)]
+                    prefetch_q.put(
+                        (s, k, (np.stack([b[0] for b in batches]),
+                                np.stack([b[1] for b in batches]))))
+        except BaseException as e:
+            prefetch_q.put(e)
 
     _threading.Thread(target=_prefetch, daemon=True).start()
     while step < args.steps:
@@ -186,7 +193,10 @@ def main(argv=None) -> int:
             log(f"fault_injection_crash step={step}")
             sys.stdout.flush()
             os._exit(17)
-        s, k, (images, labels) = prefetch_q.get()
+        got = prefetch_q.get()
+        if isinstance(got, BaseException):
+            raise RuntimeError("input prefetch thread failed") from got
+        s, k, (images, labels) = got
         assert s == step, f"prefetch desync: {s} != {step}"
         if k <= 1:
             state, loss, acc = loop.train_step(state, images, labels)

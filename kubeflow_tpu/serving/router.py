@@ -14,8 +14,15 @@ import itertools
 import json
 import random
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
+
+# RFC 7230 §6.1: connection-scoped headers a proxy must not forward.
+_HOP_BY_HOP = frozenset({
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailers", "transfer-encoding",
+    "upgrade", "host", "content-length"})
 
 
 class BackendSet:
@@ -53,6 +60,9 @@ class Router:
         # Called when a request arrives and no replica is live
         # (scale-from-zero activator hook).
         self.on_cold_request: Optional[Callable[[], None]] = None
+        # Monotonic timestamp of the most recent request; the operator
+        # uses it to scale a minReplicas=0 revision back down after idle.
+        self.last_request_time: float = time.monotonic()
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -80,6 +90,7 @@ class Router:
         return backend
 
     def _proxy(self, h, has_body: bool) -> None:
+        self.last_request_time = time.monotonic()
         backend = self._pick_backend()
         if backend is None:
             if self.on_cold_request is not None:
@@ -102,13 +113,21 @@ class Router:
         host, _, port = backend.partition(":")
         conn = http.client.HTTPConnection(host, int(port), timeout=60)
         try:
-            conn.request(h.command, h.path, body=data or None,
-                         headers={"Content-Type": "application/json"})
+            fwd: Dict[str, str] = {}
+            for k, v in h.headers.items():
+                if k.lower() in _HOP_BY_HOP:
+                    continue
+                # RFC 7230 §3.2.2: repeated fields combine comma-joined.
+                fwd[k] = f"{fwd[k]}, {v}" if k in fwd else v
+            conn.request(h.command, h.path, body=data or None, headers=fwd)
             resp = conn.getresponse()
             payload = resp.read()
             h.send_response(resp.status)
-            h.send_header("Content-Type",
-                          resp.getheader("Content-Type", "application/json"))
+            # send_response() already emitted Server/Date; don't duplicate.
+            skip = _HOP_BY_HOP | {"content-length", "server", "date"}
+            for k, v in resp.getheaders():
+                if k.lower() not in skip:
+                    h.send_header(k, v)
             h.send_header("Content-Length", str(len(payload)))
             h.end_headers()
             h.wfile.write(payload)

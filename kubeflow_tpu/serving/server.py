@@ -41,7 +41,8 @@ class Predictor:
     def load(self) -> None:
         raise NotImplementedError
 
-    def predict(self, instances: np.ndarray) -> Dict[str, Any]:
+    def predict(self, instances: np.ndarray,
+                probabilities: bool = False) -> Dict[str, Any]:
         raise NotImplementedError
 
 
@@ -53,7 +54,7 @@ class JaxPredictor(Predictor):
         self.model_dir = model_dir
         self.name = name or "model"
         self.max_batch_size = max_batch_size
-        self._predict_fn = None
+        self._compiled: Dict[int, Any] = {}
         self._buckets: List[int] = []
 
     def load(self) -> None:
@@ -79,16 +80,23 @@ class JaxPredictor(Predictor):
             probs = jax.nn.softmax(logits, -1)
             return logits.argmax(-1), probs
 
-        self._predict_fn = jax.jit(fn)
-        # Pre-warm every bucket: first-request latency == steady-state.
+        # AOT-compile every bucket (jit().lower().compile()): no request
+        # ever pays a compile AND dispatch skips the jit signature-matching
+        # cache lookup. A non-power-of-two max_batch_size is its own bucket
+        # so oversized requests chunked by it still hit a compiled shape.
         self._buckets = []
         b = 1
         while b <= self.max_batch_size:
             self._buckets.append(b)
             b *= 2
+        if self._buckets[-1] != self.max_batch_size:
+            self._buckets.append(self.max_batch_size)
+        self._compiled = {}
         for b in self._buckets:
-            dummy = jnp.zeros((b,) + self.input_shape, jnp.float32)
-            cls, probs = self._predict_fn(dummy)
+            spec = jax.ShapeDtypeStruct((b,) + self.input_shape, jnp.float32)
+            self._compiled[b] = jax.jit(fn).lower(spec).compile()
+            cls, probs = self._compiled[b](
+                np.zeros((b,) + self.input_shape, np.float32))
             jax.block_until_ready((cls, probs))
         self.ready = True
 
@@ -98,11 +106,12 @@ class JaxPredictor(Predictor):
                 return b
         return self._buckets[-1]
 
-    def predict(self, instances: np.ndarray) -> Dict[str, Any]:
+    def predict(self, instances: np.ndarray,
+                probabilities: bool = False) -> Dict[str, Any]:
         import jax
 
-        predictions: List[Any] = []
-        probabilities: List[Any] = []
+        preds: List[Any] = []
+        probs_out: List[Any] = []
         # Oversized requests run as several max-bucket dispatches; the
         # tail pads up to its bucket (always static shapes).
         for start in range(0, instances.shape[0], self.max_batch_size):
@@ -112,11 +121,21 @@ class JaxPredictor(Predictor):
             if n < b:
                 pad = np.zeros((b - n,) + chunk.shape[1:], chunk.dtype)
                 chunk = np.concatenate([chunk, pad], 0)
-            cls, probs = self._predict_fn(chunk)
-            cls, probs = jax.device_get((cls, probs))
-            predictions.extend(cls[:n].tolist())
-            probabilities.extend(p.tolist() for p in probs[:n])
-        return {"predictions": predictions, "probabilities": probabilities}
+            cls, probs = self._compiled[b](chunk)
+            # Only transfer what the response needs: probabilities are
+            # opt-in (V1 protocol requires just "predictions", and the
+            # device->host copy of a [B, classes] float tensor dominated
+            # the old response path).
+            if probabilities:
+                cls, probs = jax.device_get((cls, probs))
+                probs_out.extend(p.tolist() for p in probs[:n])
+            else:
+                cls = jax.device_get(cls)
+            preds.extend(cls[:n].tolist())
+        out: Dict[str, Any] = {"predictions": preds}
+        if probabilities:
+            out["probabilities"] = probs_out
+        return out
 
 
 class MicroBatcher:
@@ -126,11 +145,13 @@ class MicroBatcher:
     or the oldest has waited maxLatencyMs."""
 
     def __init__(self, predictor: Predictor, max_batch_size: int = 32,
-                 max_latency_ms: float = 2.0):
+                 max_latency_ms: float = 2.0, reply_timeout_s: float = 60.0):
         self.predictor = predictor
         self.max_batch_size = max_batch_size
         self.max_latency_s = max_latency_ms / 1000.0
-        self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue]]" = queue.Queue()
+        self.reply_timeout_s = reply_timeout_s
+        self._q: "queue.Queue[Tuple[np.ndarray, bool, queue.Queue]]" = \
+            queue.Queue()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="kfx-batcher")
         self._stop = threading.Event()
@@ -155,28 +176,44 @@ class MicroBatcher:
                     break
                 batch.append(item)
                 count += item[0].shape[0]
-            arrays = [b[0] for b in batch]
-            stacked = np.concatenate(arrays, 0)
+            # The whole per-batch body is inside the try: a bad request
+            # (e.g. mismatched instance shapes failing the concatenate)
+            # must reply an error to every caller in the batch, never kill
+            # the batcher thread.
             try:
-                result = self.predictor.predict(stacked)
+                want_probs = any(b[1] for b in batch)
+                stacked = np.concatenate([b[0] for b in batch], 0)
+                result = self.predictor.predict(stacked,
+                                                probabilities=want_probs)
                 preds = result["predictions"]
                 probs = result.get("probabilities")
                 off = 0
-                for arr, reply in batch:
+                for arr, wp, reply in batch:
                     n = arr.shape[0]
                     out = {"predictions": preds[off:off + n]}
-                    if probs is not None:
+                    if wp and probs is not None:
                         out["probabilities"] = probs[off:off + n]
                     reply.put(out)
                     off += n
             except Exception as e:  # propagate per-request
-                for _, reply in batch:
+                for _, _, reply in batch:
                     reply.put(e)
 
-    def predict(self, instances: np.ndarray) -> Dict[str, Any]:
+    def predict(self, instances: np.ndarray,
+                probabilities: bool = False) -> Dict[str, Any]:
+        # Shape mismatches fail fast here instead of poisoning a batch.
+        want = getattr(self.predictor, "input_shape", None)
+        if want is not None and tuple(instances.shape[1:]) != tuple(want):
+            raise ValueError(
+                f"instance shape {tuple(instances.shape[1:])} does not "
+                f"match model input {tuple(want)}")
         reply: "queue.Queue" = queue.Queue()
-        self._q.put((instances, reply))
-        out = reply.get()
+        self._q.put((instances, probabilities, reply))
+        try:
+            out = reply.get(timeout=self.reply_timeout_s)
+        except queue.Empty:
+            raise TimeoutError(
+                f"batcher did not reply within {self.reply_timeout_s}s")
         if isinstance(out, Exception):
             raise out
         return out
@@ -266,6 +303,7 @@ class ModelServer:
             length = int(h.headers.get("Content-Length", 0))
             body = json.loads(h.rfile.read(length) or b"{}")
             instances = np.asarray(body["instances"], np.float32)
+            want_probs = bool(body.get("probabilities", False))
         except (ValueError, KeyError) as e:
             h._send(400, {"error": f"bad request: {e}"})
             return
@@ -273,7 +311,8 @@ class ModelServer:
             self.request_count += 1
         try:
             batcher = self.batchers.get(name)
-            result = (batcher or p).predict(instances)
+            result = (batcher or p).predict(instances,
+                                            probabilities=want_probs)
         except Exception as e:
             h._send(500, {"error": str(e)})
             return

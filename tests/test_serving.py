@@ -81,6 +81,12 @@ class TestModelServer:
         assert preds.shape == (32,)
         # trained model beats chance comfortably
         assert (preds == labels).mean() > 0.5
+        # probabilities are opt-in (V1 response carries predictions only)
+        assert "probabilities" not in body
+        status, body = _post(f"{base}/v1/models/mnist:predict",
+                             {"instances": images.tolist(),
+                              "probabilities": True})
+        assert status == 200
         assert len(body["probabilities"][0]) == ds.num_classes
 
     def test_bucket_padding_odd_batch(self, server):
@@ -111,9 +117,9 @@ class TestMicroBatcher:
         calls = []
         orig = predictor.predict
 
-        def spy(instances):
+        def spy(instances, probabilities=False):
             calls.append(instances.shape[0])
-            return orig(instances)
+            return orig(instances, probabilities=probabilities)
 
         predictor.predict = spy
         batcher = MicroBatcher(predictor, max_batch_size=32,
@@ -136,6 +142,32 @@ class TestMicroBatcher:
         assert len(calls) < 8
         assert sum(calls) == 8
 
+    def test_bad_shape_does_not_kill_batcher(self, export_dir):
+        """A request with a mismatched instance shape errors out cleanly
+        and the batcher keeps serving subsequent requests."""
+        from kubeflow_tpu.serving.server import JaxPredictor, MicroBatcher
+
+        predictor = JaxPredictor(export_dir, name="m", max_batch_size=8)
+        predictor.load()
+        batcher = MicroBatcher(predictor, max_batch_size=8,
+                               max_latency_ms=1.0, reply_timeout_s=10.0)
+        try:
+            with pytest.raises(ValueError):
+                batcher.predict(np.zeros((1, 7, 7, 1), np.float32))
+            out = batcher.predict(np.zeros((2, 28, 28, 1), np.float32))
+            assert len(out["predictions"]) == 2
+        finally:
+            batcher.close()
+
+    def test_non_pow2_max_batch_is_a_bucket(self, export_dir):
+        from kubeflow_tpu.serving.server import JaxPredictor
+
+        p = JaxPredictor(export_dir, name="m", max_batch_size=48)
+        p.load()
+        assert 48 in p._buckets
+        out = p.predict(np.zeros((48, 28, 28, 1), np.float32))
+        assert len(out["predictions"]) == 48
+
 
 class TestRouter:
     def test_canary_split_and_cold(self):
@@ -151,7 +183,7 @@ class TestRouter:
             def load(self):
                 pass
 
-            def predict(self, instances):
+            def predict(self, instances, probabilities=False):
                 return {"predictions": [self.tag] * instances.shape[0]}
 
         s1 = ModelServer(port=0)
@@ -182,6 +214,51 @@ class TestRouter:
             router.stop()
             s1.stop()
             s2.stop()
+
+    def test_forwards_headers(self):
+        """The proxy passes client request headers to the backend and
+        mirrors backend response headers (minus hop-by-hop)."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from kubeflow_tpu.serving.router import Router
+
+        seen = {}
+
+        class Backend(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                seen.update(self.headers.items())
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("X-Model-Revision", "rev-7")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        backend = HTTPServer(("127.0.0.1", 0), Backend)
+        threading.Thread(target=backend.serve_forever, daemon=True).start()
+        router = Router().start()
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{backend.server_port}"])
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/v1/models/m",
+                headers={"Authorization": "Bearer tok",
+                         "X-Custom": "yes"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Model-Revision"] == "rev-7"
+            assert seen.get("Authorization") == "Bearer tok"
+            assert seen.get("X-Custom") == "yes"
+        finally:
+            router.stop()
+            backend.shutdown()
 
 
 @pytest.mark.slow
@@ -230,3 +307,57 @@ spec:
             status, _ = _post(f"{url}/v1/models/mnist:predict",
                               {"instances": x.tolist()}, timeout=60)
             assert status == 200
+
+    def test_scale_to_zero_round_trip(self, export_dir, tmp_path):
+        """minReplicas=0: cold request scales 0->1, idle scales 1->0."""
+        import time
+
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: ztest
+spec:
+  predictor:
+    minReplicas: 0
+    scaleToZeroIdleSeconds: 2
+    jax:
+      storageUri: file://{export_dir}
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            deadline = time.monotonic() + 60
+            url = None
+            while time.monotonic() < deadline and url is None:
+                cur = cp.store.get("InferenceService", "ztest")
+                url = cur.status.get("url")
+                time.sleep(0.1)
+            assert url, "router url never published"
+            x = np.zeros((1, 28, 28, 1), np.float32)
+
+            # Cold requests 503 until the activator has spawned a replica.
+            deadline = time.monotonic() + 120
+            status = None
+            while time.monotonic() < deadline:
+                try:
+                    status, body = _post(f"{url}/v1/models/ztest:predict",
+                                         {"instances": x.tolist()},
+                                         timeout=30)
+                    break
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    time.sleep(0.5)
+            assert status == 200 and len(body["predictions"]) == 1
+
+            # After the idle window the revision must drop back to zero.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                cur = cp.store.get("InferenceService", "ztest")
+                if cur.status.get("readyReplicas", {}).get("default") == 0:
+                    break
+                time.sleep(0.3)
+            else:
+                raise AssertionError("never scaled back to zero")
