@@ -67,7 +67,9 @@ class _Revision:
         if self.batcher:
             argv += [f"--max-batch-size={self.batcher.get('maxBatchSize', 32)}",
                      "--batcher-max-latency-ms="
-                     f"{self.batcher.get('maxLatencyMs', 2.0)}"]
+                     f"{self.batcher.get('maxLatencyMs', 2.0)}",
+                     "--batcher-reply-timeout-s="
+                     f"{self.batcher.get('replyTimeoutS', 60.0)}"]
         os.makedirs(self.workdir, exist_ok=True)
         env = inject_pythonpath(dict(os.environ))
         logf = open(os.path.join(
@@ -226,6 +228,11 @@ class InferenceServiceController(Controller):
                 has_ready = any(r.ready for r in rev.replicas)
                 if idle_s > 0 and has_ready and idle >= idle_s:
                     rt.cold_hit = False
+                    # Remove the revision from the router BEFORE killing
+                    # its replicas: a request racing the scale-down must
+                    # take the cold 503+activator path, not hit a dead
+                    # backend.
+                    getattr(rt.router, rev_name).set_endpoints([])
                 else:
                     want = 1
             rev.reap_and_respawn(want)

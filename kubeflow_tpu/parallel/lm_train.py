@@ -189,6 +189,25 @@ class LMTrainLoop:
                                                 self.global_batch(tokens))
         return state, float(loss), float(acc)
 
+    def train_many(self, state: LMTrainState, batches
+                   ) -> Tuple[LMTrainState, float, float]:
+        """Run a sequence of token batches with ONE host sync at the end.
+
+        train_step() syncs (device_get) per step, which on a remote /
+        tunneled device stalls the pipeline for a full round trip each
+        step; here all steps are dispatched back-to-back and only the
+        final loss is fetched."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        loss = acc = None
+        with jax.set_mesh(self.mesh):
+            for tokens in batches:
+                state, loss, acc = self._train_step(
+                    state, self.global_batch(tokens))
+            if loss is None:
+                raise ValueError("train_many needs at least one batch")
+        return state, float(loss), float(acc)
+
     def evaluate(self, state: LMTrainState, tokens: np.ndarray
                  ) -> Dict[str, float]:
         if self._eval_step is None:

@@ -264,7 +264,8 @@ class ModelServer:
             self.batchers[predictor.name] = MicroBatcher(
                 predictor,
                 max_batch_size=int(batcher.get("maxBatchSize", 32)),
-                max_latency_ms=float(batcher.get("maxLatencyMs", 2.0)))
+                max_latency_ms=float(batcher.get("maxLatencyMs", 2.0)),
+                reply_timeout_s=float(batcher.get("replyTimeoutS", 60.0)))
 
     # -- request handling ---------------------------------------------------
     def _handle_get(self, h) -> None:
@@ -341,6 +342,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-batch-size", type=int, default=64)
     p.add_argument("--batcher-max-latency-ms", type=float, default=0.0,
                    help=">0 enables the micro-batcher")
+    p.add_argument("--batcher-reply-timeout-s", type=float, default=60.0)
     args = p.parse_args(argv)
 
     predictor = JaxPredictor(args.model_dir, name=args.name,
@@ -351,7 +353,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     batcher = None
     if args.batcher_max_latency_ms > 0:
         batcher = {"maxBatchSize": args.max_batch_size,
-                   "maxLatencyMs": args.batcher_max_latency_ms}
+                   "maxLatencyMs": args.batcher_max_latency_ms,
+                   "replyTimeoutS": args.batcher_reply_timeout_s}
     server.register(predictor, batcher)
     server.start()
     print(f"server_ready name={args.name} port={server.port} "
