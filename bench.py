@@ -172,7 +172,6 @@ def _bench_serving_p50(n_requests: int = 200) -> dict:
 
         import json as _json
         import tempfile
-        import urllib.request
 
         ds = get_dataset("cifar10")
         model = get_model("resnet18", num_classes=ds.num_classes)
@@ -187,21 +186,33 @@ def _bench_serving_p50(n_requests: int = 200) -> dict:
         server.start()
         x = np.zeros((1,) + ds.shape, np.float32).tolist()
         payload = _json.dumps({"instances": x}).encode()
-        url = f"http://127.0.0.1:{server.port}/v1/models/resnet:predict"
+        # Persistent HTTP/1.1 connection: measure the request, not TCP
+        # handshakes.
+        import http.client
+        import socket
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.connect()
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        path = "/v1/models/resnet:predict"
         lat = []
         for _ in range(n_requests):
             t = time.perf_counter()
-            req = urllib.request.Request(
-                url, data=payload,
-                headers={"Content-Type": "application/json"})
-            urllib.request.urlopen(req, timeout=30).read()
+            conn.request("POST", path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
             lat.append((time.perf_counter() - t) * 1000)
+        conn.close()
         server.stop()
         lat.sort()
         return {
             "serving_p50_ms": round(lat[len(lat) // 2], 2),
             "serving_p99_ms": round(lat[int(len(lat) * 0.99)], 2),
             "serving_model": "resnet18-cifar10",
+            "serving_placement": {str(k): v
+                                  for k, v in predictor.placement.items()},
+            "serving_probe_ms": predictor.probe_ms,
         }
     except Exception as e:  # secondary metric must not sink the bench
         return {"serving_error": str(e)[:200]}

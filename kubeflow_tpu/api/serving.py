@@ -116,3 +116,11 @@ class InferenceService(Resource):
         if self.min_replicas() < 0 or self.max_replicas() < self.min_replicas():
             raise ValidationError("spec.predictor.minReplicas/maxReplicas",
                                   "0 <= min <= max required")
+        for rev in ("predictor", "canary"):
+            spec = self.spec.get(rev)
+            if spec is not None:
+                dev = str(spec.get("device", "auto"))
+                if dev not in ("auto", "default", "cpu"):
+                    raise ValidationError(
+                        f"spec.{rev}.device",
+                        f"{dev!r} not one of auto/default/cpu")

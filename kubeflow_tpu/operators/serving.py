@@ -50,12 +50,14 @@ class _Revision:
     """Supervised replica set for one revision of one InferenceService."""
 
     def __init__(self, name: str, model_name: str, model_dir: str,
-                 workdir: str, batcher: Optional[dict]):
+                 workdir: str, batcher: Optional[dict],
+                 device: str = "auto"):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
         self.workdir = workdir
         self.batcher = batcher
+        self.device = device
         self.replicas: List[_Replica] = []
         self.restarts = 0
 
@@ -63,7 +65,7 @@ class _Revision:
         port = free_port()
         argv = [sys.executable, "-m", "kubeflow_tpu.serving.server",
                 f"--model-dir={self.model_dir}", f"--name={self.model_name}",
-                f"--port={port}"]
+                f"--port={port}", f"--device={self.device}"]
         if self.batcher:
             argv += [f"--max-batch-size={self.batcher.get('maxBatchSize', 32)}",
                      "--batcher-max-latency-ms="
@@ -130,7 +132,10 @@ class _IsvcRuntime:
     def __init__(self):
         self.router: Optional[Router] = None
         self.revisions: Dict[str, _Revision] = {}
-        self.cold_hit = False
+        # A cold request arrived while no replica was live; resolved to a
+        # per-revision flag at the next reconcile.
+        self.cold_pending = False
+        self.cold_hit: Dict[str, bool] = {}
 
 
 class InferenceServiceController(Controller):
@@ -185,12 +190,27 @@ class InferenceServiceController(Controller):
                 with ctrl._lock:
                     r = ctrl._runtimes.get(k)
                 if r is not None:
-                    r.cold_hit = True
+                    r.cold_pending = True
                 ctrl.queue.add(k)
 
             rt.router.on_cold_request = cold
             self.record_event(isvc, "Normal", "RouterStarted",
                               f"router on 127.0.0.1:{rt.router.port}")
+
+        # Resolve a pending cold request to the first minReplicas=0
+        # revision that exists (the set the router would route to).
+        if rt.cold_pending:
+            for rev_name in ("default", "canary"):
+                spec = isvc.revision_spec(rev_name)
+                if spec is not None and int(spec.get("minReplicas", 1)) == 0:
+                    rt.cold_hit[rev_name] = True
+                    # The cold request counts as this revision's traffic;
+                    # otherwise a slow model load could out-idle the
+                    # scale-down window before the first request lands.
+                    getattr(rt.router, rev_name).last_request_time = \
+                        time.monotonic()
+                    break
+            rt.cold_pending = False
 
         all_ready = True
         for rev_name in ("default", "canary"):
@@ -212,27 +232,31 @@ class InferenceServiceController(Controller):
                     workdir=os.path.join(self.home, "serving",
                                          key.replace("/", "_")),
                     batcher=spec.get("batcher"),
+                    device=str(spec.get("device", "auto")),
                 )
                 rt.revisions[rev_name] = rev
                 self.record_event(isvc, "Normal", "RevisionCreated",
                                   f"{rev_name} -> {model_dir}")
             want = int(spec.get("minReplicas", 1))
-            if want == 0 and rt.cold_hit:
+            if want == 0 and rt.cold_hit.get(rev_name):
                 # Activator: scale from zero on traffic — and back to zero
-                # once the router has seen no requests for the idle window
-                # (Knative KPA scale-down analogue). The idle clock only
-                # counts against a replica that reached readiness: killing
-                # one mid-load would flap forever under slow model loads.
+                # once THIS revision's backend set has been idle for the
+                # window (Knative KPA scale-down analogue; router-wide
+                # traffic must not keep an untrafficked revision alive).
+                # The idle clock only counts against a replica that
+                # reached readiness: killing one mid-load would flap
+                # forever under slow model loads.
+                backend_set = getattr(rt.router, rev_name)
                 idle_s = float(spec.get("scaleToZeroIdleSeconds", 60.0))
-                idle = time.monotonic() - rt.router.last_request_time
+                idle = time.monotonic() - backend_set.last_request_time
                 has_ready = any(r.ready for r in rev.replicas)
                 if idle_s > 0 and has_ready and idle >= idle_s:
-                    rt.cold_hit = False
+                    rt.cold_hit[rev_name] = False
                     # Remove the revision from the router BEFORE killing
                     # its replicas: a request racing the scale-down must
                     # take the cold 503+activator path, not hit a dead
                     # backend.
-                    getattr(rt.router, rev_name).set_endpoints([])
+                    backend_set.set_endpoints([])
                 else:
                     want = 1
             rev.reap_and_respawn(want)

@@ -182,12 +182,7 @@ class LMTrainLoop:
 
     def train_step(self, state: LMTrainState, tokens: np.ndarray
                    ) -> Tuple[LMTrainState, float, float]:
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
-        with jax.set_mesh(self.mesh):
-            state, loss, acc = self._train_step(state,
-                                                self.global_batch(tokens))
-        return state, float(loss), float(acc)
+        return self.train_many(state, [tokens])
 
     def train_many(self, state: LMTrainState, batches
                    ) -> Tuple[LMTrainState, float, float]:

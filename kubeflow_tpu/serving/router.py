@@ -13,10 +13,11 @@ import http.client
 import itertools
 import json
 import random
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 # RFC 7230 §6.1: connection-scoped headers a proxy must not forward.
 _HOP_BY_HOP = frozenset({
@@ -32,6 +33,9 @@ class BackendSet:
         self._lock = threading.Lock()
         self._endpoints = list(endpoints or [])
         self._rr = itertools.count()
+        # Stamped by the Router when this set serves a request; drives
+        # per-revision scale-to-zero idle accounting.
+        self.last_request_time: float = time.monotonic()
 
     def set_endpoints(self, endpoints: List[str]) -> None:
         with self._lock:
@@ -67,6 +71,7 @@ class Router:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):
                 pass
@@ -81,17 +86,22 @@ class Router:
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
-    def _pick_backend(self) -> Optional[str]:
+    def _pick_backend(self) -> Tuple[Optional[str], Optional[BackendSet]]:
         use_canary = (len(self.canary) > 0
                       and self._rng.random() * 100 < self.canary_percent)
-        backend = (self.canary if use_canary else self.default).pick()
-        if backend is None:  # fall through to the other set
-            backend = (self.default if use_canary else self.canary).pick()
-        return backend
+        first = self.canary if use_canary else self.default
+        other = self.default if use_canary else self.canary
+        backend = first.pick()
+        if backend is not None:
+            return backend, first
+        backend = other.pick()  # fall through to the other set
+        return backend, (other if backend is not None else None)
 
     def _proxy(self, h, has_body: bool) -> None:
         self.last_request_time = time.monotonic()
-        backend = self._pick_backend()
+        backend, chosen = self._pick_backend()
+        if chosen is not None:
+            chosen.last_request_time = self.last_request_time
         if backend is None:
             if self.on_cold_request is not None:
                 try:
@@ -113,6 +123,8 @@ class Router:
         host, _, port = backend.partition(":")
         conn = http.client.HTTPConnection(host, int(port), timeout=60)
         try:
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             fwd: Dict[str, str] = {}
             for k, v in h.headers.items():
                 if k.lower() in _HOP_BY_HOP:
