@@ -27,6 +27,7 @@ JOB_RESTARTING = "Restarting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
 JOB_SUSPENDED = "Suspended"
+JOB_QUEUED = "Queued"  # admitted but waiting for profile quota capacity
 
 # Restart policies (per replica).
 RESTART_NEVER = "Never"

@@ -66,13 +66,18 @@ class ControlPlane:
                 self.manager.register(ctrl)
         except ImportError:
             pass
-        try:
-            from .operators.platform import platform_controllers
+        from .operators.platform import (
+            PlatformAdmission,
+            platform_controllers,
+        )
 
-            for ctrl in platform_controllers(self.store, self.gangs):
-                self.manager.register(ctrl)
-        except ImportError:
-            pass
+        for ctrl in platform_controllers(self.store, self.gangs):
+            self.manager.register(ctrl)
+        # Wire quota + PodDefault admission into every workload controller.
+        admission = PlatformAdmission(self.store)
+        for ctrl in self.manager.controllers.values():
+            if hasattr(ctrl, "admission"):
+                ctrl.admission = admission
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ControlPlane":

@@ -1,0 +1,295 @@
+"""Platform (L6) operators: Notebook, Profile, and PodDefault admission.
+
+Reference parity (SURVEY.md §2.1 — reconstruction; the reference mount is
+empty, see SURVEY §0):
+
+  * notebook-controller (~3k LoC Go): ``Notebook`` CR -> StatefulSet +
+    Service + Istio VirtualService, plus the culler stopping idle
+    notebooks. Here the template's command runs as a supervised local
+    process (single-member gang: same restart/backoff/logging machinery
+    as training jobs) with a routed local URL in ``status.url``; culling
+    watches the process's output activity against the reference culler's
+    idle-seconds annotation.
+  * profile-controller (~3k) + kfam (~2k): ``Profile`` CR -> per-user
+    namespace + RBAC bindings + ResourceQuota. Here a Profile owns the
+    namespace bearing its name: contributor bindings are normalised into
+    status (the kfam surface) and ``spec.resourceQuotaSpec.hard`` is
+    enforced at gang-creation time by PlatformAdmission.
+  * admission-webhook (~2k): ``PodDefault`` mutation of pods in a profile
+    namespace. Here PlatformAdmission.mutate_specs injects matching
+    PodDefaults' env into every replica of a gang before launch.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Dict, List, Optional
+
+from ..api.platform import (
+    NOTEBOOK_CULLED,
+    NOTEBOOK_READY,
+    PROFILE_READY,
+    Notebook,
+    PodDefault,
+    Profile,
+)
+from ..api.training import JOB_QUEUED, TrainingJob
+from ..core.controller import Controller, Result
+from ..core.store import Conflict, NotFound, ResourceStore
+from ..runtime import gang as G
+from ..utils.net import free_port
+from ..utils.proc import inject_pythonpath
+
+TRAINING_KINDS = ("JAXJob", "TFJob", "PyTorchJob", "MPIJob")
+
+
+class PlatformAdmission:
+    """Admission hooks applied by workload controllers at gang build time.
+
+    Stands in for the reference's two admission paths: the ResourceQuota
+    check the apiserver performs on pod creation (profile-controller
+    installs the quota; SURVEY §2.1) and the PodDefault mutating webhook.
+    """
+
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    # -- quota (profile-controller / ResourceQuota parity) ------------------
+    def check_job(self, job: TrainingJob) -> Optional[str]:
+        """Return a denial reason if starting `job` would exceed the
+        namespace Profile's quota, else None."""
+        profile = self.store.try_get("Profile", job.namespace)
+        if not isinstance(profile, Profile):
+            return None
+        hard = (profile.resource_quota().get("hard")) or {}
+        max_jobs = hard.get("count/jobs")
+        max_replicas = hard.get("count/replicas")
+        if max_jobs is None and max_replicas is None:
+            return None
+        jobs = replicas = 0
+        for kind in TRAINING_KINDS:
+            for obj in self.store.list(kind, namespace=job.namespace):
+                assert isinstance(obj, TrainingJob)
+                if (obj.KIND, obj.name) == (job.KIND, job.name):
+                    continue
+                if obj.is_finished() or obj.run_policy().suspend:
+                    continue
+                # Jobs still waiting in the quota queue hold no capacity;
+                # counting them would let two queued jobs starve each
+                # other forever after a slot frees.
+                if obj.has_condition(JOB_QUEUED):
+                    continue
+                jobs += 1
+                replicas += obj.total_replicas()
+        if max_jobs is not None and jobs + 1 > int(max_jobs):
+            return (f"profile {profile.name}: count/jobs={max_jobs} "
+                    f"exhausted ({jobs} active)")
+        if max_replicas is not None and \
+                replicas + job.total_replicas() > int(max_replicas):
+            return (f"profile {profile.name}: count/replicas={max_replicas} "
+                    f"exhausted ({replicas} active + "
+                    f"{job.total_replicas()} requested)")
+        return None
+
+    # -- PodDefault injection (admission-webhook parity) --------------------
+    def mutate_specs(self, obj, specs: List[G.ProcessSpec]) -> List[str]:
+        """Inject env from PodDefaults in obj's namespace whose selector
+        matches obj's labels (existing keys win, webhook semantics).
+        Returns the names of the PodDefaults applied."""
+        applied = []
+        for pd in self.store.list("PodDefault", namespace=obj.namespace):
+            assert isinstance(pd, PodDefault)
+            if not pd.matches(obj.metadata.labels):
+                continue
+            for spec in specs:
+                for e in pd.env():
+                    spec.env.setdefault(str(e["name"]), str(e["value"]))
+            applied.append(pd.name)
+        return applied
+
+
+class NotebookController(Controller):
+    """Supervises one long-running process per Notebook resource."""
+
+    KIND = "Notebook"
+    RESYNC_PERIOD = 1.0
+
+    def __init__(self, store: ResourceStore, gangs: G.GangManager):
+        super().__init__(store)
+        self.gangs = gangs
+        self.admission: Optional[PlatformAdmission] = None
+
+    def _gang_key(self, key: str) -> str:
+        return f"notebook/{key}"
+
+    def on_delete(self, obj) -> None:
+        self.gangs.delete(self._gang_key(obj.key))
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, key: str) -> Optional[Result]:
+        nb = self.get_resource(key)
+        if nb is None:
+            self.gangs.delete(self._gang_key(key))
+            return None
+        assert isinstance(nb, Notebook)
+        gkey = self._gang_key(key)
+
+        # Culled notebooks stay down until the spec changes (the reference
+        # culler scales the StatefulSet to zero; re-applying restarts it).
+        if nb.has_condition(NOTEBOOK_CULLED):
+            if nb.status.get("culledAtGeneration") == nb.metadata.generation:
+                return None
+            nb.set_condition(NOTEBOOK_CULLED, "False", "Restarted",
+                             "spec changed; notebook restarting")
+            self._update_status(nb)
+
+        port = nb.status.get("port")
+        if not port:
+            port = free_port()
+            nb.status["port"] = port
+            nb.status["url"] = f"http://127.0.0.1:{port}"
+            self._update_status(nb)
+
+        gang = self.gangs.get(gkey)
+        if gang is None:
+            gang = self._create_gang(nb, gkey, int(port))
+            self.record_event(nb, "Normal", "NotebookStarted",
+                              f"serving on {nb.status.get('url')}")
+        st = gang.status()
+        running = st.phase == G.RUNNING
+        ready = running and self._probe(int(port), nb)
+
+        changed = False
+        want = "True" if ready else "False"
+        if not nb.has_condition(NOTEBOOK_READY, want):
+            reason = "NotebookReady" if ready else (
+                "NotebookStopped" if st.phase in (G.SUCCEEDED, G.FAILED)
+                else "NotebookStarting")
+            nb.set_condition(NOTEBOOK_READY, want, reason, st.message)
+            changed = True
+        if changed:
+            self._update_status(nb)
+
+        if running:
+            self._maybe_cull(nb, gang, gkey)
+        return None
+
+    def _create_gang(self, nb: Notebook, gkey: str, port: int) -> G.Gang:
+        ctrl, key = self, nb.key
+
+        def factory(workdir: str) -> G.Gang:
+            argv = [a.replace("$(KFX_PORT)", str(port))
+                     .replace("$(NB_PORT)", str(port))
+                    for a in nb.argv()]
+            env = {str(e.get("name")): str(e.get("value"))
+                   for e in (nb.container().get("env") or [])}
+            env["KFX_NOTEBOOK_PORT"] = str(port)
+            inject_pythonpath(env)
+            specs = [G.ProcessSpec(replica_type="Notebook", index=0,
+                                   argv=argv, env=env)]
+            if ctrl.admission is not None:
+                applied = ctrl.admission.mutate_specs(nb, specs)
+                if applied:
+                    ctrl.record_event(nb, "Normal", "PodDefaultsApplied",
+                                      ", ".join(applied))
+            return G.Gang(
+                name=nb.name, specs=specs, workdir=workdir,
+                restart_policy="OnFailure", backoff_limit=5,
+                chief_replica_type="Notebook",
+                on_change=lambda g: ctrl.queue.add(key))
+
+        return self.gangs.ensure(gkey, factory)
+
+    def _probe(self, port: int, nb: Notebook) -> bool:
+        """TCP readiness probe against the routed port; notebooks whose
+        template declares no port are ready when the process runs."""
+        declares_port = bool(nb.container().get("ports"))
+        if not declares_port:
+            return True
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                return True
+        except OSError:
+            return False
+
+    def _maybe_cull(self, nb: Notebook, gang: G.Gang, gkey: str) -> None:
+        """Idle culling: the reference culler stops a notebook whose last
+        activity is older than the idle window. Activity proxy: the
+        process's output log mtime (requests to a notebook produce access
+        logs), floored at the last (re)start."""
+        idle_s = nb.culling_idle_seconds()
+        if idle_s <= 0:
+            return
+        st = gang.status()
+        started = max((r.started_at or 0.0) for r in st.replicas.values())
+        last = started
+        log_path = gang.log_path("notebook-0")
+        try:
+            last = max(last, os.path.getmtime(log_path))
+        except OSError:
+            pass
+        if (time.time() - last) < idle_s:
+            return
+        self.gangs.delete(gkey)
+        nb.set_condition(NOTEBOOK_CULLED, "True", "IdleCulled",
+                         f"no activity for {idle_s}s")
+        nb.set_condition(NOTEBOOK_READY, "False", "IdleCulled", "")
+        nb.status["culledAtGeneration"] = nb.metadata.generation
+        self._update_status(nb)
+        self.record_event(nb, "Normal", "NotebookCulled",
+                          f"idle for >= {idle_s}s")
+
+    def _update_status(self, nb: Notebook) -> None:
+        try:
+            self.store.update_status(nb)
+        except (Conflict, NotFound):
+            self.queue.add(nb.key)
+
+    def shutdown(self) -> None:
+        pass  # gangs are owned by the shared GangManager
+
+
+class ProfileController(Controller):
+    """Profile -> owned namespace + normalised contributor bindings
+    (profile-controller + kfam surface) + quota visibility."""
+
+    KIND = "Profile"
+
+    def reconcile(self, key: str) -> Optional[Result]:
+        profile = self.get_resource(key)
+        if profile is None:
+            return None
+        assert isinstance(profile, Profile)
+        changed = False
+        ns = profile.name  # a Profile owns the namespace bearing its name
+        if profile.status.get("namespace") != ns:
+            profile.status["namespace"] = ns
+            changed = True
+        bindings = [{"user": profile.owner().get("name"), "role": "admin"}]
+        bindings += [{"user": c.get("name"), "role": c.get("role", "edit")}
+                     for c in profile.contributors()]
+        if profile.status.get("bindings") != bindings:
+            profile.status["bindings"] = bindings
+            changed = True
+        hard = (profile.resource_quota().get("hard")) or {}
+        if hard and profile.status.get("quota") != hard:
+            profile.status["quota"] = hard
+            changed = True
+        if not profile.has_condition(PROFILE_READY):
+            profile.set_condition(PROFILE_READY, "True", "NamespaceReady",
+                                  f"namespace {ns} provisioned")
+            changed = True
+            self.record_event(profile, "Normal", "NamespaceReady", ns)
+        if changed:
+            try:
+                self.store.update_status(profile)
+            except (Conflict, NotFound):
+                self.queue.add(profile.key)
+        return None
+
+
+def platform_controllers(store: ResourceStore,
+                         gangs: G.GangManager) -> List[Controller]:
+    return [NotebookController(store, gangs), ProfileController(store)]

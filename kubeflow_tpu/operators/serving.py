@@ -222,7 +222,10 @@ class InferenceServiceController(Controller):
                     del rt.revisions[rev_name]
                 continue
             model_dir = _resolve_storage_uri(spec_storage_uri(spec))
-            if rev is None or rev.model_dir != model_dir:
+            batcher = spec.get("batcher")
+            device = str(spec.get("device", "auto"))
+            if rev is None or rev.model_dir != model_dir \
+                    or rev.device != device or rev.batcher != batcher:
                 if rev is not None:
                     rev.teardown()
                 rev = _Revision(
@@ -231,8 +234,8 @@ class InferenceServiceController(Controller):
                     model_dir=model_dir,
                     workdir=os.path.join(self.home, "serving",
                                          key.replace("/", "_")),
-                    batcher=spec.get("batcher"),
-                    device=str(spec.get("device", "auto")),
+                    batcher=batcher,
+                    device=device,
                 )
                 rt.revisions[rev_name] = rev
                 self.record_event(isvc, "Normal", "RevisionCreated",

@@ -74,6 +74,9 @@ class TrainingControllerBase(Controller):
         # is single-chip), single-process inherits the machine default.
         self.worker_platform = worker_platform if worker_platform is not None \
             else os.environ.get("KFX_WORKER_PLATFORM")
+        # Set by the control plane when the platform operators are present:
+        # quota admission + PodDefault injection (operators/platform.py).
+        self.admission = None
 
     # -- gang bookkeeping ---------------------------------------------------
     def _gang_key(self, key: str) -> str:
@@ -126,6 +129,22 @@ class TrainingControllerBase(Controller):
 
         gang = self.gangs.get(gkey)
         if gang is None:
+            if self.admission is not None:
+                denial = self.admission.check_job(job)
+                if denial:
+                    # Quota-exceeded jobs queue (the reference's pod
+                    # creation is rejected by ResourceQuota and the job
+                    # controller retries); they start when capacity frees.
+                    if self._set_if_changed(job, T.JOB_QUEUED, "True",
+                                            "QuotaExceeded", denial):
+                        self._update_status(job)
+                        self.record_event(job, "Warning", "QuotaExceeded",
+                                          denial)
+                    return Result(requeue=True, requeue_after=1.0)
+            if job.has_condition(T.JOB_QUEUED):
+                job.set_condition(T.JOB_QUEUED, "False", "QuotaFreed",
+                                  "capacity available")
+                self._update_status(job)
             gang = self._create_gang(job, gkey, policy)
             if not job.has_condition(T.JOB_CREATED):
                 job.set_condition(T.JOB_CREATED, "True", "JobCreated",
@@ -147,6 +166,11 @@ class TrainingControllerBase(Controller):
             specs, env_hook = ctrl.build_specs(job, workdir)
             for spec in specs:
                 inject_pythonpath(spec.env)
+            if ctrl.admission is not None:
+                applied = ctrl.admission.mutate_specs(job, specs)
+                if applied:
+                    ctrl.record_event(job, "Normal", "PodDefaultsApplied",
+                                      ", ".join(applied))
             # restartPolicy comes from the chief replica's spec (the
             # reference tracks it per replica; one gang = one policy here,
             # chief's wins as it decides success anyway).

@@ -157,7 +157,10 @@ class JaxPredictor(Predictor):
                 self.placement[b] = "cpu" if t_cpu * b < t_acc else \
                     "accelerator"
         else:
-            dev_name = "cpu" if device == "cpu" else "accelerator"
+            # Label truthfully on CPU-only hosts: "default" there IS cpu.
+            dev_name = "cpu" if (device == "cpu"
+                                 or default_dev.platform == "cpu") else \
+                "accelerator"
             self.placement = {b: dev_name for b in self._buckets}
 
         self._compiled = {}
