@@ -52,6 +52,11 @@ class TransformerConfig:
     # residual stream is sharded over the "model" axis on the seq dim
     # (annotation only — XLA inserts the all-gather/reduce-scatter pairs).
     sp: bool = False
+    # Context parallelism: >1 shards the sequence dim over the "ctx" mesh
+    # axis for the whole layer stack, with exact causal ring attention
+    # (parallel/ring_attention.py) rotating K/V chunks between ctx
+    # neighbours. Mutually exclusive with sp (both shard the seq dim).
+    cp: int = 1
 
     @property
     def qkv_features(self) -> int:
@@ -100,14 +105,27 @@ class Attention(nn.Module):
         k = rope(k, positions)
         q = q / np.sqrt(cfg.head_dim)
 
-        # Dense causal attention (XLA fuses the softmax chain). The
-        # long-context context-parallel path lives in
-        # parallel/ring_attention.py behind its own sharded train loop.
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        mask = nn.make_causal_mask(jnp.zeros((B, S)), dtype=jnp.bool_)
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+        if cfg.cp > 1:
+            # Context-parallel path: seq sharded over "ctx", heads over
+            # "model" (each head attends independently, so tp composes),
+            # exact causal ring attention rotating K/V between neighbours.
+            import functools
+
+            from ..parallel.mesh import AXIS_CTX, AXIS_DATA, AXIS_MODEL
+            from ..parallel.ring_attention import ring_attention
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(AXIS_DATA, AXIS_CTX, AXIS_MODEL, None)
+            out = jax.shard_map(
+                functools.partial(ring_attention, axis_name=AXIS_CTX),
+                in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        else:
+            # Dense causal attention (XLA fuses the softmax chain).
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            mask = nn.make_causal_mask(jnp.zeros((B, S)), dtype=jnp.bool_)
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
         return nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                name="out")(out)
@@ -186,13 +204,17 @@ class Block(nn.Module):
         cfg = self.cfg
 
         def sp_shard(y):
-            if not cfg.sp:
+            """Sequence-dim activation sharding between matmul regions:
+            over "model" for Megatron sp, over "ctx" when context-parallel
+            (cp keeps the residual stream seq-sharded the whole way)."""
+            if not cfg.sp and cfg.cp <= 1:
                 return y
-            from ..parallel.mesh import AXIS_DATA, AXIS_MODEL
+            from ..parallel.mesh import AXIS_CTX, AXIS_DATA, AXIS_MODEL
             from jax.sharding import PartitionSpec as P
 
+            axis = AXIS_CTX if cfg.cp > 1 else AXIS_MODEL
             return jax.lax.with_sharding_constraint(
-                y, P(AXIS_DATA, AXIS_MODEL, None))
+                y, P(AXIS_DATA, axis, None))
 
         x = sp_shard(x)
         x = x + Attention(cfg, name="attn")(

@@ -28,7 +28,13 @@ from ..models.transformer import (
     TransformerLM,
     param_logical_axes,
 )
-from .mesh import AXIS_DATA, MeshPlan, param_sharding_rules, tree_shardings
+from .mesh import (
+    AXIS_CTX,
+    AXIS_DATA,
+    MeshPlan,
+    param_sharding_rules,
+    tree_shardings,
+)
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -78,6 +84,12 @@ class LMTrainLoop:
         if plan.pp > 1:
             raise NotImplementedError(
                 "pp>1 runs through parallel.pipeline.PipelinedLMTrainLoop")
+        if cfg.cp != plan.cp and (cfg.cp > 1 or plan.cp > 1):
+            raise ValueError(
+                f"cfg.cp={cfg.cp} must match the mesh plan's cp={plan.cp}")
+        if cfg.cp > 1 and cfg.sp:
+            raise ValueError("sp and cp both shard the sequence dim; "
+                             "enable at most one")
         self.cfg = cfg
         self.mesh = mesh
         self.plan = plan
@@ -85,6 +97,9 @@ class LMTrainLoop:
         self.model = TransformerLM(cfg)
         self.rules = param_sharding_rules(plan)
         self.repl = NamedSharding(mesh, P())
+        # Raw [B, S+1] token batches shard over "data" only (S+1 rarely
+        # divides cp); the sliced [B, S] inputs/targets are constrained
+        # onto "ctx" inside the loss, so cp shards every activation.
         self.batch_sharding = NamedSharding(mesh, P(AXIS_DATA, None))
 
         schedule = optax.warmup_cosine_decay_schedule(
@@ -101,7 +116,12 @@ class LMTrainLoop:
 
     # -- state --------------------------------------------------------------
     def _init_fn(self, rng):
-        sample = jnp.zeros((1, min(self.cfg.max_seq_len, 8)), jnp.int32)
+        # The sample only shapes the params, but with cp>1 the in-model
+        # shard_map requires the sample itself to divide the mesh: batch
+        # over "data", seq over "ctx".
+        s = min(self.cfg.max_seq_len, 8)
+        s = ((s + self.plan.cp - 1) // self.plan.cp) * self.plan.cp
+        sample = jnp.zeros((self.plan.dp, s), jnp.int32)
         variables = self.model.init(rng, sample)
         params = variables["params"]
         return LMTrainState(step=jnp.zeros((), jnp.int32), params=params,
@@ -134,6 +154,10 @@ class LMTrainLoop:
     def _loss_fn(self, params, tokens):
         """tokens: [B, S+1] int32 (inputs || shifted targets)."""
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if self.cfg.cp > 1:
+            cons = lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, P(AXIS_DATA, AXIS_CTX)))
+            inputs, targets = cons(inputs), cons(targets)
         outputs = self.model.apply(
             {"params": params}, inputs,
             mutable=["aux_loss"] if self.cfg.n_experts else [])

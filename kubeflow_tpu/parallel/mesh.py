@@ -17,6 +17,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_STAGE = "stage"   # pipeline (pp)
 AXIS_DATA = "data"     # batch (dp) + fsdp param shards + experts (ep)
+AXIS_CTX = "ctx"       # context parallelism (cp): sequence via ring attention
 AXIS_MODEL = "model"   # tensor (tp) + sequence (sp) activation shards
 
 
@@ -28,44 +29,56 @@ class MeshPlan:
     sharded over the data-parallel group, all-gathered per layer by XLA).
     Likewise experts (ep) place the expert dimension on "data", and
     sequence parallelism (sp) reuses "model" for activation shards.
+    Context parallelism (cp) has its own axis: the sequence dim of
+    activations and K/V shards over "ctx", with ring attention rotating
+    K/V chunks between ctx neighbours (parallel/ring_attention.py).
     """
 
     pp: int = 1
     dp: int = 1
+    cp: int = 1
     tp: int = 1
     fsdp: bool = False  # shard params along "data" too
 
     @property
     def n_devices(self) -> int:
-        return self.pp * self.dp * self.tp
+        return self.pp * self.dp * self.cp * self.tp
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {AXIS_STAGE: self.pp, AXIS_DATA: self.dp, AXIS_MODEL: self.tp}
+        return {AXIS_STAGE: self.pp, AXIS_DATA: self.dp,
+                AXIS_CTX: self.cp, AXIS_MODEL: self.tp}
 
 
-def _factor(n: int, want_tp: Optional[int], want_pp: Optional[int]
-            ) -> Tuple[int, int, int]:
-    """Choose (pp, dp, tp) for n devices; dp absorbs what pp/tp don't."""
+def _factor(n: int, want_tp: Optional[int], want_pp: Optional[int],
+            want_cp: Optional[int]) -> Tuple[int, int, int, int]:
+    """Choose (pp, dp, cp, tp) for n devices; dp absorbs the rest."""
     pp = want_pp or 1
     if n % pp:
         raise ValueError(f"pp={pp} does not divide device count {n}")
     rest = n // pp
+    cp = want_cp or 1
+    if rest % cp:
+        raise ValueError(f"cp={cp} does not divide {rest} (n={n}, pp={pp})")
+    rest //= cp
     tp = want_tp or 1
     if rest % tp:
-        raise ValueError(f"tp={tp} does not divide {rest} (n={n}, pp={pp})")
-    return pp, rest // tp, tp
+        raise ValueError(
+            f"tp={tp} does not divide {rest} (n={n}, pp={pp}, cp={cp})")
+    return pp, rest // tp, cp, tp
 
 
 def make_mesh(n_devices: Optional[int] = None, *, tp: Optional[int] = None,
-              pp: Optional[int] = None, fsdp: bool = False,
+              pp: Optional[int] = None, cp: Optional[int] = None,
+              fsdp: bool = False,
               devices: Optional[Sequence[jax.Device]] = None
               ) -> Tuple[Mesh, MeshPlan]:
-    """Build the ("stage", "data", "model") mesh over the slice.
+    """Build the ("stage", "data", "ctx", "model") mesh over the slice.
 
     Device order matters for collective locality: jax.devices() on TPU is
     already ordered so that adjacent ids are ICI neighbours; tp (the most
     chatty axis: per-layer all-reduces) gets the innermost, contiguous
-    stride, pp (per-microbatch point-to-point only) the outermost.
+    stride, then cp (ring ppermute between neighbours), pp (per-microbatch
+    point-to-point only) the outermost.
     """
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
@@ -81,10 +94,10 @@ def make_mesh(n_devices: Optional[int] = None, *, tp: Optional[int] = None,
             raise ValueError(msg)
         devs = devs[:n_devices]
     n = len(devs)
-    pp_, dp_, tp_ = _factor(n, tp, pp)
-    arr = np.array(devs).reshape(pp_, dp_, tp_)
-    return (Mesh(arr, (AXIS_STAGE, AXIS_DATA, AXIS_MODEL)),
-            MeshPlan(pp=pp_, dp=dp_, tp=tp_, fsdp=fsdp))
+    pp_, dp_, cp_, tp_ = _factor(n, tp, pp, cp)
+    arr = np.array(devs).reshape(pp_, dp_, cp_, tp_)
+    return (Mesh(arr, (AXIS_STAGE, AXIS_DATA, AXIS_CTX, AXIS_MODEL)),
+            MeshPlan(pp=pp_, dp=dp_, cp=cp_, tp=tp_, fsdp=fsdp))
 
 
 # ---------------------------------------------------------------------------

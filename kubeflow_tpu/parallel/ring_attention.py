@@ -74,9 +74,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return num, m_tot, den, kc, vc
 
-    # Initial accumulators must be marked device-varying over the ring axis
-    # for shard_map's VMA check (the loop makes them varying).
-    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    # Initial accumulators must be marked device-varying for shard_map's
+    # VMA check (the loop makes them varying): over every manual axis the
+    # inputs vary over (e.g. data/ctx/model when called from the model's
+    # sharded attention), not just the ring axis.
+    try:
+        vma = tuple(jax.typeof(q).vma) or (axis_name,)
+    except AttributeError:  # older jax: ring axis only
+        vma = (axis_name,)
+    vary = lambda x: jax.lax.pcast(x, vma, to="varying")
     num0 = vary(jnp.zeros((B, S, H, D), jnp.float32))
     m0 = vary(jnp.full((B, H, S), NEG_INF, jnp.float32))
     den0 = vary(jnp.zeros((B, H, S), jnp.float32))
