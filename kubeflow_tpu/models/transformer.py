@@ -44,6 +44,11 @@ class TransformerConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    # "capacity": GShard-style fixed expert buffers [E, B, C, D] with
+    # cumsum slotting and token dropping beyond capacity (O(E·C) expert
+    # FLOPs — scales to large E). "dense": every expert sees every token,
+    # masked (exact, O(E·tokens) FLOPs — only sane for tiny E).
+    moe_dispatch: str = "capacity"
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -146,11 +151,18 @@ class DenseFFN(nn.Module):
 
 
 class MoEFFN(nn.Module):
-    """Top-k routed experts with dense one-hot dispatch.
+    """Top-k routed experts, dispatch/combine as einsums against one-hot
+    routing tensors — no gather/scatter, so the whole layer is MXU work
+    and shards cleanly: experts over "data" (ep), expert mlp dim over
+    "model" (tp).
 
-    Dispatch/combine are einsums against a one-hot routing tensor — no
-    gather/scatter, so the whole layer is MXU work and shards cleanly:
-    experts over "data" (ep), expert mlp dim over "model" (tp).
+    Default dispatch is GShard-style capacity routing: each batch row is a
+    routing group; every expert owns a fixed buffer of C slots per group
+    (C = ceil(capacity_factor · K · S / E)); tokens claim slots in
+    sequence order via a cumsum, first choices before second, and tokens
+    beyond capacity are dropped (their residual passes through untouched).
+    Expert FLOPs are O(E · C) regardless of routing skew — this is what
+    lets E grow past toy sizes. With C == S it is exact (== dense).
     """
 
     cfg: TransformerConfig
@@ -163,33 +175,64 @@ class MoEFFN(nn.Module):
         gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                                param_dtype=jnp.float32, name="gate")(
             x.astype(jnp.float32))
-        weights, idx = jax.lax.top_k(jax.nn.softmax(gate_logits, -1), K)
+        probs = jax.nn.softmax(gate_logits, -1)
+        weights, idx = jax.lax.top_k(probs, K)
         weights = weights / jnp.sum(weights, -1, keepdims=True)
-        # [B, S, K, E] one-hot expert assignment, combined with routing
-        # weights into a single dispatch tensor [B, S, E].
-        one_hot = jax.nn.one_hot(idx, E, dtype=cfg.dtype)
-        combine = jnp.einsum("bsk,bske->bse", weights.astype(cfg.dtype),
-                             one_hot)
-        dispatch = (combine > 0).astype(cfg.dtype)
+        one_hot = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # [B, S, K, E]
 
         wi = self.param("wi", nn.initializers.lecun_normal(),
                         (E, D, 2 * cfg.d_ff), cfg.param_dtype)
         wo = self.param("wo", nn.initializers.lecun_normal(),
                         (E, cfg.d_ff, D), cfg.param_dtype)
-        # Every expert sees every token, masked by dispatch — the dense-MoE
-        # formulation (exact for small E; capacity-dropping variant is a
-        # serving-time optimisation, not needed for correctness).
-        xe = jnp.einsum("bsd,bse->ebsd", x, dispatch)
-        h = jnp.einsum("ebsd,edf->ebsf", xe, wi.astype(cfg.dtype))
-        gate_h, up = jnp.split(h, 2, axis=-1)
-        h = nn.silu(gate_h) * up
-        ye = jnp.einsum("ebsf,efd->ebsd", h, wo.astype(cfg.dtype))
-        y = jnp.einsum("ebsd,bse->bsd", ye, combine)
+
+        def expert_ffn(xe):
+            """xe: [E, ..., D] per-expert token buffers."""
+            h = jnp.einsum("e...d,edf->e...f", xe, wi.astype(cfg.dtype))
+            gate_h, up = jnp.split(h, 2, axis=-1)
+            return jnp.einsum("e...f,efd->e...d", nn.silu(gate_h) * up,
+                              wo.astype(cfg.dtype))
+
+        if cfg.moe_dispatch == "capacity":
+            cap = int(np.ceil(cfg.capacity_factor * K * S / E))
+            cap = max(1, min(cap, S))
+            # Slot assignment: flatten choices k-major-last so every
+            # token's first choice outranks any token's second choice,
+            # then a cumsum per expert numbers the claimed slots.
+            ohp = one_hot.transpose(0, 2, 1, 3).reshape(B, K * S, E)
+            pos = jnp.cumsum(ohp, axis=1) - ohp  # [B, K*S, E]
+            keep = (pos < cap) * ohp
+            pos = pos.reshape(B, K, S, E).transpose(0, 2, 1, 3)
+            keep = keep.reshape(B, K, S, E).transpose(0, 2, 1, 3)
+            # Each (token, expert) pair is claimed by at most one k (top_k
+            # indices are distinct), so fold k BEFORE the slot one_hot —
+            # the biggest MoE activation stays [B, S, E, C], not K× that.
+            pos_se = jnp.sum(pos * keep, axis=2)       # [B, S, E]
+            keep_se = jnp.sum(keep, axis=2)            # 0/1 [B, S, E]
+            w_se = jnp.sum(weights[..., None] * keep, axis=2)
+            dispatch = (jax.nn.one_hot(pos_se, cap, dtype=cfg.dtype)
+                        * keep_se.astype(cfg.dtype)[..., None])
+            combine = w_se.astype(cfg.dtype)[..., None] * dispatch
+            xe = jnp.einsum("bsd,bsec->ebcd", x, dispatch)  # [E, B, C, D]
+            ye = expert_ffn(xe)
+            y = jnp.einsum("ebcd,bsec->bsd", ye, combine)
+        elif cfg.moe_dispatch == "dense":
+            # Every expert sees every token, masked — exact at any
+            # capacity but O(E·tokens) FLOPs; kept as the numerics oracle.
+            combine = jnp.einsum("bsk,bske->bse", weights.astype(cfg.dtype),
+                                 one_hot.astype(cfg.dtype))
+            dispatch = (combine > 0).astype(cfg.dtype)
+            xe = jnp.einsum("bsd,bse->ebsd", x, dispatch)
+            ye = expert_ffn(xe)
+            y = jnp.einsum("ebsd,bse->bsd", ye, combine)
+        else:
+            raise ValueError(
+                f"unknown moe_dispatch {cfg.moe_dispatch!r} "
+                "(expected 'capacity' or 'dense')")
 
         # Load-balancing auxiliary loss (Switch-style), stashed for the
         # train loop via a mutable collection.
         me = jnp.mean(one_hot[..., 0, :].astype(jnp.float32), axis=(0, 1))
-        ce = jnp.mean(jax.nn.softmax(gate_logits, -1), axis=(0, 1))
+        ce = jnp.mean(probs, axis=(0, 1))
         self.sow("aux_loss", "moe", E * jnp.sum(me * ce))
         return y
 
@@ -234,8 +277,21 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         cfg = self.cfg
+        if cfg.cp > 1:
+            # Pin the token layout before the (vocab-sharded) embedding
+            # gather so the lookup's output lands directly on the
+            # (data, ctx) layout the layer stack keeps — otherwise SPMD
+            # falls back to a full rematerialisation of the activations.
+            from ..parallel.mesh import AXIS_CTX, AXIS_DATA
+            from jax.sharding import PartitionSpec as P
+
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, P(AXIS_DATA, AXIS_CTX))
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="embed")(tokens)
+        if cfg.cp > 1:
+            x = jax.lax.with_sharding_constraint(
+                x, P(AXIS_DATA, AXIS_CTX, None))
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
 

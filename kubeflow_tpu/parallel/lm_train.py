@@ -129,8 +129,11 @@ class LMTrainLoop:
 
     def state_shardings(self) -> LMTrainState:
         if self._state_shardings is None:
-            abs_state = jax.eval_shape(
-                self._init_fn, jax.random.PRNGKey(self.hp.seed))
+            # Trace under the mesh: the model's cp/sp paths contain bare-
+            # PartitionSpec sharding constraints that need an ambient mesh.
+            with jax.set_mesh(self.mesh):
+                abs_state = jax.eval_shape(
+                    self._init_fn, jax.random.PRNGKey(self.hp.seed))
             axes = param_logical_axes(abs_state.params)
             params_sh = tree_shardings(self.mesh, axes, self.rules,
                                        abs_state.params)

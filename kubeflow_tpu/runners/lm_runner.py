@@ -32,6 +32,8 @@ def parse_args(argv=None):
     p.add_argument("--pp", type=int, default=1, help="pipeline stages")
     p.add_argument("--fsdp", action="store_true")
     p.add_argument("--sp", action="store_true", help="sequence parallelism")
+    p.add_argument("--cp", type=int, default=1,
+                   help="context parallel ways (ring attention over 'ctx')")
     p.add_argument("--experts", type=int, default=0, help="MoE experts (ep)")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--microbatches", type=int, default=0)
@@ -68,6 +70,10 @@ def main(argv=None) -> int:
               "(sequence parallelism composes with tp in the non-pipelined "
               "loop only)", file=sys.stderr)
         return 2
+    if args.cp > 1 and (args.pp > 1 or args.sp):
+        print("error: --cp composes with dp/tp/fsdp/ep only (sp shards the "
+              "same seq dim; pp runs the pipelined loop)", file=sys.stderr)
+        return 2
     ds = get_lm_dataset(args.dataset, seed=args.seed,
                         seq_len=args.seq_len or None)
     cfg = preset_config(
@@ -76,9 +82,10 @@ def main(argv=None) -> int:
         max_seq_len=ds.seq_len,
         n_experts=args.experts,
         sp=args.sp,
+        cp=args.cp,
         remat=args.remat,
     )
-    mesh, plan = make_mesh(tp=args.tp or None, pp=args.pp,
+    mesh, plan = make_mesh(tp=args.tp or None, pp=args.pp, cp=args.cp,
                            fsdp=args.fsdp)
     hp = LMHyperParams(learning_rate=args.learning_rate,
                        warmup_steps=args.warmup_steps,
@@ -97,6 +104,7 @@ def main(argv=None) -> int:
           f"devices={jax.device_count()} plan=pp{plan.pp}/dp{plan.dp}/"
           f"tp{plan.tp}{'/fsdp' if plan.fsdp else ''}"
           f"{'/sp' if cfg.sp else ''}"
+          f"{f'/cp{plan.cp}' if plan.cp > 1 else ''}"
           f"{f'/ep{cfg.n_experts}' if cfg.n_experts else ''} "
           f"seq_len={ds.seq_len}", flush=True)
 

@@ -55,9 +55,11 @@ class TestMesh:
         from kubeflow_tpu.parallel.mesh import make_mesh
 
         mesh, plan = make_mesh(8, tp=2, pp=2)
-        assert (plan.pp, plan.dp, plan.tp) == (2, 2, 2)
-        assert mesh.devices.shape == (2, 2, 2)
-        assert mesh.axis_names == ("stage", "data", "model")
+        assert (plan.pp, plan.dp, plan.cp, plan.tp) == (2, 2, 1, 2)
+        assert mesh.devices.shape == (2, 2, 1, 2)
+        assert mesh.axis_names == ("stage", "data", "ctx", "model")
+        mesh2, plan2 = make_mesh(8, tp=2, cp=2)
+        assert (plan2.pp, plan2.dp, plan2.cp, plan2.tp) == (1, 2, 2, 2)
 
     def test_bad_factorisation(self):
         from kubeflow_tpu.parallel.mesh import make_mesh
@@ -127,6 +129,41 @@ class TestShardedTraining:
             s2, l2, _ = loop2.train_step(s2, toks)
             assert abs(l1 - l2) < 5e-2, (step, l1, l2)
 
+    def test_cp_matches_no_cp(self, tiny_cfg):
+        """Context parallelism (ring attention over "ctx") is numerically
+        a layout choice: training with cp=2 must track the cp=1 loop."""
+        import dataclasses
+
+        from kubeflow_tpu.data.lm import LMDataset
+        from kubeflow_tpu.parallel.lm_train import LMHyperParams, LMTrainLoop
+        from kubeflow_tpu.parallel.mesh import make_mesh
+
+        hp = LMHyperParams(total_steps=10, warmup_steps=2, seed=0)
+        mesh1, plan1 = make_mesh(8, tp=2, fsdp=True)
+        loop1 = LMTrainLoop(tiny_cfg, mesh1, plan1, hp)
+        cfg_cp = dataclasses.replace(tiny_cfg, cp=2)
+        mesh2, plan2 = make_mesh(8, tp=2, cp=2, fsdp=True)
+        loop2 = LMTrainLoop(cfg_cp, mesh2, plan2, hp)
+        s1, s2 = loop1.init_state(), loop2.init_state()
+        ds = LMDataset(vocab_size=tiny_cfg.vocab_size, seq_len=32)
+        it = ds.batches(16)
+        for step in range(4):
+            toks = next(it)
+            s1, l1, _ = loop1.train_step(s1, toks)
+            s2, l2, _ = loop2.train_step(s2, toks)
+            assert abs(l1 - l2) < 5e-2, (step, l1, l2)
+
+    def test_cp_rejects_sp(self, tiny_cfg):
+        import dataclasses
+
+        from kubeflow_tpu.parallel.lm_train import LMHyperParams, LMTrainLoop
+        from kubeflow_tpu.parallel.mesh import make_mesh
+
+        mesh, plan = make_mesh(8, cp=2)
+        cfg = dataclasses.replace(tiny_cfg, cp=2, sp=True)
+        with pytest.raises(ValueError, match="sp and cp"):
+            LMTrainLoop(cfg, mesh, plan, LMHyperParams())
+
     def test_pipeline_rejects_bad_shapes(self, tiny_cfg):
         from kubeflow_tpu.parallel.lm_train import LMHyperParams
         from kubeflow_tpu.parallel.mesh import make_mesh
@@ -139,6 +176,77 @@ class TestShardedTraining:
             PipelinedLMTrainLoop(
                 dataclasses.replace(tiny_cfg, n_layers=3), mesh, plan,
                 LMHyperParams())
+
+
+class TestMoE:
+    def _moe(self, dispatch, cf, E=4, K=2, D=16, d_ff=32):
+        from kubeflow_tpu.models.transformer import MoEFFN, TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=64, d_model=D, n_heads=2,
+                                head_dim=8, n_layers=1, d_ff=d_ff,
+                                max_seq_len=32, n_experts=E, expert_top_k=K,
+                                capacity_factor=cf, moe_dispatch=dispatch)
+        return MoEFFN(cfg)
+
+    def test_capacity_matches_dense_at_full_capacity(self):
+        """With C == S no token is ever dropped, so capacity dispatch is
+        numerically the dense oracle."""
+        import jax
+        import jax.numpy as jnp
+
+        E, K = 4, 2
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(2, 16, 16)), jnp.float32)
+        dense = self._moe("dense", 1.25, E=E, K=K)
+        full = self._moe("capacity", E / K, E=E, K=K)  # C = S exactly
+        params = dense.init(jax.random.PRNGKey(0), x)
+        y1, aux1 = dense.apply(params, x, mutable=["aux_loss"])
+        y2, aux2 = full.apply(params, x, mutable=["aux_loss"])
+        assert float(jnp.max(jnp.abs(y1 - y2))) < 1e-2
+        a1, a2 = (jax.tree.leaves(a)[0] for a in (aux1, aux2))
+        assert np.allclose(np.asarray(a1), np.asarray(a2))
+
+    def test_capacity_drops_overflow_tokens(self):
+        """Under-capacity buffers drop late tokens: the dropped token's FFN
+        output is zero (residual passthrough), never garbage."""
+        import jax
+        import jax.numpy as jnp
+
+        tight = self._moe("capacity", 0.25)  # C = ceil(.25*2*16/4) = 2 slots
+        x = jnp.asarray(np.random.default_rng(4).normal(size=(1, 16, 16)),
+                        jnp.float32)
+        params = tight.init(jax.random.PRNGKey(0), x)
+        y, _ = tight.apply(params, x, mutable=["aux_loss"])
+        assert np.isfinite(np.asarray(y)).all()
+        # At most E*C = 8 of 16 tokens can hold a slot, so some rows of the
+        # output must be exactly zero (dropped tokens contribute nothing).
+        row_norms = np.asarray(jnp.sum(jnp.abs(y), axis=-1))[0]
+        assert (row_norms == 0).sum() >= 16 - 8
+
+    def test_ep_e8_trains(self, tiny_cfg):
+        """E=8 experts (one per device over "data"): capacity dispatch keeps
+        expert FLOPs O(E·C), where the dense oracle would do E× the token
+        FLOPs."""
+        import dataclasses
+
+        from kubeflow_tpu.data.lm import LMDataset
+        from kubeflow_tpu.parallel.lm_train import LMHyperParams, LMTrainLoop
+        from kubeflow_tpu.parallel.mesh import make_mesh
+
+        cfg = dataclasses.replace(tiny_cfg, n_experts=8)
+        mesh, plan = make_mesh(8, fsdp=True)
+        loop = LMTrainLoop(cfg, mesh, plan,
+                           LMHyperParams(total_steps=8, warmup_steps=2))
+        state = loop.init_state()
+        assert tuple(state.params["layers"]["moe"]["wi"].sharding.spec)[1] \
+            == "data"
+        ds = LMDataset(vocab_size=cfg.vocab_size, seq_len=32)
+        it = ds.batches(16)
+        losses = []
+        for _ in range(6):
+            state, loss, _ = loop.train_step(state, next(it))
+            losses.append(loss)
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
 class TestRingAttention:
