@@ -293,6 +293,27 @@ def registered_kinds() -> List[str]:
     return sorted(k for k in _REGISTRY if k[0].isupper())
 
 
+# Single source of truth for the one-word state shown by `kfx get`, the
+# dashboard, and the remote client (most-significant condition wins).
+STATE_PRIORITY = ("Failed", "Succeeded", "Restarting", "Suspended",
+                  "Running", "Ready", "Created")
+
+
+def display_state(conditions) -> str:
+    """One-word display state from a condition list. Accepts Condition
+    objects or plain dicts (the JSON wire form)."""
+    true = set()
+    for c in conditions:
+        ctype = c.get("type") if isinstance(c, dict) else c.type
+        status = c.get("status") if isinstance(c, dict) else c.status
+        if status == "True":
+            true.add(ctype)
+    for s in STATE_PRIORITY:
+        if s in true:
+            return s
+    return "Pending"
+
+
 def from_manifest(d: Dict[str, Any]) -> Resource:
     """Build a typed resource from a parsed manifest dict."""
     kind = d.get("kind")

@@ -1,0 +1,390 @@
+"""kfx apiserver + dashboard-lite — the platform's HTTP surface.
+
+Server mode (`kfx server`) hosts a persistent ControlPlane behind:
+
+* a REST API (the k8s-apiserver seam of the reference stack, SURVEY.md §1
+  L0): list/get/apply/delete resources, events, replica logs. Other kfx
+  invocations can point at it with ``KFX_SERVER=http://host:port`` and
+  become thin HTTP clients (the kubectl model).
+* a read-only HTML dashboard (the centraldashboard equivalent, SURVEY.md
+  §2.2): every resource with state/conditions, per-resource pages with
+  events and the chief log tail.
+
+Routes:
+  GET    /healthz                                 liveness
+  GET    /version
+  GET    /apis                                    registered kinds
+  GET    /apis/{kind}[?namespace=ns]              list (JSON)
+  GET    /apis/{kind}/{ns}/{name}                 object (JSON)
+  GET    /apis/{kind}/{ns}/{name}/events          events (JSON)
+  GET    /apis/{kind}/{ns}/{name}/logs[?replica=] log text
+  POST   /apis                                    apply YAML manifests
+  DELETE /apis/{kind}/{ns}/{name}                 delete
+  GET    /                                        dashboard (HTML)
+  GET    /ui/{kind}/{ns}/{name}                   resource page (HTML)
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .api.base import (
+    ValidationError,
+    display_state,
+    registered_kinds,
+    resource_class,
+)
+from .api.manifest import load_manifests
+from .controlplane import ControlPlane
+from .core.store import AlreadyExists, Conflict, NotFound
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "kfx-apiserver"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def cp(self) -> ControlPlane:
+        return self.server.cp  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- plumbing -----------------------------------------------------------
+    def _drain(self) -> None:
+        """Consume an unread request body so keep-alive connections stay
+        in sync (an error response must not leave body bytes to be parsed
+        as the next request line)."""
+        if getattr(self, "_body_consumed", False):
+            return
+        self._body_consumed = True
+        length = int(self.headers.get("Content-Length") or 0)
+        while length > 0:
+            chunk = self.rfile.read(min(length, 65536))
+            if not chunk:
+                break
+            length -= len(chunk)
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: Optional[dict] = None) -> None:
+        self._drain()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, payload) -> None:
+        self._send(code, json.dumps(payload, indent=1).encode(),
+                   "application/json")
+
+    def _text(self, code: int, text: str) -> None:
+        self._send(code, text.encode(), "text/plain; charset=utf-8")
+
+    def _html(self, code: int, body: str) -> None:
+        self._send(code, body.encode(), "text/html; charset=utf-8")
+
+    def _error(self, code: int, msg: str) -> None:
+        self._json(code, {"error": msg})
+
+    # -- verbs --------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if url.path == "/healthz":
+                return self._text(200, "ok")
+            if url.path == "/version":
+                from . import __version__
+
+                return self._json(200, {"version": __version__})
+            if not parts:  # dashboard root
+                return self._html(200, self._dashboard())
+            if parts[0] == "ui" and len(parts) == 4:
+                return self._html(200, self._resource_page(*parts[1:]))
+            if parts[0] == "apis":
+                return self._get_apis(parts[1:], q)
+            return self._error(404, f"no route {url.path}")
+        except (NotFound, KeyError) as e:
+            return self._error(404, str(e.args[0] if e.args else e))
+        except Exception as e:  # never abort the connection mid-response
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def _get_apis(self, parts: List[str], q) -> None:
+        if not parts:
+            return self._json(200, {"kinds": registered_kinds()})
+        cls = resource_class(parts[0])
+        if len(parts) == 1:
+            ns = (q.get("namespace") or [None])[0]
+            objs = self.cp.store.list(cls.KIND, ns)
+            return self._json(200, {"kind": cls.KIND,
+                                    "items": [o.to_dict() for o in objs]})
+        if len(parts) == 3:
+            ns, name = parts[1], parts[2]
+            return self._json(
+                200, self.cp.store.get(cls.KIND, name, ns).to_dict())
+        if len(parts) == 4 and parts[3] == "events":
+            ns, name = parts[1], parts[2]
+            self.cp.store.get(cls.KIND, name, ns)  # 404 on absence
+            evs = self.cp.store.events_for(cls.KIND, f"{ns}/{name}")
+            return self._json(200, {"events": [
+                {"timestamp": e.timestamp, "type": e.type,
+                 "reason": e.reason, "message": e.message} for e in evs]})
+        if len(parts) == 4 and parts[3] == "logs":
+            ns, name = parts[1], parts[2]
+            replica = (q.get("replica") or [""])[0]
+            offset = int((q.get("offset") or ["0"])[0])
+            # job_logs_from returns ("", offset) before the gang has
+            # written anything — pollers between apply and launch get an
+            # empty 200, never an aborted connection.
+            text, new_off = self.cp.job_logs_from(
+                cls.KIND, name, ns, replica, offset)
+            body = text.encode()
+            self._drain()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Kfx-Log-Offset", str(new_off))
+            self.end_headers()
+            self.wfile.write(body)
+            return None
+        return self._error(404, f"no route /apis/{'/'.join(parts)}")
+
+    def do_POST(self):  # noqa: N802
+        url = urlparse(self.path)
+        if url.path != "/apis":
+            return self._error(404, f"no route {url.path}")
+        length = int(self.headers.get("Content-Length") or 0)
+        text = self.rfile.read(length).decode()
+        self._body_consumed = True
+        try:
+            applied = self.cp.apply(load_manifests(text))
+        except (ValidationError, Conflict, AlreadyExists, KeyError) as e:
+            return self._error(400, str(e))
+        except Exception as e:
+            return self._error(500, f"{type(e).__name__}: {e}")
+        return self._json(200, {"applied": [
+            {"kind": o.KIND, "name": o.name, "namespace": o.namespace,
+             "verb": verb} for o, verb in applied]})
+
+    def do_DELETE(self):  # noqa: N802
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        if len(parts) != 4 or parts[0] != "apis":
+            return self._error(404, f"no route {self.path}")
+        try:
+            cls = resource_class(parts[1])
+            self.cp.store.delete(cls.KIND, parts[3], parts[2])
+        except (NotFound, KeyError) as e:
+            return self._error(404, str(e.args[0] if e.args else e))
+        return self._json(200, {"deleted": f"{parts[1]}/{parts[3]}"})
+
+    # -- dashboard ----------------------------------------------------------
+    _STYLE = """
+    body{font-family:system-ui,sans-serif;margin:2em;color:#1a1a2e}
+    h1{font-size:1.4em} h2{font-size:1.1em;margin-top:1.4em}
+    table{border-collapse:collapse;min-width:40em}
+    th,td{text-align:left;padding:.3em .8em;border-bottom:1px solid #ddd}
+    th{background:#f4f4f8} a{color:#2149b0;text-decoration:none}
+    .Succeeded,.Ready{color:#137a23}.Failed{color:#b01313}
+    .Running{color:#2149b0} pre{background:#f7f7f9;padding:1em;
+    overflow-x:auto;border:1px solid #e2e2ea}
+    """
+
+    def _page(self, title: str, body: str) -> str:
+        return (f"<!doctype html><html><head><meta charset='utf-8'>"
+                f"<title>{html.escape(title)}</title>"
+                f"<style>{self._STYLE}</style></head><body>"
+                f"<h1><a href='/'>kfx</a> — {html.escape(title)}</h1>"
+                f"{body}</body></html>")
+
+    def _dashboard(self) -> str:
+        out = []
+        for kind in registered_kinds():
+            objs = self.cp.store.list(kind)
+            if not objs:
+                continue
+            rows = []
+            for o in objs:
+                st = display_state(o.conditions)
+                url = f"/ui/{kind.lower()}/{o.namespace}/{o.name}"
+                rows.append(
+                    f"<tr><td><a href='{url}'>{html.escape(o.name)}</a></td>"
+                    f"<td>{html.escape(o.namespace)}</td>"
+                    f"<td class='{st}'>{st}</td>"
+                    f"<td>{o.status.get('restartCount', 0)}</td></tr>")
+            out.append(
+                f"<h2>{kind}</h2><table><tr><th>name</th><th>namespace"
+                f"</th><th>state</th><th>restarts</th></tr>"
+                + "".join(rows) + "</table>")
+        if not out:
+            out.append("<p>no resources — <code>kfx apply -f …</code> "
+                       "to create some.</p>")
+        return self._page("dashboard", "".join(out))
+
+    def _resource_page(self, kind: str, ns: str, name: str) -> str:
+        cls = resource_class(kind)
+        obj = self.cp.store.get(cls.KIND, name, ns)
+        body = [f"<h2>conditions</h2><table><tr><th>type</th><th>status"
+                f"</th><th>reason</th><th>message</th></tr>"]
+        for c in obj.conditions:
+            body.append(f"<tr><td>{html.escape(c.type)}</td>"
+                        f"<td>{html.escape(c.status)}</td>"
+                        f"<td>{html.escape(c.reason or '')}</td>"
+                        f"<td>{html.escape(c.message or '')}</td></tr>")
+        body.append("</table><h2>events</h2><table><tr><th>time</th>"
+                    "<th>type</th><th>reason</th><th>message</th></tr>")
+        for e in self.cp.store.events_for(cls.KIND, f"{ns}/{name}"):
+            body.append(f"<tr><td>{html.escape(e.timestamp)}</td>"
+                        f"<td>{html.escape(e.type)}</td>"
+                        f"<td>{html.escape(e.reason)}</td>"
+                        f"<td>{html.escape(e.message)}</td></tr>")
+        body.append("</table>")
+        try:
+            log = self.cp.job_logs(cls.KIND, name, ns, "")
+            if log:
+                tail = log[-8000:]
+                body.append(f"<h2>log (chief, tail)</h2>"
+                            f"<pre>{html.escape(tail)}</pre>")
+        except Exception:  # non-job kinds / no log yet: page still renders
+            pass
+        body.append(f"<h2>spec</h2><pre>{html.escape(json.dumps(obj.spec, indent=1))}"
+                    f"</pre>")
+        return self._page(f"{cls.KIND} {ns}/{name}", "".join(body))
+
+
+class ApiServer:
+    """The HTTP front of a ControlPlane; embeddable (tests) or run via
+    serve_forever (the `kfx server` verb)."""
+
+    def __init__(self, cp: ControlPlane, port: int = 8134,
+                 host: str = "127.0.0.1"):
+        self.cp = cp
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.cp = cp  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="kfx-apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class ApiError(Exception):
+    """Non-2xx from the apiserver, carrying (status, message)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class Client:
+    """Thin HTTP client over the REST routes — what a kfx invocation
+    becomes when ``KFX_SERVER`` points at a running `kfx server` (the
+    kubectl model: state and gangs live in the server process)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, path: str, data: Optional[bytes] = None,
+              method: str = "GET") -> Tuple[int, str, dict]:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read().decode(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            body = e.read().decode()
+            try:
+                msg = json.loads(body).get("error", body)
+            except (json.JSONDecodeError, ValueError):
+                msg = body
+            raise ApiError(e.code, msg) from None
+
+    def _json(self, path: str, **kw):
+        return json.loads(self._call(path, **kw)[1])
+
+    def healthy(self) -> bool:
+        try:
+            return self._call("/healthz")[0] == 200
+        except Exception:
+            return False
+
+    def apply_text(self, text: str) -> List[dict]:
+        return self._json("/apis", data=text.encode(),
+                          method="POST")["applied"]
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        q = f"?namespace={namespace}" if namespace else ""
+        return self._json(f"/apis/{kind}{q}")["items"]
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        return self._json(f"/apis/{kind}/{namespace}/{name}")
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._call(f"/apis/{kind}/{namespace}/{name}", method="DELETE")
+
+    def logs(self, kind: str, namespace: str, name: str,
+             replica: str = "") -> str:
+        q = f"?replica={replica}" if replica else ""
+        return self._call(f"/apis/{kind}/{namespace}/{name}/logs{q}")[1]
+
+    def logs_from(self, kind: str, namespace: str, name: str,
+                  replica: str, offset: int) -> Tuple[str, int]:
+        """Incremental tail (mirrors ControlPlane.job_logs_from): text
+        from byte ``offset`` plus the next offset, so pollers never
+        re-download the whole log."""
+        _, text, headers = self._call(
+            f"/apis/{kind}/{namespace}/{name}/logs"
+            f"?replica={replica}&offset={offset}")
+        return text, int(headers.get("X-Kfx-Log-Offset") or offset)
+
+    def events(self, kind: str, namespace: str, name: str) -> List[dict]:
+        return self._json(f"/apis/{kind}/{namespace}/{name}/events")["events"]
+
+
+def serve_forever(home: Optional[str] = None, port: int = 8134) -> int:
+    with ControlPlane(home=home, journal=True) as cp:
+        server = ApiServer(cp, port=port)
+        print(f"kfx apiserver + dashboard on {server.url} "
+              f"(KFX_SERVER={server.url} for client mode)", flush=True)
+        try:
+            server.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.httpd.server_close()
+    return 0

@@ -46,12 +46,9 @@ def _fmt_age(created: str) -> str:
 
 
 def _job_state(obj: Resource) -> str:
-    order = ["Failed", "Succeeded", "Restarting", "Suspended", "Running",
-             "Created"]
-    for c in order:
-        if obj.has_condition(c):
-            return c
-    return "Pending"
+    from .api.base import display_state
+
+    return display_state(obj.conditions)
 
 
 def _print_table(rows: List[List[str]], headers: List[str]) -> None:
@@ -71,9 +68,16 @@ class KfxCLI:
 
     # -- verbs --------------------------------------------------------------
     def apply(self, paths: List[str]) -> List[Resource]:
+        from .api.base import from_manifest
+        from .kfctl import expand_manifest_file
+
         out = []
         for path in paths:
-            for obj, verb in self.cp.apply_file(path):
+            # KfDef documents expand client-side into their rendered
+            # platform resources (kfctl model; see kubeflow_tpu.kfctl).
+            resources = [from_manifest(d)
+                         for d in expand_manifest_file(path)]
+            for obj, verb in self.cp.apply(resources):
                 print(f"{obj.KIND.lower()}/{obj.name} {verb}")
                 out.append(obj)
         return out
@@ -193,6 +197,44 @@ class KfxCLI:
             print(f"{e.timestamp} {e.type} {e.reason}: {e.message}")
         return 0
 
+    def profile(self, kind: str, name: str, namespace: str, replica: str,
+                duration_ms: int, logdir: str) -> int:
+        """Capture a jax.profiler trace from a running replica (SURVEY.md
+        §5.1: `kfx profile <job>` → TensorBoard-loadable xplane dump).
+
+        Works cross-process: the workdir where workers advertise their
+        profiler ports is derived from the store, so a passive kfx
+        invocation can profile a job owned by `kfx server` (or another
+        `kfx run`) on the same host."""
+        from .profiling import capture_trace, replica_port
+
+        cls = resource_class(kind)
+        job = self.cp.store.get(cls.KIND, name, namespace)
+        key = f"{cls.KIND.lower()}/{namespace}/{name}"
+        gang = self.cp.gangs.get(key)
+        workdir = gang.workdir if gang else self.cp.gangs.workdir_for(key)
+        if not replica:
+            if gang is not None:
+                chief = gang.chief_replica_type
+            elif isinstance(job, TrainingJob):
+                chief = job.chief_replica_type()
+            else:
+                chief = "worker"
+            replica = f"{chief.lower()}-0"
+        port = replica_port(workdir, replica)
+        if port is None:
+            print(f"replica {replica} of {key} has not advertised a "
+                  f"profiler port (job not running, started with "
+                  f"KFX_PROFILE=0, or still initialising?)",
+                  file=sys.stderr)
+            return 1
+        out = logdir or os.path.join(workdir, "profiler", "traces")
+        paths = capture_trace(f"localhost:{port}", out, duration_ms)
+        for p in paths:
+            print(p)
+        print(f"trace captured: point tensorboard --logdir at {out}")
+        return 0
+
     def kill_replica(self, kind: str, name: str, namespace: str,
                      replica: str) -> int:
         """Fault-injection hook (SURVEY.md §5.3: `kfx kill-worker`)."""
@@ -256,8 +298,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("name")
     sp.add_argument("replica")
 
+    sp = sub.add_parser(
+        "profile", help="capture a jax.profiler trace from a running job")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    sp.add_argument("--replica", default="",
+                    help="replica id, e.g. worker-1 (default: chief-0)")
+    sp.add_argument("--duration-ms", type=int, default=2000)
+    sp.add_argument("--logdir", default="",
+                    help="output dir (default <job workdir>/profiler/traces)")
+
     sp = sub.add_parser("server", help="run the persistent control plane")
     sp.add_argument("--port", type=int, default=8134)
+
+    sp = sub.add_parser("init", help="scaffold a KfDef platform config")
+    sp.add_argument("name")
+    sp.add_argument("-o", "--output", default="kfdef.yaml")
+    sp.add_argument("--platform-namespace", default=None)
+
+    sp = sub.add_parser(
+        "generate", help="render a KfDef to per-resource manifests")
+    sp.add_argument("-f", "--filename", required=True)
+    sp.add_argument("-o", "--output", default="manifests")
 
     sub.add_parser("version", help="print version")
     return p
@@ -292,6 +354,25 @@ def _main(argv: Optional[List[str]] = None) -> int:
 
         print(f"kfx {__version__}")
         return 0
+    if args.cmd == "init":
+        from .kfctl import init_scaffold
+
+        if os.path.exists(args.output):
+            print(f"error: {args.output} already exists", file=sys.stderr)
+            return 1
+        with open(args.output, "w") as f:
+            f.write(init_scaffold(args.name, args.platform_namespace))
+        print(f"wrote {args.output}")
+        return 0
+    if args.cmd == "generate":
+        from .kfctl import generate
+
+        for p in generate(args.filename, args.output):
+            print(p)
+        return 0
+    if os.environ.get("KFX_SERVER") and args.cmd in (
+            "apply", "run", "get", "describe", "delete", "logs", "events"):
+        return _remote_main(args)
     if args.cmd == "server":
         try:
             from .apiserver import serve_forever
@@ -301,7 +382,14 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return 1
         return serve_forever(home=args.home, port=args.port)
 
-    with ControlPlane(home=args.home, journal=True) as cp:
+    # Verbs that don't launch work must never reconcile: a second control
+    # plane on the same home would adopt Running jobs and spawn duplicate
+    # gangs next to their owner. delete is store-only (an owning server
+    # observes it through its own store watch); kill-replica only acts on
+    # gangs this process owns.
+    passive = args.cmd in ("get", "describe", "logs", "events", "profile",
+                           "delete", "kill-replica")
+    with ControlPlane(home=args.home, journal=True, passive=passive) as cp:
         cli = KfxCLI(cp)
         if args.cmd == "apply":
             if args.wait:
@@ -338,7 +426,147 @@ def _main(argv: Optional[List[str]] = None) -> int:
         if args.cmd == "kill-replica":
             return cli.kill_replica(args.kind, args.name, args.namespace,
                                     args.replica)
+        if args.cmd == "profile":
+            return cli.profile(args.kind, args.name, args.namespace,
+                               args.replica, args.duration_ms, args.logdir)
     return 0
+
+
+def _dict_state(obj: dict) -> str:
+    from .api.base import display_state
+
+    return display_state(obj.get("status", {}).get("conditions", []))
+
+
+def _remote_main(args) -> int:
+    """Thin-client mode: KFX_SERVER points at a running `kfx server`;
+    state and gangs live there (the kubectl model — see apiserver)."""
+    import urllib.error
+
+    from .apiserver import ApiError, Client
+
+    url = os.environ["KFX_SERVER"]
+    client = Client(url)
+    try:
+        return _remote_dispatch(client, args)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+        reason = getattr(e, "reason", e)
+        print(f"error: cannot reach kfx server at {url}: {reason} "
+              f"(is `kfx server` running? unset KFX_SERVER for local mode)",
+              file=sys.stderr)
+        return 1
+
+
+def _remote_dispatch(client, args) -> int:
+    if args.cmd in ("apply", "run"):
+        import yaml
+
+        from .kfctl import expand_manifest_file
+
+        applied = []
+        for path in args.filename:
+            # KfDef expands client-side (kfctl model); the server receives
+            # plain rendered resources.
+            text = "---\n".join(
+                yaml.safe_dump(d, sort_keys=False)
+                for d in expand_manifest_file(path))
+            for item in client.apply_text(text):
+                print(f"{item['kind'].lower()}/{item['name']} "
+                      f"{item['verb']}")
+                applied.append(item)
+        wait = args.cmd == "run" or getattr(args, "wait", False)
+        if not wait:
+            return 0
+        follow = args.cmd == "run" and not getattr(args, "no_follow", False)
+        return _remote_wait(client, applied, args.timeout, follow)
+    if args.cmd == "get":
+        if args.name:
+            objs = [client.get(args.kind, args.namespace, args.name)]
+        else:
+            objs = client.list(args.kind, args.namespace)
+        if args.output == "json":
+            print(json.dumps(objs[0] if args.name else objs, indent=2))
+        elif args.output == "yaml":
+            import yaml
+
+            print("---\n".join(yaml.safe_dump(o, sort_keys=False)
+                               for o in objs), end="")
+        else:
+            rows = [[o["metadata"]["name"], _dict_state(o),
+                     str(o.get("status", {}).get("restartCount", 0)),
+                     _fmt_age(o["metadata"].get("creationTimestamp", ""))]
+                    for o in objs]
+            _print_table(rows, ["NAME", "STATE", "RESTARTS", "AGE"])
+        return 0
+    if args.cmd == "describe":
+        import yaml
+
+        obj = client.get(args.kind, args.namespace, args.name)
+        print(yaml.safe_dump(obj, sort_keys=False), end="")
+        events = client.events(args.kind, args.namespace, args.name)
+        if events:
+            print("events:")
+            for e in events:
+                print(f"  {e['timestamp']} {e['type']} {e['reason']}: "
+                      f"{e['message']}")
+        return 0
+    if args.cmd == "delete":
+        client.delete(args.kind, args.namespace, args.name)
+        print(f"{args.kind.lower()}/{args.name} deleted")
+        return 0
+    if args.cmd == "logs":
+        print(client.logs(args.kind, args.namespace, args.name,
+                          args.replica), end="")
+        return 0
+    if args.cmd == "events":
+        for e in client.events(args.kind, args.namespace, args.name):
+            print(f"{e['timestamp']} {e['type']} {e['reason']}: "
+                  f"{e['message']}")
+        return 0
+    raise AssertionError(f"unhandled remote cmd {args.cmd}")
+
+
+def _remote_wait(client, applied: List[dict], timeout: float,
+                 follow: bool) -> int:
+    from .apiserver import ApiError
+
+    rc = 0
+    for item in applied:
+        kind, ns, name = item["kind"], item["namespace"], item["name"]
+        try:
+            is_job = issubclass(resource_class(kind), TrainingJob)
+        except KeyError:
+            continue
+        if not is_job and kind != "Experiment":
+            continue
+        deadline = time.monotonic() + timeout
+        offset = 0
+        state = "Pending"
+        while time.monotonic() < deadline:
+            obj = client.get(kind, ns, name)
+            if follow and is_job:  # experiments have no chief log
+                try:
+                    text, offset = client.logs_from(kind, ns, name, "",
+                                                    offset)
+                except ApiError:
+                    text = ""
+                if text:
+                    sys.stdout.write(text)
+                    sys.stdout.flush()
+            state = _dict_state(obj)
+            if state in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.3)
+        else:
+            raise SystemExit(f"timeout: {kind} {ns}/{name} still {state} "
+                             f"after {timeout}s")
+        print(f"{kind.lower()}/{name} {state.lower()}")
+        if state != "Succeeded":
+            rc = 1
+    return rc
 
 
 def _wait_jobs(cli: KfxCLI, jobs: List[TrainingJob], timeout: float) -> int:

@@ -36,7 +36,13 @@ class ControlPlane:
     """
 
     def __init__(self, home: Optional[str] = None, journal: bool = False,
-                 worker_platform: Optional[str] = None):
+                 worker_platform: Optional[str] = None,
+                 passive: bool = False):
+        # passive: load state but never start reconcile loops. Read-only
+        # CLI verbs (get/logs/events/profile) use this so a second kfx
+        # process on the same home cannot adopt Running jobs and spawn
+        # duplicate gangs next to the process that owns them.
+        self.passive = passive
         self.home = os.path.abspath(home or default_home())
         os.makedirs(self.home, exist_ok=True)
         journal_path = os.path.join(self.home, "state.db") if journal else None
@@ -81,8 +87,9 @@ class ControlPlane:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ControlPlane":
-        self.manager.start()
-        self._started = True
+        if not self.passive:
+            self.manager.start()
+            self._started = True
         return self
 
     def stop(self) -> None:

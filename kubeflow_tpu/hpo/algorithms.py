@@ -7,7 +7,8 @@ assignments. All algorithms are deterministic given (seed, history).
 
 Implemented: random, grid, tpe (Bergstra-style two-density), bayesian
 (GP + expected improvement), cmaes ((μ/λ) covariance adaptation),
-hyperband (successive-halving brackets via a resource parameter).
+hyperband (successive-halving brackets via a resource parameter),
+regularizedevolution (aging-evolution NAS over architecture genomes).
 """
 
 from __future__ import annotations
@@ -350,12 +351,73 @@ class Hyperband(Algorithm):
         return hashlib.md5(repr(items).encode()).hexdigest()
 
 
+class RegularizedEvolution(Algorithm):
+    """NAS-class search: aging (regularized) evolution over the parameter
+    space treated as an architecture genome (AmoebaNet-style; this is the
+    algorithm class behind Katib's NAS suggestion services, SURVEY.md §2.2
+    suggestion-services row — ENAS/DARTS need a trainable supernet, which
+    is a trial-side concern; the suggestion-side contract is a discrete
+    architecture search, which aging evolution serves).
+
+    Population = the `population_size` most recent completed trials (old
+    architectures age out regardless of fitness — the "regularized" part).
+    Each suggestion tournament-selects a parent from `tournament_size`
+    random members and mutates exactly one gene: a categorical/int choice
+    resamples, a continuous gene takes a Gaussian step in unit space.
+    """
+
+    name = "regularizedevolution"
+
+    def __init__(self, parameters, settings=None, objective_type="maximize",
+                 seed: int = 0):
+        super().__init__(parameters, settings or {}, objective_type, seed)
+        self.population_size = int(self.settings.get("population_size", 20))
+        self.tournament_size = int(self.settings.get("tournament_size", 5))
+        self.mutation_sigma = float(self.settings.get("mutation_sigma", 0.15))
+
+    def _mutate(self, assignment: Assignment,
+                rng: np.random.Generator) -> Assignment:
+        x = self.space.encode(assignment)
+        j = int(rng.integers(0, self.space.dim()))
+        p = self.space.params[j]
+        if p.get("parameterType") in ("int", "double"):
+            x[j] = float(np.clip(x[j] + rng.normal(0, self.mutation_sigma),
+                                 0.0, 1.0))
+        else:
+            n = len(p["_list"])
+            if n > 1:
+                cur = min(int(x[j] * n), n - 1)
+                nxt = int(rng.integers(0, n - 1))
+                nxt += nxt >= cur  # uniform over the OTHER choices
+                x[j] = (nxt + 0.5) / n
+        return self.space.decode(x)
+
+    def suggest(self, trials, count):
+        rng = self._rng(len(trials))
+        done = [t for t in trials if t.get("value") is not None]
+        out = []
+        sign = 1.0 if self.maximize else -1.0
+        # trial order IS age: the store hands history oldest-first
+        population = done[-self.population_size:]
+        for _ in range(count):
+            if len(population) < self.tournament_size:
+                out.append(self.space.sample(rng))  # warmup: random cohort
+                continue
+            picks = rng.choice(len(population), size=self.tournament_size,
+                               replace=False)
+            parent = max((population[i] for i in picks),
+                         key=lambda t: sign * float(t["value"]))
+            out.append(self._mutate(parent["assignments"], rng))
+        return out
+
+
 _ALGORITHMS = {cls.name: cls for cls in
                (RandomSearch, GridSearch, TPE, BayesianOptimization, CMAES,
-                Hyperband)}
+                Hyperband, RegularizedEvolution)}
 # Katib aliases
 _ALGORITHMS["bayesian"] = BayesianOptimization
 _ALGORITHMS["skopt"] = BayesianOptimization
+_ALGORITHMS["nas"] = RegularizedEvolution
 
 
 def algorithm_names() -> List[str]:

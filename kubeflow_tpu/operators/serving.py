@@ -221,7 +221,9 @@ class InferenceServiceController(Controller):
                     rev.teardown()
                     del rt.revisions[rev_name]
                 continue
-            model_dir = _resolve_storage_uri(spec_storage_uri(spec))
+            model_dir = _resolve_storage_uri(
+                spec_storage_uri(spec),
+                os.path.join(self.home, "storage-cache"))
             batcher = spec.get("batcher")
             device = str(spec.get("device", "auto"))
             if rev is None or rev.model_dir != model_dir \
@@ -327,14 +329,12 @@ def spec_storage_uri(spec: dict) -> str:
     return str(spec.get("storageUri", ""))
 
 
-def _resolve_storage_uri(uri: str) -> str:
-    """storage-initializer equivalent: resolve a URI to a local dir.
-    file:// and bare paths are native; other schemes would download here."""
-    if uri.startswith("file://"):
-        return uri[len("file://"):]
-    if "://" in uri:
-        raise ValueError(f"unsupported storageUri scheme: {uri}")
-    return uri
+def _resolve_storage_uri(uri: str, cache_dir: str) -> str:
+    """Storage-initializer equivalent (serving/storage.py): resolve a URI
+    to a local export dir, downloading remote schemes into the cache."""
+    from ..serving.storage import initialize
+
+    return initialize(uri, cache_dir)
 
 
 def serving_controllers(store: ResourceStore, home: str) -> List[Controller]:

@@ -87,8 +87,11 @@ class TrainingControllerBase(Controller):
 
     # -- per-kind contract --------------------------------------------------
     def build_specs(self, job: T.TrainingJob, workdir: str) -> Tuple[
-            List[G.ProcessSpec], Optional[Callable[[int], Dict[str, str]]]]:
-        """Return (process specs, per-attempt env hook) for this job."""
+            List[G.ProcessSpec],
+            Optional[Callable[[int], Dict[str, Dict[str, str]]]]]:
+        """Return (process specs, per-attempt env hook). The hook's dict
+        is keyed by replica id, with "*" applying to every member (see
+        Gang.restart_env_hook)."""
         raise NotImplementedError
 
     def platform_for(self, job: T.TrainingJob) -> str:
@@ -311,37 +314,48 @@ class JAXJobController(TrainingControllerBase):
                 argv=rs.argv() or list(_PLACEHOLDER_ARGV), env=env,
                 cwd=rs.working_dir()))
 
-        def env_hook(attempt: int) -> Dict[str, str]:
-            return {rdv.ENV_COORDINATOR: f"127.0.0.1:{free_port()}"}
+        def env_hook(attempt: int) -> Dict[str, Dict[str, str]]:
+            return {"*": {rdv.ENV_COORDINATOR: f"127.0.0.1:{free_port()}"}}
 
         return specs, env_hook
 
 
 class TFJobController(TrainingControllerBase):
-    """tf-operator parity: builds the cluster spec once (stable ports) and
-    injects per-task ``TF_CONFIG`` (genTFConfig)."""
+    """tf-operator parity: injects per-task ``TF_CONFIG`` (genTFConfig).
+
+    Cluster ports are allocated by the per-attempt env hook at the moment
+    the gang launches — not at spec-build time — so the unbound-port
+    window is milliseconds, and every restart (including one caused by a
+    port collision crashing a TF server) rendezvouses on fresh ports.
+    A user-supplied TF_CONFIG in the replica env always wins."""
 
     KIND = "TFJob"
     JOB_CLASS = T.TFJob
 
     def build_specs(self, job, workdir):
         members = self._member_layout(job)
-        cluster: Dict[str, List[str]] = {}
-        addr: Dict[Tuple[str, int], str] = {}
-        for rtype, idx, _ in members:
-            a = f"127.0.0.1:{free_port()}"
-            cluster.setdefault(rtype, []).append(a)
-            addr[(rtype, idx)] = a
         specs = []
         for rtype, idx, _ in members:
             rs = job.replica_specs()[rtype]
-            env = rdv.tf_env(cluster, rtype, idx)
-            env.update(rs.env())
             specs.append(G.ProcessSpec(
                 replica_type=rtype, index=idx,
-                argv=rs.argv() or list(_PLACEHOLDER_ARGV), env=env,
+                argv=rs.argv() or list(_PLACEHOLDER_ARGV), env=rs.env(),
                 cwd=rs.working_dir()))
-        return specs, None
+
+        def env_hook(attempt: int) -> Dict[str, Dict[str, str]]:
+            cluster: Dict[str, List[str]] = {}
+            for rtype, idx, _ in members:
+                cluster.setdefault(rtype, []).append(
+                    f"127.0.0.1:{free_port()}")
+            over: Dict[str, Dict[str, str]] = {}
+            for rtype, idx, _ in members:
+                if "TF_CONFIG" in job.replica_specs()[rtype].env():
+                    continue
+                over[f"{rtype.lower()}-{idx}"] = rdv.tf_env(
+                    cluster, rtype, idx)
+            return over
+
+        return specs, env_hook
 
 
 class PyTorchJobController(TrainingControllerBase):
@@ -365,8 +379,8 @@ class PyTorchJobController(TrainingControllerBase):
                 argv=rs.argv() or list(_PLACEHOLDER_ARGV), env=env,
                 cwd=rs.working_dir()))
 
-        def env_hook(attempt: int) -> Dict[str, str]:
-            return {"MASTER_PORT": str(free_port())}
+        def env_hook(attempt: int) -> Dict[str, Dict[str, str]]:
+            return {"*": {"MASTER_PORT": str(free_port())}}
 
         return specs, env_hook
 

@@ -1,0 +1,96 @@
+"""Profiling subsystem (SURVEY.md §5.1 TPU contract): a `jax.profiler`
+trace server in every worker + `kfx profile <job>` capturing
+TensorBoard-loadable xplane dumps.
+
+Server side — runners call :func:`maybe_start_profiler_server` right
+after backend init. Unless ``KFX_PROFILE=0``, it starts
+``jax.profiler.start_server`` on a free port and advertises the port in
+``<KFX_WORKDIR>/profiler/<replica>.port`` so the control plane can find
+it without pre-allocating ports in the job spec (no spec-time port
+race — the runner binds first, then advertises).
+
+Client side — :func:`capture_trace` speaks the profiler protocol to a
+worker's trace server (via the TF profiler client; jax's server is the
+same tsl/xla profiler service) and writes the standard TensorBoard
+``plugins/profile/<run>/`` layout: ``*.xplane.pb`` per host.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional
+
+ENV_PROFILE = "KFX_PROFILE"
+PORT_DIR = "profiler"
+TRACE_DIR = "traces"
+
+
+def _replica_id() -> str:
+    rtype = os.environ.get("KFX_REPLICA_TYPE", "worker").lower()
+    ridx = os.environ.get("KFX_REPLICA_INDEX", "0")
+    return f"{rtype}-{ridx}"
+
+
+def port_file(workdir: str, replica: str) -> str:
+    return os.path.join(workdir, PORT_DIR, f"{replica}.port")
+
+
+def maybe_start_profiler_server() -> Optional[int]:
+    """Start the per-worker trace server (idempotent, opt-out via
+    KFX_PROFILE=0). Returns the port, or None when disabled."""
+    if os.environ.get(ENV_PROFILE, "1") == "0":
+        return None
+    import jax
+
+    from .utils.net import free_port
+
+    port = free_port()
+    try:
+        jax.profiler.start_server(port)
+    except Exception:  # profiler service unavailable on this backend
+        return None
+    workdir = os.environ.get("KFX_WORKDIR")
+    if not workdir:
+        # Direct runner invocation (no gang): the server runs, but there
+        # is no job workdir to advertise in — never pollute the cwd.
+        return port
+    path = port_file(workdir, _replica_id())
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, path)  # atomic: readers never see a partial write
+    except OSError:
+        pass  # server still reachable if the caller knows the port
+    return port
+
+
+def replica_port(workdir: str, replica: str) -> Optional[int]:
+    try:
+        with open(port_file(workdir, replica)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def capture_trace(service_addr: str, logdir: str,
+                  duration_ms: int = 2000) -> List[str]:
+    """Grab a trace from a running worker's profiler server into
+    ``logdir`` (TensorBoard layout). Returns the xplane dump paths.
+
+    The TF profiler client is imported lazily — it is only needed in the
+    CLI process, never in workers.
+    """
+    os.makedirs(logdir, exist_ok=True)
+    from tensorflow.python.profiler import profiler_client
+
+    profiler_client.trace(service_addr, logdir, duration_ms)
+    dumps = sorted(glob.glob(os.path.join(
+        logdir, "plugins", "profile", "*", "*.xplane.pb")))
+    if not dumps:
+        raise RuntimeError(
+            f"profiler at {service_addr} returned no xplane dump under "
+            f"{logdir} (was the worker idle for the whole window?)")
+    return dumps

@@ -90,6 +90,10 @@ def main(argv=None) -> int:
 
     enable_compile_cache()
 
+    from kubeflow_tpu.profiling import maybe_start_profiler_server
+
+    maybe_start_profiler_server()
+
     from kubeflow_tpu.data import get_dataset
     from kubeflow_tpu.models import get_model
     from kubeflow_tpu.training import Checkpointer, TrainLoop

@@ -56,6 +56,10 @@ def main(argv=None) -> int:
 
     enable_compile_cache()
 
+    from ..profiling import maybe_start_profiler_server
+
+    maybe_start_profiler_server()
+
     from ..data.lm import get_lm_dataset
     from ..models.transformer import preset_config
     from ..parallel.lm_train import LMHyperParams, LMTrainLoop

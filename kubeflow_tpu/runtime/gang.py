@@ -106,7 +106,8 @@ class Gang:
         clean_policy: str = T.CLEAN_POD_RUNNING,
         chief_replica_type: str = "",
         on_change: Optional[Callable[["Gang"], None]] = None,
-        restart_env_hook: Optional[Callable[[int], Dict[str, str]]] = None,
+        restart_env_hook: Optional[
+            Callable[[int], Dict[str, Dict[str, str]]]] = None,
     ):
         self.name = name
         self.specs = specs
@@ -118,8 +119,12 @@ class Gang:
         self.chief_replica_type = chief_replica_type or (
             specs[0].replica_type if specs else "")
         self.on_change = on_change
-        # Called with the attempt number before each (re)launch; returns env
-        # overrides (used to re-allocate the jax.distributed coordinator port).
+        # Called with the attempt number before each (re)launch; returns
+        # env overrides keyed by replica id — used to re-allocate
+        # rendezvous ports so a restart (or a port-collision crash) always
+        # gets fresh ones. The key "*" applies to every member; a replica
+        # id key (e.g. "worker-1") applies to that member only, on top of
+        # "*" (TF_CONFIG differs per task). Values are {VAR: value} dicts.
         self.restart_env_hook = restart_env_hook
 
         self._lock = threading.RLock()
@@ -180,7 +185,8 @@ class Gang:
             for spec in self.specs:
                 env = dict(os.environ)
                 env.update(spec.env)
-                env.update(overrides)
+                env.update(overrides.get("*", {}))
+                env.update(overrides.get(spec.id, {}))
                 logf = open(self.log_path(spec.id), "ab")
                 logf.write(
                     f"==== attempt {attempt} {time.strftime('%Y-%m-%dT%H:%M:%S')}"

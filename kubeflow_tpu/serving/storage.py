@@ -1,0 +1,108 @@
+"""Storage initializer: pull a model export to local disk before serving.
+
+Reference parity (SURVEY.md §2.1 KFServing controller row: an
+initContainer downloads the model from GCS/S3/HTTP/PVC before the server
+starts). Schemes:
+
+  file:///path, /path      passthrough (no copy)
+  pvc://volume/sub/path    resolved under KFX_PVC_ROOT (the mounted-volume
+                           model of the reference, minus the kubelet)
+  http://, https://        downloaded into the cache dir via stdlib urllib
+                           (offline-testable against a local HTTP server)
+  gs://bucket/obj          public GCS JSON/XML endpoint over https
+  s3://bucket/obj          virtual-hosted s3 URL (KFX_S3_ENDPOINT to
+                           point at minio etc.)
+
+Remote exports are fetched into ``<cache>/<digest>/`` and re-used; a
+partial download never becomes visible (tmp dir + atomic rename).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tempfile
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, List
+
+# The files that make up an export (export.py's format). Remote schemes
+# fetch exactly these; local schemes just point at the directory.
+EXPORT_FILES = ("config.json", "params.msgpack")
+
+ENV_PVC_ROOT = "KFX_PVC_ROOT"
+ENV_S3_ENDPOINT = "KFX_S3_ENDPOINT"
+
+
+def _pvc(uri: str, cache_dir: str) -> str:
+    root = os.environ.get(ENV_PVC_ROOT, "/mnt/pvc")
+    rest = uri[len("pvc://"):]
+    return os.path.join(root, rest)
+
+
+def _http(uri: str, cache_dir: str) -> str:
+    digest = hashlib.sha256(uri.encode()).hexdigest()[:16]
+    dest = os.path.join(cache_dir, digest)
+    if os.path.isdir(dest):  # cached (atomic rename made it complete)
+        return dest
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=cache_dir, prefix=f".{digest}.")
+    try:
+        base = uri.rstrip("/")
+        for fname in EXPORT_FILES:
+            with urllib.request.urlopen(f"{base}/{fname}",
+                                        timeout=60) as r, \
+                    open(os.path.join(tmp, fname), "wb") as f:
+                shutil.copyfileobj(r, f)
+        try:
+            os.replace(tmp, dest)
+        except OSError:  # a concurrent initializer completed first
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return dest
+
+
+def _gs(uri: str, cache_dir: str) -> str:
+    bucket, _, obj = uri[len("gs://"):].partition("/")
+    return _http(f"https://storage.googleapis.com/{bucket}/{obj}",
+                 cache_dir)
+
+
+def _s3(uri: str, cache_dir: str) -> str:
+    bucket, _, obj = uri[len("s3://"):].partition("/")
+    endpoint = os.environ.get(ENV_S3_ENDPOINT)
+    if endpoint:
+        return _http(f"{endpoint.rstrip('/')}/{bucket}/{obj}", cache_dir)
+    return _http(f"https://{bucket}.s3.amazonaws.com/{obj}", cache_dir)
+
+
+_SCHEMES: Dict[str, Callable[[str, str], str]] = {
+    "pvc": _pvc,
+    "http": _http,
+    "https": _http,
+    "gs": _gs,
+    "s3": _s3,
+}
+
+
+def supported_schemes() -> List[str]:
+    return ["file"] + sorted(_SCHEMES)
+
+
+def initialize(uri: str, cache_dir: str) -> str:
+    """Resolve ``uri`` to a local export directory, downloading if the
+    scheme is remote. Raises ValueError for unknown schemes."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if "://" not in uri:
+        return uri
+    scheme = urllib.parse.urlparse(uri).scheme
+    handler = _SCHEMES.get(scheme)
+    if handler is None:
+        raise ValueError(
+            f"unsupported storageUri scheme {scheme!r} (supported: "
+            f"{', '.join(supported_schemes())})")
+    return handler(uri, cache_dir)

@@ -1,0 +1,189 @@
+"""HTTP surface tests: REST API (list/get/apply/delete/events/logs) and
+the dashboard-lite HTML views (SURVEY.md §2.2 centraldashboard row)."""
+
+import json
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.apiserver import ApiServer
+from kubeflow_tpu.controlplane import ControlPlane
+
+PY = sys.executable
+
+JOB = """
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata:
+  name: api-job
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+          - name: main
+            command: ["{py}", "-c", "print('served hello')"]
+"""
+
+
+@pytest.fixture()
+def server(tmp_path):
+    with ControlPlane(home=str(tmp_path / "kfx"),
+                      worker_platform="cpu") as cp:
+        with ApiServer(cp, port=0) as srv:
+            yield srv
+
+
+def _get(url, expect=200):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (e.code, e.read().decode())
+        return e.code, e.read().decode()
+
+
+def _req(url, data=None, method="POST"):
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+class TestRestApi:
+    def test_health_version_kinds(self, server):
+        assert _get(f"{server.url}/healthz") == (200, "ok")
+        st, body = _get(f"{server.url}/version")
+        assert st == 200 and "version" in json.loads(body)
+        st, body = _get(f"{server.url}/apis")
+        kinds = json.loads(body)["kinds"]
+        assert "JAXJob" in kinds and "Experiment" in kinds
+
+    def test_apply_get_logs_events_delete(self, server):
+        st, body = _req(f"{server.url}/apis",
+                        JOB.format(py=PY).encode())
+        assert st == 200
+        assert json.loads(body)["applied"][0]["verb"] == "created"
+
+        # poll the object until the job finishes
+        import time
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st, body = _get(f"{server.url}/apis/jaxjob/default/api-job")
+            obj = json.loads(body)
+            conds = {c["type"]: c["status"]
+                     for c in obj.get("status", {}).get("conditions", [])}
+            if conds.get("Succeeded") == "True":
+                break
+            time.sleep(0.2)
+        assert conds.get("Succeeded") == "True", conds
+
+        st, body = _get(f"{server.url}/apis/jaxjobs?namespace=default")
+        assert st == 200 and len(json.loads(body)["items"]) == 1
+
+        st, body = _get(f"{server.url}/apis/jaxjob/default/api-job/logs")
+        assert st == 200 and "served hello" in body
+
+        st, body = _get(f"{server.url}/apis/jaxjob/default/api-job/events")
+        assert st == 200 and json.loads(body)["events"]
+
+        st, _ = _req(f"{server.url}/apis/jaxjob/default/api-job",
+                     method="DELETE")
+        assert st == 200
+        _get(f"{server.url}/apis/jaxjob/default/api-job", expect=404)
+
+    def test_errors(self, server):
+        _get(f"{server.url}/apis/nosuchkind", expect=404)
+        _get(f"{server.url}/apis/jaxjob/default/ghost", expect=404)
+        _get(f"{server.url}/nope", expect=404)
+        # invalid manifest -> 400 with the validation message
+        try:
+            _req(f"{server.url}/apis", b"apiVersion: v1\nkind: JAXJob\n")
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+class TestClientMode:
+    def test_kfx_verbs_against_server(self, server, tmp_path, capsys,
+                                      monkeypatch):
+        """KFX_SERVER turns the CLI into a thin HTTP client (kubectl
+        model): run/get/logs/events/describe/delete all round-trip."""
+        from kubeflow_tpu.cli import main as kfx_main
+
+        monkeypatch.setenv("KFX_SERVER", server.url)
+        manifest = tmp_path / "job.yaml"
+        manifest.write_text(JOB.format(py=PY))
+
+        rc = kfx_main(["run", "-f", str(manifest)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "jaxjob/api-job created" in out
+        assert "served hello" in out
+        assert "jaxjob/api-job succeeded" in out
+
+        rc = kfx_main(["get", "jaxjobs"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "api-job" in out and "Succeeded" in out
+
+        rc = kfx_main(["describe", "jaxjob", "api-job"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "kind: JAXJob" in out and "events:" in out
+
+        rc = kfx_main(["logs", "jaxjob", "api-job"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "served hello" in out
+
+        rc = kfx_main(["delete", "jaxjob", "api-job"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "deleted" in out
+
+        rc = kfx_main(["get", "jaxjob", "api-job"])
+        assert rc == 1
+        assert "error:" in capsys.readouterr().err
+
+
+class TestDashboard:
+    def test_root_and_resource_page(self, server):
+        st, body = _get(f"{server.url}/")
+        assert st == 200 and "no resources" in body
+
+        _req(f"{server.url}/apis", JOB.format(py=PY).encode())
+        import time
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st, body = _get(f"{server.url}/")
+            if "api-job" in body:
+                break
+            time.sleep(0.2)
+        assert "JAXJob" in body and "api-job" in body
+
+        # wait for success so the page shows conditions + log
+        while time.monotonic() < deadline:
+            st, page = _get(f"{server.url}/ui/jaxjob/default/api-job")
+            if "Succeeded" in page:
+                break
+            time.sleep(0.2)
+        assert "conditions" in page and "events" in page
+        assert "served hello" in page  # chief log tail embedded
+
+    def test_html_escapes_content(self, server, tmp_path):
+        evil = JOB.format(py=PY).replace(
+            "api-job", "xss").replace(
+            "served hello", "<script>alert(1)</script>")
+        _req(f"{server.url}/apis", evil.encode())
+        import time
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _, page = _get(f"{server.url}/ui/jaxjob/default/xss")
+            if "script" in page and "Succeeded" in page:
+                break
+            time.sleep(0.2)
+        assert "<script>alert(1)</script>" not in page
+        assert "&lt;script&gt;" in page

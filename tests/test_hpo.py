@@ -44,7 +44,8 @@ def _run_algorithm(name, n_rounds=14, batch=2, settings=None):
 
 class TestAlgorithms:
     @pytest.mark.parametrize("name", ["random", "tpe",
-                                      "bayesianoptimization", "cmaes"])
+                                      "bayesianoptimization", "cmaes",
+                                      "regularizedevolution"])
     def test_bounds_and_improvement(self, name):
         trials = _run_algorithm(name)
         for t in trials:
@@ -102,6 +103,33 @@ class TestAlgorithms:
         best = trials[-1]["assignments"]
         assert any(all(a[k] == best[k] for k in ("lr", "units", "opt"))
                    for a in promoted)
+
+    def test_regularized_evolution_mutates_one_gene(self):
+        """Past warmup, every suggestion is a one-gene mutation of a
+        population member (the NAS genome contract)."""
+        from kubeflow_tpu.hpo.algorithms import get_algorithm
+
+        algo = get_algorithm("regularizedevolution",
+                             [dict(p) for p in PARAMS],
+                             settings={"population_size": "8",
+                                       "tournament_size": "3"}, seed=7)
+        trials = [{"assignments": a, "value": _quadratic(a)}
+                  for a in algo.suggest([], 8)]
+        children = algo.suggest(trials, 4)
+        genomes = [t["assignments"] for t in trials]
+        for child in children:
+            diffs = [min(sum(child[k] != g[k] for k in child)
+                         for g in genomes)]
+            # exactly one gene differs from SOME parent (or zero, when a
+            # continuous mutation rounds back to the same decoded value)
+            assert min(diffs) <= 1, (child, genomes)
+
+    def test_regularized_evolution_converges(self):
+        trials = _run_algorithm("regularizedevolution", n_rounds=16,
+                                settings={"population_size": "12",
+                                          "tournament_size": "4"})
+        best = max(t["value"] for t in trials)
+        assert best > -0.6, best  # tighter than the random-parity bar
 
     def test_unknown_algorithm(self):
         from kubeflow_tpu.hpo.algorithms import get_algorithm
@@ -247,6 +275,75 @@ class TestExperimentE2E:
             # suggestion audit trail
             sug = cp.store.get("Suggestion", "e2e")
             assert sug.spec["requests"] == 4
+
+    def test_nas_experiment_searches_architectures(self, tmp_path):
+        """Regularized-evolution NAS sweep whose trial parameters ARE the
+        model shape (layers / ffn width); the scored 'architecture' with
+        the most capacity wins."""
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        text = f"""
+apiVersion: kubeflow.org/v1
+kind: Experiment
+metadata:
+  name: nas
+spec:
+  objective:
+    type: maximize
+    objectiveMetricName: score
+  algorithm:
+    algorithmName: regularizedevolution
+    algorithmSettings:
+    - name: population_size
+      value: "4"
+    - name: tournament_size
+      value: "2"
+  maxTrialCount: 8
+  parallelTrialCount: 2
+  maxFailedTrialCount: 2
+  parameters:
+  - name: layers
+    parameterType: categorical
+    feasibleSpace: {{list: ["2", "4", "8"]}}
+  - name: ffn
+    parameterType: int
+    feasibleSpace: {{min: "64", max: "256"}}
+  trialTemplate:
+    trialParameters:
+    - name: layers
+      reference: layers
+    - name: ffn
+      reference: ffn
+    trialSpec:
+      apiVersion: kubeflow.org/v1
+      kind: JAXJob
+      spec:
+        jaxReplicaSpecs:
+          Worker:
+            replicas: 1
+            restartPolicy: Never
+            template:
+              spec:
+                containers:
+                - name: t
+                  command: ["{PY}", "-c",
+                            "print('score=' + str(int('${{trialParameters.layers}}') * int('${{trialParameters.ffn}}')))"]
+"""
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply(load_manifests(text))
+            exp = cp.wait_for_condition("Experiment", "nas", "Succeeded",
+                                        timeout=180)
+            s = exp.status
+            assert s["trialsSucceeded"] == 8
+            best = s["currentOptimalTrial"]
+            # the optimum is the largest searched architecture
+            assert float(best["observation"]["metrics"][0]["latest"]) \
+                >= 4 * 64
+            pa = {p["name"]: p["value"]
+                  for p in best["parameterAssignments"]}
+            assert pa["layers"] in ("2", "4", "8") and 64 <= int(pa["ffn"])
 
     def test_goal_stops_early(self, tmp_path):
         from kubeflow_tpu.api.manifest import load_manifests

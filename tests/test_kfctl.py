@@ -1,0 +1,168 @@
+"""kfctl-parity tests: KfDef rendering (namespace/Profile stamping,
+parameters, patches, ordering), `kfx init/generate`, and a whole-platform
+apply through the CLI (SURVEY.md §2.1 kfctl row, §3 CS5)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+import yaml
+
+PY = sys.executable
+
+KFDEF = """
+apiVersion: kfdef.apps.kubeflow.org/v1
+kind: KfDef
+metadata:
+  name: team-a-platform
+spec:
+  namespace: team-a
+  commonLabels:
+    team: a
+  applications:
+  - name: defaults
+    resource:
+      apiVersion: kubeflow.org/v1alpha1
+      kind: PodDefault
+      metadata:
+        name: env-defaults
+      spec:
+        selector:
+          matchLabels:
+            team: a
+        env:
+        - name: TEAM
+          value: a
+  - name: training
+    path: job.yaml
+    parameters:
+      steps: "3"
+"""
+
+JOB_TEMPLATE = """
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata:
+  name: platform-job
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+          - name: main
+            command: ["{py}", "-c",
+                      "import os; print('steps=' + '${{param.steps}}');
+                      print('team_env=' + os.environ.get('TEAM', ''))"]
+"""
+
+
+@pytest.fixture()
+def kfdef_dir(tmp_path):
+    (tmp_path / "kfdef.yaml").write_text(KFDEF.format())
+    (tmp_path / "job.yaml").write_text(JOB_TEMPLATE.format(py=PY))
+    return tmp_path
+
+
+class TestRender:
+    def test_expand_orders_and_stamps(self, kfdef_dir):
+        from kubeflow_tpu.kfctl import expand_manifest_file
+
+        docs = expand_manifest_file(str(kfdef_dir / "kfdef.yaml"))
+        kinds = [d["kind"] for d in docs]
+        # Profile (from spec.namespace) first, PodDefault next, workload last
+        assert kinds == ["Profile", "PodDefault", "JAXJob"]
+        prof, pd, job = docs
+        assert prof["metadata"]["name"] == "team-a"
+        assert pd["metadata"]["namespace"] == "team-a"
+        assert job["metadata"]["namespace"] == "team-a"
+        assert job["metadata"]["labels"]["team"] == "a"
+        # ${param.steps} substituted
+        cmd = job["spec"]["jaxReplicaSpecs"]["Worker"]["template"]["spec"][
+            "containers"][0]["command"]
+        assert "print('steps=' + '3')" in cmd[-1]
+
+    def test_patch_merges(self, tmp_path):
+        from kubeflow_tpu.kfctl import render_kfdef
+
+        doc = yaml.safe_load(textwrap.dedent("""
+            apiVersion: kfdef.apps.kubeflow.org/v1
+            kind: KfDef
+            metadata: {name: p}
+            spec:
+              applications:
+              - name: nb
+                resource:
+                  apiVersion: kubeflow.org/v1
+                  kind: Notebook
+                  metadata: {name: nb1}
+                  spec: {idleSeconds: 100, template: {a: 1}}
+                patch:
+                  spec: {idleSeconds: 600}
+        """))
+        out = render_kfdef(doc, str(tmp_path))
+        assert out[0]["spec"] == {"idleSeconds": 600, "template": {"a": 1}}
+
+    def test_undefined_param_rejected(self, tmp_path):
+        from kubeflow_tpu.api.base import ValidationError
+        from kubeflow_tpu.kfctl import render_kfdef
+
+        doc = {
+            "apiVersion": "kfdef.apps.kubeflow.org/v1", "kind": "KfDef",
+            "metadata": {"name": "p"},
+            "spec": {"applications": [{
+                "name": "x",
+                "resource": {"kind": "JAXJob",
+                             "metadata": {"name": "${param.nope}"}}}]}}
+        with pytest.raises(ValidationError, match="param.nope"):
+            render_kfdef(doc, str(tmp_path))
+
+    def test_app_without_source_rejected(self, tmp_path):
+        from kubeflow_tpu.api.base import ValidationError
+        from kubeflow_tpu.kfctl import render_kfdef
+
+        doc = {"apiVersion": "v1", "kind": "KfDef",
+               "metadata": {"name": "p"},
+               "spec": {"applications": [{"name": "empty"}]}}
+        with pytest.raises(ValidationError, match="path.*resource"):
+            render_kfdef(doc, str(tmp_path))
+
+
+class TestKfxVerbs:
+    def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
+        from kubeflow_tpu.cli import main as kfx_main
+
+        monkeypatch.chdir(tmp_path)
+        rc = kfx_main(["init", "my-platform"])
+        assert rc == 0 and os.path.exists("kfdef.yaml")
+        # re-init refuses to clobber
+        assert kfx_main(["init", "my-platform"]) == 1
+        capsys.readouterr()
+
+        rc = kfx_main(["generate", "-f", "kfdef.yaml", "-o", "out"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        files = sorted(os.listdir("out"))
+        assert files == ["00-profile-my-platform.yaml"]
+        assert "00-profile-my-platform.yaml" in out
+
+    def test_apply_kfdef_brings_up_platform(self, kfdef_dir, capsys):
+        """`kfx run -f kfdef.yaml`: Profile + PodDefault land, the job
+        runs with the substituted parameter, and the PodDefault's env is
+        injected into the gang (admission path)."""
+        from kubeflow_tpu.cli import main as kfx_main
+
+        home = str(kfdef_dir / "home")
+        rc = kfx_main(["--home", home, "run", "-f",
+                       str(kfdef_dir / "kfdef.yaml")])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "profile/team-a created" in out
+        assert "poddefault/env-defaults created" in out
+        assert "jaxjob/platform-job created" in out
+        assert "steps=3" in out
+        assert "team_env=a" in out  # PodDefault env reached the worker
+        assert "jaxjob/platform-job succeeded" in out

@@ -87,8 +87,8 @@ class TestEnvContracts:
             assert s.env["JAX_PLATFORMS"] == "cpu"
             assert s.env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "gloo"
         # Coordinator is allocated per attempt, distinct across attempts.
-        a0 = hook(0)[rdv.ENV_COORDINATOR]
-        a1 = hook(1)[rdv.ENV_COORDINATOR]
+        a0 = hook(0)["*"][rdv.ENV_COORDINATOR]
+        a1 = hook(1)["*"][rdv.ENV_COORDINATOR]
         assert a0.startswith("127.0.0.1:") and a0 != a1
 
     def test_tfjob_tf_config(self, tmp_path):
@@ -97,17 +97,50 @@ class TestEnvContracts:
             "Worker": {"replicas": 2, "template": _tmpl("pass")},
             "PS": {"replicas": 1, "template": _tmpl("pass")},
         })
-        specs, _ = self._specs(TFJobController, job, tmp_path)
-        by_id = {s.id: s for s in specs}
-        cfg = json.loads(by_id["worker-1"].env["TF_CONFIG"])
+        specs, hook = self._specs(TFJobController, job, tmp_path)
+        # TF_CONFIG is injected per attempt (launch-time ports), keyed by
+        # replica id — not baked into the spec env at build time.
+        env0 = hook(0)
+        cfg = json.loads(env0["worker-1"]["TF_CONFIG"])
         assert set(cfg["cluster"]) == {"chief", "worker", "ps"}
         assert len(cfg["cluster"]["worker"]) == 2
         assert cfg["task"] == {"type": "worker", "index": 1}
         # every member sees the identical cluster spec
-        assert all(json.loads(s.env["TF_CONFIG"])["cluster"] == cfg["cluster"]
-                   for s in specs)
+        assert all(json.loads(e["TF_CONFIG"])["cluster"] == cfg["cluster"]
+                   for e in env0.values())
+        assert set(env0) == {s.id for s in specs}
         # chief is rank 0 (first member) for gang success semantics
         assert specs[0].id == "chief-0"
+        # a restart rendezvouses on fresh ports
+        cfg1 = json.loads(hook(1)["worker-1"]["TF_CONFIG"])
+        assert cfg1["cluster"] != cfg["cluster"]
+
+    def test_tfjob_parallel_jobs_bindable_ports(self, cp):
+        """Port-race regression: several TFJobs launching at once must all
+        hand their members ports they can actually bind (allocation
+        happens at launch, collisions would crash the TF server and be
+        retried with fresh ports)."""
+        script = (
+            "import json, os, socket\n"
+            "cfg = json.loads(os.environ['TF_CONFIG'])\n"
+            "t = cfg['task']\n"
+            "addr = cfg['cluster'][t['type']][t['index']]\n"
+            "host, port = addr.rsplit(':', 1)\n"
+            "s = socket.socket()\n"
+            "s.bind((host, int(port)))  # my advertised port must be free\n"
+            "s.listen(1)\n"
+            "import time; time.sleep(1.0)\n"
+            "s.close()\n")
+        names = [f"tfp-{i}" for i in range(4)]
+        for n in names:
+            cp.apply([_job("TFJob", n, "tfReplicaSpecs", {
+                "Chief": {"replicas": 1, "template": _tmpl(script)},
+                "Worker": {"replicas": 2, "template": _tmpl(script)},
+            })])
+        for n in names:
+            final = cp.wait_for_job("TFJob", n, timeout=60)
+            assert final.has_condition(T.JOB_SUCCEEDED), \
+                cp.job_logs("TFJob", n)
 
     def test_pytorchjob_env(self, tmp_path):
         job = _job("PyTorchJob", "p", "pytorchReplicaSpecs", {
@@ -119,7 +152,7 @@ class TestEnvContracts:
         assert {s.env["RANK"] for s in specs} == {"0", "1", "2"}
         assert all(s.env["WORLD_SIZE"] == "3" for s in specs)
         assert all(s.env["MASTER_ADDR"] == "127.0.0.1" for s in specs)
-        assert hook(0)["MASTER_PORT"].isdigit()
+        assert hook(0)["*"]["MASTER_PORT"].isdigit()
 
     def test_mpijob_hostfile_and_launcher_rewrite(self, tmp_path):
         job = _job("MPIJob", "m", "mpiReplicaSpecs", {

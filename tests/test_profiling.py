@@ -1,0 +1,142 @@
+"""Profiling subsystem tests (SURVEY.md §5.1): every worker runs a
+jax.profiler trace server advertised via a port file, and `kfx profile`
+captures a TensorBoard-loadable xplane dump from a running job."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api.base import from_manifest
+from kubeflow_tpu.controlplane import ControlPlane
+
+PY = sys.executable
+
+
+def _long_job(name):
+    return from_manifest({
+        "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"jaxReplicaSpecs": {"Worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{
+                "name": "jax",
+                "command": [PY, "-m", "kubeflow_tpu.runners.jax_runner",
+                            "--model=mlp", "--dataset=mnist",
+                            "--steps=100000", "--batch-size=64",
+                            "--log-every=500", "--no-checkpoint"],
+            }]}}}}}})
+
+
+class TestProfilerServer:
+    def test_opt_out(self, monkeypatch):
+        from kubeflow_tpu.profiling import maybe_start_profiler_server
+
+        monkeypatch.setenv("KFX_PROFILE", "0")
+        assert maybe_start_profiler_server() is None
+
+    def test_port_file_roundtrip(self, tmp_path):
+        from kubeflow_tpu.profiling import port_file, replica_port
+
+        assert replica_port(str(tmp_path), "worker-0") is None
+        path = port_file(str(tmp_path), "worker-0")
+        os.makedirs(os.path.dirname(path))
+        with open(path, "w") as f:
+            f.write("12345")
+        assert replica_port(str(tmp_path), "worker-0") == 12345
+
+
+@pytest.mark.slow
+class TestKfxProfile:
+    def test_capture_from_running_jaxjob(self, tmp_path, capsys):
+        """Apply a long-running JAXJob, `kfx profile` it mid-training, and
+        assert a TensorBoard xplane artifact lands on disk."""
+        from kubeflow_tpu.cli import KfxCLI
+        from kubeflow_tpu.profiling import replica_port
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply([_long_job("prof-job")])
+            cli = KfxCLI(cp)
+
+            deadline = time.monotonic() + 120
+            gang = port = None
+            while time.monotonic() < deadline:
+                gang = cp.gangs.get("jaxjob/default/prof-job")
+                if gang is not None:
+                    port = replica_port(gang.workdir, "worker-0")
+                    if port is not None:
+                        break
+                time.sleep(0.5)
+            assert port is not None, "worker never advertised profiler port"
+            time.sleep(5.0)  # let training get past compile into the loop
+
+            logdir = str(tmp_path / "trace")
+            rc = cli.profile("JAXJob", "prof-job", "default", "",
+                             duration_ms=1500, logdir=logdir)
+            out = capsys.readouterr().out
+            assert rc == 0, out
+            assert ".xplane.pb" in out
+            dumps = [line for line in out.splitlines()
+                     if line.endswith(".xplane.pb")]
+            assert dumps and os.path.exists(dumps[0])
+            assert os.path.getsize(dumps[0]) > 0
+
+            cp.store.delete("JAXJob", "prof-job")
+
+    def test_profile_not_running(self, tmp_path, capsys):
+        from kubeflow_tpu.cli import KfxCLI
+        from kubeflow_tpu.core.store import NotFound
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            with pytest.raises(NotFound):
+                KfxCLI(cp).profile("JAXJob", "ghost", "default", "",
+                                   duration_ms=100, logdir=str(tmp_path))
+            cp.apply([_long_job("idle")])
+            # applied but pick a replica that never existed
+            rc = KfxCLI(cp).profile("JAXJob", "idle", "default",
+                                    "worker-7", duration_ms=100,
+                                    logdir=str(tmp_path))
+            assert rc == 1
+            assert "profiler port" in capsys.readouterr().err
+            cp.store.delete("JAXJob", "idle")
+
+    def test_profile_cross_process(self, tmp_path):
+        """A PASSIVE second control plane on the same home (what a second
+        `kfx profile` invocation opens) can trace a job owned by the
+        first process — and must not spawn duplicate gangs."""
+        from kubeflow_tpu.cli import KfxCLI
+        from kubeflow_tpu.profiling import replica_port
+
+        home = str(tmp_path / "kfx")
+        with ControlPlane(home=home, journal=True,
+                          worker_platform="cpu") as owner:
+            owner.apply([_long_job("xproc")])
+            deadline = time.monotonic() + 120
+            port = None
+            while time.monotonic() < deadline:
+                gang = owner.gangs.get("jaxjob/default/xproc")
+                if gang is not None:
+                    port = replica_port(gang.workdir, "worker-0")
+                    if port is not None:
+                        break
+                time.sleep(0.5)
+            assert port is not None
+            time.sleep(5.0)
+
+            with ControlPlane(home=home, journal=True, passive=True,
+                              worker_platform="cpu") as viewer:
+                assert viewer.gangs.get("jaxjob/default/xproc") is None
+                rc = KfxCLI(viewer).profile(
+                    "JAXJob", "xproc", "default", "", duration_ms=1500,
+                    logdir=str(tmp_path / "xtrace"))
+                assert rc == 0
+                # passive plane never reconciled -> no duplicate gang
+                assert viewer.gangs.get("jaxjob/default/xproc") is None
+            import glob
+
+            assert glob.glob(str(tmp_path / "xtrace" / "plugins" /
+                                 "profile" / "*" / "*.xplane.pb"))
+            owner.store.delete("JAXJob", "xproc")
