@@ -22,6 +22,7 @@ from .katib import (  # noqa: F401
     Trial,
 )
 from .manifest import dump_manifest, load_manifest_file, load_manifests  # noqa: F401
+from .pipelines import Pipeline  # noqa: F401
 from .platform import Notebook, PodDefault, Profile  # noqa: F401
 from .serving import InferenceService  # noqa: F401
 from .training import (  # noqa: F401

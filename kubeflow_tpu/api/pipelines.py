@@ -1,0 +1,118 @@
+"""Pipeline resource: a DAG of steps run to completion in dependency
+order — the Kubeflow Pipelines role (SURVEY.md §2.2 Pipelines row; the
+reference delegates to Argo Workflows, here the platform's own controller
+executes the DAG over the same gang runtime as everything else).
+
+Shape:
+
+    apiVersion: kubeflow.org/v1
+    kind: Pipeline
+    metadata: {name: train-then-serve}
+    spec:
+      params: {preset: tiny, steps: "40"}     # ${params.x} substitution
+      steps:
+      - name: train
+        template:                              # raw command step
+          spec:
+            containers:
+            - name: main
+              command: [python, -m, kubeflow_tpu.runners.lm_runner,
+                        "--preset=${params.preset}",
+                        "--steps=${params.steps}"]
+      - name: serve
+        dependsOn: [train]
+        resource:                              # apply-a-resource step
+          apiVersion: serving.kubeflow.org/v1beta1
+          kind: InferenceService
+          spec: {...}
+
+Template steps run as single-replica JAXJobs (the generic process
+runner); resource steps apply the embedded manifest and wait for its
+terminal condition (Succeeded/Failed for jobs and experiments, Ready for
+services). All steps of one pipeline share KFX_PIPELINE_WORKSPACE, a
+directory for passing artifacts between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from .base import Resource, ValidationError, register
+
+PIPELINE_RUNNING = "Running"
+PIPELINE_SUCCEEDED = "Succeeded"
+PIPELINE_FAILED = "Failed"
+
+STEP_PENDING = "Pending"
+STEP_RUNNING = "Running"
+STEP_SUCCEEDED = "Succeeded"
+STEP_FAILED = "Failed"
+STEP_SKIPPED = "Skipped"
+
+
+@register
+class Pipeline(Resource):
+    KIND = "Pipeline"
+    PLURAL = "pipelines"
+
+    def steps(self) -> List[Dict[str, Any]]:
+        return list(self.spec.get("steps") or [])
+
+    def params(self) -> Dict[str, str]:
+        return {str(k): str(v)
+                for k, v in (self.spec.get("params") or {}).items()}
+
+    def step_order(self) -> List[str]:
+        """Topological order of step names; raises ValidationError on
+        cycles / unknown dependencies."""
+        steps = self.steps()
+        names = [str(s.get("name") or "") for s in steps]
+        deps = {str(s.get("name")): [str(d) for d in
+                                     (s.get("dependsOn") or [])]
+                for s in steps}
+        order: List[str] = []
+        state: Dict[str, int] = {}  # 0 visiting, 1 done
+
+        def visit(n: str, chain: List[str]) -> None:
+            if state.get(n) == 1:
+                return
+            if state.get(n) == 0:
+                raise ValidationError(
+                    "spec.steps", f"dependency cycle: {' -> '.join(chain + [n])}")
+            state[n] = 0
+            for d in deps.get(n, []):
+                if d not in deps:
+                    raise ValidationError(
+                        f"spec.steps[{n}].dependsOn",
+                        f"unknown step {d!r}")
+                visit(d, chain + [n])
+            state[n] = 1
+            order.append(n)
+
+        for n in names:
+            visit(n, [])
+        return order
+
+    def validate(self) -> None:
+        super().validate()
+        steps = self.steps()
+        if not steps:
+            raise ValidationError("spec.steps", "at least one step required")
+        seen = set()
+        for i, s in enumerate(steps):
+            name = s.get("name")
+            if not name:
+                raise ValidationError(f"spec.steps[{i}].name", "required")
+            if not isinstance(name, str):
+                raise ValidationError(
+                    f"spec.steps[{i}].name",
+                    f"must be a string (got {type(name).__name__}; "
+                    f"quote numeric names in YAML)")
+            if name in seen:
+                raise ValidationError(f"spec.steps[{i}].name",
+                                      f"duplicate step name {name!r}")
+            seen.add(name)
+            if not s.get("template") and not s.get("resource"):
+                raise ValidationError(
+                    f"spec.steps[{i}]", "needs 'template' or 'resource'")
+        self.step_order()  # cycle / unknown-dep check

@@ -6,9 +6,14 @@ Server mode (`kfx server`) hosts a persistent ControlPlane behind:
   L0): list/get/apply/delete resources, events, replica logs. Other kfx
   invocations can point at it with ``KFX_SERVER=http://host:port`` and
   become thin HTTP clients (the kubectl model).
-* a read-only HTML dashboard (the centraldashboard equivalent, SURVEY.md
-  §2.2): every resource with state/conditions, per-resource pages with
-  events and the chief log tail.
+* an HTML dashboard (the centraldashboard equivalent, SURVEY.md §2.2):
+  every resource with state/conditions, per-resource pages with events
+  and the chief log tail, and a notebook spawner page (the
+  jupyter-web-app equivalent: create/delete Notebook resources from a
+  form; the GPU/CPU pickers of the reference become the command line).
+* the kfam access-management API (SURVEY.md §2.1 kfam row):
+  GET/POST/DELETE /kfam/v1/bindings manage a Profile's contributors;
+  the profile controller folds them into status.bindings.
 
 Routes:
   GET    /healthz                                 liveness
@@ -21,7 +26,12 @@ Routes:
   POST   /apis                                    apply YAML manifests
   DELETE /apis/{kind}/{ns}/{name}                 delete
   GET    /                                        dashboard (HTML)
+  GET    /ui/notebooks                            notebook spawner (HTML)
+  POST   /ui/notebooks                            create/delete from form
   GET    /ui/{kind}/{ns}/{name}                   resource page (HTML)
+  GET    /kfam/v1/bindings[?namespace=ns]         list contributor bindings
+  POST   /kfam/v1/bindings                        {namespace,user,role}
+  DELETE /kfam/v1/bindings?namespace=&user=       remove a binding
 """
 
 from __future__ import annotations
@@ -108,10 +118,15 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(200, {"version": __version__})
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
+            if parts == ["ui", "notebooks"]:
+                return self._html(200, self._notebooks_page())
             if parts[0] == "ui" and len(parts) == 4:
                 return self._html(200, self._resource_page(*parts[1:]))
             if parts[0] == "apis":
                 return self._get_apis(parts[1:], q)
+            if parts[:2] == ["kfam", "v1"] and parts[2:] == ["bindings"]:
+                ns = (q.get("namespace") or [None])[0]
+                return self._json(200, {"bindings": self._kfam_list(ns)})
             return self._error(404, f"no route {url.path}")
         except (NotFound, KeyError) as e:
             return self._error(404, str(e.args[0] if e.args else e))
@@ -160,31 +175,103 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         url = urlparse(self.path)
-        if url.path != "/apis":
-            return self._error(404, f"no route {url.path}")
         length = int(self.headers.get("Content-Length") or 0)
         text = self.rfile.read(length).decode()
         self._body_consumed = True
         try:
-            applied = self.cp.apply(load_manifests(text))
-        except (ValidationError, Conflict, AlreadyExists, KeyError) as e:
+            if url.path == "/apis":
+                applied = self.cp.apply(load_manifests(text))
+                return self._json(200, {"applied": [
+                    {"kind": o.KIND, "name": o.name,
+                     "namespace": o.namespace, "verb": verb}
+                    for o, verb in applied]})
+            if url.path == "/ui/notebooks":
+                return self._notebooks_form(parse_qs(text))
+            if url.path == "/kfam/v1/bindings":
+                return self._kfam_post(json.loads(text))
+            return self._error(404, f"no route {url.path}")
+        except (ValidationError, Conflict, AlreadyExists, NotFound,
+                KeyError, ValueError) as e:
             return self._error(400, str(e))
         except Exception as e:
             return self._error(500, f"{type(e).__name__}: {e}")
-        return self._json(200, {"applied": [
-            {"kind": o.KIND, "name": o.name, "namespace": o.namespace,
-             "verb": verb} for o, verb in applied]})
 
     def do_DELETE(self):  # noqa: N802
-        parts = [p for p in urlparse(self.path).path.split("/") if p]
-        if len(parts) != 4 or parts[0] != "apis":
-            return self._error(404, f"no route {self.path}")
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
         try:
+            if parts[:3] == ["kfam", "v1", "bindings"]:
+                q = parse_qs(url.query)
+                ns = (q.get("namespace") or [""])[0]
+                user = (q.get("user") or [""])[0]
+                return self._kfam_delete(ns, user)
+            if len(parts) != 4 or parts[0] != "apis":
+                return self._error(404, f"no route {self.path}")
             cls = resource_class(parts[1])
             self.cp.store.delete(cls.KIND, parts[3], parts[2])
         except (NotFound, KeyError) as e:
             return self._error(404, str(e.args[0] if e.args else e))
+        except Exception as e:
+            return self._error(500, f"{type(e).__name__}: {e}")
         return self._json(200, {"deleted": f"{parts[1]}/{parts[3]}"})
+
+    # -- kfam (access management, SURVEY.md §2.1) ---------------------------
+    def _kfam_list(self, namespace: Optional[str]) -> List[dict]:
+        out = []
+        for prof in self.cp.store.list("Profile"):
+            if namespace and prof.name != namespace:
+                continue
+            for b in prof.status.get("bindings", []):
+                out.append({"user": b.get("user"),
+                            "role": b.get("role", "edit"),
+                            "referredNamespace": prof.name})
+        return out
+
+    def _update_profile(self, ns: str, mutate) -> None:
+        """Optimistic read-modify-write with retry: the profile controller
+        folds bindings into status concurrently, bumping the version —
+        an internal race must not surface as a client error."""
+        for _ in range(20):
+            prof = self.cp.store.get("Profile", ns)
+            mutate(prof)
+            try:
+                self.cp.store.update(prof)
+                return
+            except Conflict:
+                continue
+        raise Conflict(f"profile {ns} kept changing; retry")
+
+    def _kfam_post(self, body: dict) -> None:
+        ns = body.get("namespace") or body.get("referredNamespace")
+        user = body.get("user")
+        role = body.get("role", "edit")
+        if not ns or not user:
+            return self._error(400, "namespace and user are required")
+
+        def mutate(prof):
+            contribs = [c for c in prof.contributors()
+                        if c.get("name") != user]
+            contribs.append({"name": user, "role": role})
+            prof.spec["contributors"] = contribs
+
+        self._update_profile(ns, mutate)
+        return self._json(200, {"bound": {"user": user, "role": role,
+                                          "referredNamespace": ns}})
+
+    def _kfam_delete(self, ns: str, user: str) -> None:
+        if not ns or not user:
+            return self._error(400, "namespace and user are required")
+        prof = self.cp.store.get("Profile", ns)
+        if not any(c.get("name") == user for c in prof.contributors()):
+            return self._error(404, f"no binding for {user} in {ns}")
+
+        def mutate(p):
+            p.spec["contributors"] = [c for c in p.contributors()
+                                      if c.get("name") != user]
+
+        self._update_profile(ns, mutate)
+        return self._json(200, {"unbound": {"user": user,
+                                            "referredNamespace": ns}})
 
     # -- dashboard ----------------------------------------------------------
     _STYLE = """
@@ -227,7 +314,81 @@ class _Handler(BaseHTTPRequestHandler):
         if not out:
             out.append("<p>no resources — <code>kfx apply -f …</code> "
                        "to create some.</p>")
+        out.append("<p><a href='/ui/notebooks'>notebook spawner</a></p>")
         return self._page("dashboard", "".join(out))
+
+    # -- notebook spawner (jupyter-web-app equivalent) ----------------------
+    def _notebooks_page(self, message: str = "") -> str:
+        rows = []
+        for nb in self.cp.store.list("Notebook"):
+            st = display_state(nb.conditions)
+            url = nb.status.get("url", "")
+            link = (f"<a href='{html.escape(url)}'>{html.escape(url)}</a>"
+                    if url else "—")
+            rows.append(
+                f"<tr><td><a href='/ui/notebook/{nb.namespace}/{nb.name}'>"
+                f"{html.escape(nb.name)}</a></td>"
+                f"<td>{html.escape(nb.namespace)}</td>"
+                f"<td class='{st}'>{st}</td><td>{link}</td>"
+                f"<td><form method='post' action='/ui/notebooks'>"
+                f"<input type='hidden' name='action' value='delete'>"
+                f"<input type='hidden' name='name' "
+                f"value='{html.escape(nb.name)}'>"
+                f"<input type='hidden' name='namespace' "
+                f"value='{html.escape(nb.namespace)}'>"
+                f"<button>delete</button></form></td></tr>")
+        table = ("<table><tr><th>name</th><th>namespace</th><th>state</th>"
+                 "<th>url</th><th></th></tr>" + "".join(rows) + "</table>"
+                 if rows else "<p>no notebooks yet.</p>")
+        form = """
+        <h2>spawn a notebook</h2>
+        <form method='post' action='/ui/notebooks'>
+        <input type='hidden' name='action' value='create'>
+        <table>
+        <tr><td>name</td><td><input name='name' required></td></tr>
+        <tr><td>namespace</td>
+            <td><input name='namespace' value='default'></td></tr>
+        <tr><td>command</td><td><input name='command' size='60'
+            value='python -m http.server --bind 127.0.0.1 $(KFX_PORT)'>
+            </td></tr>
+        <tr><td>image label</td>
+            <td><input name='image' value='kfx/notebook:latest'></td></tr>
+        <tr><td>idle cull (s)</td>
+            <td><input name='idle' value='0'></td></tr>
+        </table>
+        <button>create</button></form>"""
+        msg = f"<p><b>{html.escape(message)}</b></p>" if message else ""
+        return self._page("notebooks", msg + table + form)
+
+    def _notebooks_form(self, form: dict) -> None:
+        get = lambda k, d="": (form.get(k) or [d])[0]
+        action = get("action", "create")
+        name, ns = get("name"), get("namespace", "default")
+        if action == "delete":
+            self.cp.store.delete("Notebook", name, ns)
+            return self._html(200, self._notebooks_page(
+                f"deleted {ns}/{name}"))
+        import shlex
+
+        manifest = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Notebook",
+            "metadata": {
+                "name": name, "namespace": ns,
+                "annotations": {"notebooks.kubeflow.org/idle-seconds":
+                                get("idle", "0")},
+            },
+            "spec": {"template": {"spec": {"containers": [{
+                "name": "notebook",
+                "image": get("image", "kfx/notebook:latest"),
+                "command": shlex.split(get("command")),
+            }]}}},
+        }
+        from .api.base import from_manifest
+
+        self.cp.apply([from_manifest(manifest)])
+        return self._html(200, self._notebooks_page(
+            f"created {ns}/{name}"))
 
     def _resource_page(self, kind: str, ns: str, name: str) -> str:
         cls = resource_class(kind)

@@ -85,10 +85,11 @@ class KfxCLI:
     def run(self, paths: List[str], timeout: float, follow: bool = True) -> int:
         applied = self.apply(paths)
         waitable = [o for o in applied
-                    if isinstance(o, TrainingJob) or o.KIND == "Experiment"]
+                    if isinstance(o, TrainingJob)
+                    or o.KIND in ("Experiment", "Pipeline")]
         if not waitable:
-            print("nothing to wait for (no training jobs or experiments "
-                  "in manifests)")
+            print("nothing to wait for (no training jobs, experiments or "
+                  "pipelines in manifests)")
             return 0
         rc = 0
         for obj in waitable:
@@ -400,9 +401,10 @@ def _main(argv: Optional[List[str]] = None) -> int:
             # suspended ones, not leftovers from prior invocations).
             jobs = []
             for o in applied:
-                if not isinstance(o, TrainingJob) or o.is_finished():
-                    continue
-                if o.run_policy().suspend:
+                if isinstance(o, TrainingJob):
+                    if o.is_finished() or o.run_policy().suspend:
+                        continue
+                elif o.KIND != "Pipeline":
                     continue
                 jobs.append(o)
             if jobs:
@@ -540,7 +542,7 @@ def _remote_wait(client, applied: List[dict], timeout: float,
             is_job = issubclass(resource_class(kind), TrainingJob)
         except KeyError:
             continue
-        if not is_job and kind != "Experiment":
+        if not is_job and kind not in ("Experiment", "Pipeline"):
             continue
         deadline = time.monotonic() + timeout
         offset = 0

@@ -72,6 +72,10 @@ class ControlPlane:
                 self.manager.register(ctrl)
         except ImportError:
             pass
+        from .operators.pipelines import pipeline_controllers
+
+        for ctrl in pipeline_controllers(self.store, self.home):
+            self.manager.register(ctrl)
         from .operators.platform import (
             PlatformAdmission,
             platform_controllers,

@@ -62,6 +62,11 @@ class TransformerConfig:
     # (parallel/ring_attention.py) rotating K/V chunks between ctx
     # neighbours. Mutually exclusive with sp (both shard the seq dim).
     cp: int = 1
+    # Attention implementation: "auto" uses the pallas flash kernel
+    # (ops/flash_attention.py) on TPU when shapes qualify, else the XLA
+    # dense path; "flash"/"xla" force one. cp>1 always rides ring
+    # attention (its own seq-sharded kernel).
+    attn_impl: str = "auto"
 
     @property
     def qkv_features(self) -> int:
@@ -96,6 +101,39 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     cfg: TransformerConfig
 
+    def _use_flash(self, seq_len: int) -> bool:
+        cfg = self.cfg
+        if cfg.attn_impl not in ("auto", "flash", "xla"):
+            raise ValueError(
+                f"unknown attn_impl {cfg.attn_impl!r} "
+                "(expected 'auto', 'flash' or 'xla')")
+        if cfg.attn_impl == "xla":
+            return False
+        if cfg.attn_impl == "flash" and cfg.head_dim % 64:
+            raise ValueError(
+                f"attn_impl='flash' needs head_dim%64==0, "
+                f"got D={cfg.head_dim}")
+        from ..ops.flash_attention import supported
+
+        ok = supported(seq_len, cfg.head_dim)
+        if cfg.attn_impl == "flash":
+            # Sub-block traces (e.g. the 8-token init sample) ride the
+            # dense path; real sequences use the kernel.
+            return ok
+        # auto: flash where it measurably wins on this hardware. Measured
+        # on the v5e (8-step LM train, base preset): XLA's fused dense
+        # attention is faster up to S=1024 (kernel launch overhead
+        # dominates); at S=2048 flash is 1.24x faster end-to-end (MFU
+        # 0.247 -> 0.305) because the O(S^2) score matrix stops touching
+        # HBM. Above 4096 the emulator's compiler rejects the
+        # scan+remat+kernel combination, so auto stays on XLA there
+        # (force attn_impl="flash" to override). tp composes (heads
+        # shard over "model"); sp composes (attention input is full-S).
+        import jax
+
+        return (ok and jax.default_backend() == "tpu"
+                and 2048 <= seq_len < 4096)
+
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
@@ -124,6 +162,30 @@ class Attention(nn.Module):
             out = jax.shard_map(
                 functools.partial(ring_attention, axis_name=AXIS_CTX),
                 in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        elif self._use_flash(S):
+            import functools
+
+            from ..ops.flash_attention import flash_attention
+
+            # Off-TPU (forced via attn_impl="flash", e.g. tests) the
+            # kernel runs in pallas interpret mode — same code path,
+            # reference semantics.
+            flash_attention = functools.partial(
+                flash_attention,
+                interpret=jax.default_backend() != "tpu")
+            mesh = jax.sharding.get_abstract_mesh()
+            if not mesh.empty:
+                # Under GSPMD a pallas call must be per-shard: batch rides
+                # "data", heads ride "model" (tp), seq/feature whole.
+                from ..parallel.mesh import AXIS_DATA, AXIS_MODEL
+                from jax.sharding import PartitionSpec as P
+
+                spec = P(AXIS_DATA, None, AXIS_MODEL, None)
+                out = jax.shard_map(flash_attention,
+                                    in_specs=(spec, spec, spec),
+                                    out_specs=spec)(q, k, v)
+            else:
+                out = flash_attention(q, k, v)
         else:
             # Dense causal attention (XLA fuses the softmax chain).
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)

@@ -1,0 +1,254 @@
+"""Pipeline controller: executes the step DAG over the platform's own
+resources (SURVEY.md §2.2 Pipelines row — the reference delegates DAG
+execution to Argo; here the reconcile loop IS the workflow engine).
+
+Each step becomes an owned child resource named ``<pipeline>-<step>``:
+template steps render to single-replica JAXJobs (the generic process
+runner), resource steps apply their embedded manifest. A step starts
+when every dependency has Succeeded; a failed step fails the pipeline
+and marks un-started downstream steps Skipped. ``${params.x}``
+substitutes pipeline parameters into step specs (same idiom as Katib's
+trialParameters), and every container gets KFX_PIPELINE_WORKSPACE — a
+shared scratch directory for passing artifacts between steps.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+from ..api import pipelines as P
+from ..api.base import Resource, ValidationError, from_manifest
+from ..core.controller import Controller, Result
+from ..core.store import AlreadyExists, Conflict, NotFound, ResourceStore
+
+_CHILD_KINDS = ("JAXJob", "TFJob", "PyTorchJob", "MPIJob", "Experiment",
+                "InferenceService", "Notebook")
+_PARAM_RE = re.compile(r"\$\{params\.([A-Za-z0-9_-]+)\}")
+
+
+def _substitute(node: Any, params: Dict[str, str]) -> Any:
+    if isinstance(node, str):
+        def repl(m):
+            key = m.group(1)
+            if key not in params:
+                raise ValidationError("spec.params",
+                                      f"undefined ${{params.{key}}}")
+            return params[key]
+
+        return _PARAM_RE.sub(repl, node)
+    if isinstance(node, dict):
+        return {k: _substitute(v, params) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_substitute(v, params) for v in node]
+    return node
+
+
+def _inject_workspace(spec: Dict[str, Any], workspace: str) -> None:
+    """Add KFX_PIPELINE_WORKSPACE to every container env in the spec
+    (recursively — replica specs nest templates at varying depths)."""
+    if isinstance(spec, dict):
+        for k, v in spec.items():
+            if k == "containers" and isinstance(v, list):
+                for c in v:
+                    env = c.setdefault("env", [])
+                    if not any(e.get("name") == "KFX_PIPELINE_WORKSPACE"
+                               for e in env):
+                        env.append({"name": "KFX_PIPELINE_WORKSPACE",
+                                    "value": workspace})
+            else:
+                _inject_workspace(v, workspace)
+    elif isinstance(spec, list):
+        for v in spec:
+            _inject_workspace(v, workspace)
+
+
+def _child_terminal(child: Resource) -> Optional[str]:
+    """Succeeded/Failed for jobs+experiments; Ready counts as success
+    for long-running kinds (a serving step completes on Ready)."""
+    if child.has_condition("Succeeded"):
+        return P.STEP_SUCCEEDED
+    if child.has_condition("Failed"):
+        return P.STEP_FAILED
+    if child.has_condition("Ready"):
+        return P.STEP_SUCCEEDED
+    return None
+
+
+class PipelineController(Controller):
+    KIND = "Pipeline"
+    OWNS = list(_CHILD_KINDS)
+    RESYNC_PERIOD = 2.0
+
+    def __init__(self, store: ResourceStore, workspace_root: str):
+        super().__init__(store)
+        self.workspace_root = workspace_root
+
+    # -- children -----------------------------------------------------------
+    @staticmethod
+    def _child_name(pipe: P.Pipeline, step: str) -> str:
+        return f"{pipe.name}-{step}"
+
+    @staticmethod
+    def _owned(child: Resource, pipe: P.Pipeline) -> bool:
+        return any(ref.get("kind") == "Pipeline"
+                   and ref.get("name") == pipe.name
+                   for ref in child.metadata.owner_references)
+
+    def _render_child(self, pipe: P.Pipeline, step: Dict[str, Any]
+                      ) -> Resource:
+        params = pipe.params()
+        if step.get("resource"):
+            manifest = _substitute(copy.deepcopy(step["resource"]), params)
+        else:
+            template = _substitute(copy.deepcopy(step["template"]), params)
+            manifest = {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "spec": {"runPolicy": {"backoffLimit": 0},
+                         "jaxReplicaSpecs": {"Worker": {
+                             "replicas": 1,
+                             "restartPolicy": "Never",
+                             "template": template}}},
+            }
+        meta = manifest.setdefault("metadata", {})
+        meta["name"] = self._child_name(pipe, step["name"])
+        meta["namespace"] = pipe.namespace
+        meta["ownerReferences"] = [{"kind": "Pipeline", "name": pipe.name}]
+        meta.setdefault("labels", {})["pipelines.kubeflow.org/pipeline"] = \
+            pipe.name
+        workspace = os.path.join(self.workspace_root,
+                                 f"{pipe.namespace}_{pipe.name}")
+        os.makedirs(workspace, exist_ok=True)
+        _inject_workspace(manifest.get("spec") or {}, workspace)
+        child = from_manifest(manifest)
+        child.validate()
+        return child
+
+    def on_delete(self, obj: Resource) -> None:
+        assert isinstance(obj, P.Pipeline)
+        for step in obj.steps():
+            kind = (step.get("resource") or {}).get("kind", "JAXJob")
+            child = self.store.try_get(
+                kind, self._child_name(obj, str(step["name"])),
+                obj.namespace)
+            if child is not None and self._owned(child, obj):
+                try:
+                    self.store.delete(kind, child.name, child.namespace)
+                except NotFound:
+                    pass
+        import shutil
+
+        shutil.rmtree(os.path.join(
+            self.workspace_root, f"{obj.namespace}_{obj.name}"),
+            ignore_errors=True)
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, key: str) -> Optional[Result]:
+        pipe = self.get_resource(key)
+        if pipe is None:
+            return None
+        assert isinstance(pipe, P.Pipeline)
+        if pipe.has_condition(P.PIPELINE_SUCCEEDED) or \
+                pipe.has_condition(P.PIPELINE_FAILED):
+            return None
+
+        steps = {str(s["name"]): s for s in pipe.steps()}
+        order = pipe.step_order()
+        phases: Dict[str, str] = {}
+        name_conflict = None
+        for name in order:
+            step = steps[name]
+            kind = (step.get("resource") or {}).get("kind", "JAXJob")
+            child = self.store.try_get(
+                kind, self._child_name(pipe, name), pipe.namespace)
+            if child is not None and not self._owned(child, pipe):
+                name_conflict = (name, kind)
+                phases[name] = P.STEP_FAILED
+                continue
+            if child is None:
+                phases[name] = P.STEP_PENDING
+            else:
+                phases[name] = _child_terminal(child) or P.STEP_RUNNING
+
+        if name_conflict is not None:
+            name, kind = name_conflict
+            self._finish(pipe, phases, P.PIPELINE_FAILED, "NameConflict")
+            self.record_event(
+                pipe, "Warning", "NameConflict",
+                f"unrelated {kind} named {self._child_name(pipe, name)} "
+                f"already exists")
+            return None
+
+        failed = [n for n, ph in phases.items() if ph == P.STEP_FAILED]
+        if failed:
+            # Stop launching new work; let in-flight steps drain so their
+            # final phases are recorded, then fail with Pending → Skipped.
+            running = [n for n, ph in phases.items()
+                       if ph == P.STEP_RUNNING]
+            if running:
+                self._write_status(pipe.key, phases, [
+                    (P.PIPELINE_RUNNING, "True", "DrainingAfterFailure")])
+                return Result(requeue=True, requeue_after=1.0)
+            for n, ph in phases.items():
+                if ph == P.STEP_PENDING:
+                    phases[n] = P.STEP_SKIPPED
+            self._finish(pipe, phases, P.PIPELINE_FAILED,
+                         f"Step:{failed[0]}")
+            self.record_event(pipe, "Warning", "StepFailed",
+                              f"step {failed[0]} failed")
+            return None
+
+        # start every Pending step whose deps are all Succeeded
+        started = []
+        for name in order:
+            if phases[name] != P.STEP_PENDING:
+                continue
+            deps = [str(d) for d in (steps[name].get("dependsOn") or [])]
+            if all(phases[d] == P.STEP_SUCCEEDED for d in deps):
+                child = self._render_child(pipe, steps[name])
+                try:
+                    self.store.create(child)
+                except AlreadyExists:
+                    continue  # raced with ourselves; next resync settles
+                phases[name] = P.STEP_RUNNING
+                started.append(name)
+        for name in started:
+            self.record_event(pipe, "Normal", "StepStarted",
+                              f"step {name} started")
+
+        if all(ph == P.STEP_SUCCEEDED for ph in phases.values()):
+            self._finish(pipe, phases, P.PIPELINE_SUCCEEDED, "AllSteps")
+            self.record_event(pipe, "Normal", "Succeeded",
+                              f"all {len(phases)} steps succeeded")
+            return None
+        self._write_status(pipe.key, phases, [
+            (P.PIPELINE_RUNNING, "True", "StepsInProgress")])
+        return Result(requeue=True, requeue_after=1.0)
+
+    # -- status -------------------------------------------------------------
+    def _finish(self, pipe: P.Pipeline, phases: Dict[str, str],
+                terminal: str, reason: str) -> None:
+        self._write_status(pipe.key, phases, [
+            (terminal, "True", reason),
+            (P.PIPELINE_RUNNING, "False", reason)])
+
+    def _write_status(self, key: str, phases, conds) -> None:
+        fresh = self.get_resource(key)
+        if fresh is None:
+            return
+        fresh.status["steps"] = dict(phases)
+        for ctype, status, reason in conds:
+            fresh.set_condition(ctype, status, reason, "")
+        try:
+            self.store.update_status(fresh)
+        except (Conflict, NotFound):
+            self.queue.add(key)
+
+
+def pipeline_controllers(store: ResourceStore, home: str
+                         ) -> List[Controller]:
+    return [PipelineController(
+        store, os.path.join(home, "pipeline-workspaces"))]

@@ -147,6 +147,101 @@ class TestClientMode:
         assert "error:" in capsys.readouterr().err
 
 
+class TestNotebookSpawner:
+    def test_spawn_and_delete_via_form(self, server):
+        """The jupyter-web-app equivalent: a form POST creates a Notebook
+        resource, the page lists it with its routed URL, and a delete
+        POST removes it."""
+        import time
+        import urllib.parse
+
+        st, page = _get(f"{server.url}/ui/notebooks")
+        assert st == 200 and "no notebooks yet" in page
+
+        form = urllib.parse.urlencode({
+            "action": "create", "name": "web-nb", "namespace": "default",
+            "command": f"{PY} -m http.server --bind 127.0.0.1 $(KFX_PORT)",
+            "idle": "0"})
+        st, page = _req(f"{server.url}/ui/notebooks", form.encode())
+        assert st == 200 and "created default/web-nb" in page
+
+        deadline = time.monotonic() + 60
+        url = None
+        while time.monotonic() < deadline:
+            st, body = _get(f"{server.url}/apis/notebook/default/web-nb")
+            obj = json.loads(body)
+            url = obj.get("status", {}).get("url")
+            conds = {c["type"]: c["status"]
+                     for c in obj.get("status", {}).get("conditions", [])}
+            if url and conds.get("Ready") == "True":
+                break
+            time.sleep(0.2)
+        assert url, "notebook never became ready"
+        _, page = _get(f"{server.url}/ui/notebooks")
+        assert "web-nb" in page and url in page
+
+        form = urllib.parse.urlencode({
+            "action": "delete", "name": "web-nb", "namespace": "default"})
+        st, page = _req(f"{server.url}/ui/notebooks", form.encode())
+        assert st == 200 and "deleted default/web-nb" in page
+        _get(f"{server.url}/apis/notebook/default/web-nb", expect=404)
+
+
+class TestKfam:
+    def test_binding_lifecycle(self, server):
+        import time
+
+        profile = """
+apiVersion: kubeflow.org/v1
+kind: Profile
+metadata:
+  name: team-z
+spec:
+  owner:
+    kind: User
+    name: alice@example.com
+"""
+        _req(f"{server.url}/apis", profile.encode())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
+            bindings = json.loads(body)["bindings"]
+            if bindings:
+                break
+            time.sleep(0.2)
+        assert [b["user"] for b in bindings] == ["alice@example.com"]
+
+        st, _ = _req(f"{server.url}/kfam/v1/bindings", json.dumps(
+            {"namespace": "team-z", "user": "bob@example.com",
+             "role": "edit"}).encode())
+        assert st == 200
+        while time.monotonic() < deadline:
+            _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
+            users = [b["user"] for b in json.loads(body)["bindings"]]
+            if "bob@example.com" in users:
+                break
+            time.sleep(0.2)
+        assert sorted(users) == ["alice@example.com", "bob@example.com"]
+
+        st, _ = _req(f"{server.url}/kfam/v1/bindings?namespace=team-z"
+                     f"&user=bob@example.com", method="DELETE")
+        assert st == 200
+        while time.monotonic() < deadline:
+            _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
+            users = [b["user"] for b in json.loads(body)["bindings"]]
+            if "bob@example.com" not in users:
+                break
+            time.sleep(0.2)
+        assert users == ["alice@example.com"]
+        # removing a non-binding 404s
+        try:
+            _req(f"{server.url}/kfam/v1/bindings?namespace=team-z"
+                 f"&user=ghost@example.com", method="DELETE")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+
 class TestDashboard:
     def test_root_and_resource_page(self, server):
         st, body = _get(f"{server.url}/")

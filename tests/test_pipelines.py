@@ -1,0 +1,161 @@
+"""Pipeline DAG engine tests: validation (cycles, unknown deps), topo
+ordering, step fan-out/fan-in, parameter substitution, shared workspace,
+failure short-circuit with Skipped downstream, and cascade delete."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api.base import ValidationError, from_manifest
+from kubeflow_tpu.controlplane import ControlPlane
+
+PY = sys.executable
+
+
+def _pipeline(name, steps, params=None):
+    return from_manifest({
+        "apiVersion": "kubeflow.org/v1", "kind": "Pipeline",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"params": params or {}, "steps": steps}})
+
+
+def _cmd_step(name, code, depends=None):
+    s = {"name": name,
+         "template": {"spec": {"containers": [{
+             "name": "main", "command": [PY, "-c", code]}]}}}
+    if depends:
+        s["dependsOn"] = depends
+    return s
+
+
+@pytest.fixture()
+def cp(tmp_path):
+    with ControlPlane(home=str(tmp_path / "kfx"),
+                      worker_platform="cpu") as plane:
+        yield plane
+
+
+class TestValidation:
+    def test_cycle_rejected(self):
+        p = _pipeline("c", [
+            _cmd_step("a", "pass", depends=["b"]),
+            _cmd_step("b", "pass", depends=["a"])])
+        with pytest.raises(ValidationError, match="cycle"):
+            p.validate()
+
+    def test_unknown_dep_rejected(self):
+        p = _pipeline("u", [_cmd_step("a", "pass", depends=["ghost"])])
+        with pytest.raises(ValidationError, match="unknown step"):
+            p.validate()
+
+    def test_duplicate_and_empty(self):
+        with pytest.raises(ValidationError, match="duplicate"):
+            _pipeline("d", [_cmd_step("a", "1"),
+                            _cmd_step("a", "2")]).validate()
+        with pytest.raises(ValidationError, match="at least one"):
+            _pipeline("e", []).validate()
+
+    def test_topo_order(self):
+        p = _pipeline("t", [
+            _cmd_step("z", "pass", depends=["a", "b"]),
+            _cmd_step("a", "pass"),
+            _cmd_step("b", "pass", depends=["a"])])
+        order = p.step_order()
+        assert order.index("a") < order.index("b") < order.index("z")
+
+
+class TestExecution:
+    def test_diamond_dag_runs_in_order(self, cp, tmp_path):
+        """a -> (b, c) -> d: artifacts through the shared workspace prove
+        ordering; d sees both b's and c's outputs."""
+        write = ("import os, pathlib, time\n"
+                 "ws = pathlib.Path(os.environ['KFX_PIPELINE_WORKSPACE'])\n"
+                 "(ws / '{n}.txt').write_text(str(time.time()))\n")
+        check = ("import os, pathlib, sys\n"
+                 "ws = pathlib.Path(os.environ['KFX_PIPELINE_WORKSPACE'])\n"
+                 "ok = all((ws / f).exists() for f in "
+                 "['a.txt', 'b.txt', 'c.txt'])\n"
+                 "sys.exit(0 if ok else 1)\n")
+        cp.apply([_pipeline("diamond", [
+            _cmd_step("a", write.format(n="a")),
+            _cmd_step("b", write.format(n="b"), depends=["a"]),
+            _cmd_step("c", write.format(n="c"), depends=["a"]),
+            _cmd_step("d", check, depends=["b", "c"]),
+        ])])
+        final = cp.wait_for_condition("Pipeline", "diamond", "Succeeded",
+                                      timeout=120)
+        assert final.status["steps"] == {
+            "a": "Succeeded", "b": "Succeeded", "c": "Succeeded",
+            "d": "Succeeded"}
+
+    def test_params_substituted(self, cp):
+        step = {"name": "s", "template": {"spec": {"containers": [{
+            "name": "main",
+            "command": [PY, "-c", "print('val=${params.x}')"]}]}}}
+        cp.apply([_pipeline("par", [step], params={"x": "42"})])
+        cp.wait_for_condition("Pipeline", "par", "Succeeded", timeout=60)
+        log = cp.job_logs("JAXJob", "par-s")
+        assert "val=42" in log
+
+    def test_failure_skips_downstream(self, cp):
+        cp.apply([_pipeline("fail", [
+            _cmd_step("bad", "raise SystemExit(3)"),
+            _cmd_step("after", "pass", depends=["bad"]),
+        ])])
+        final = cp.wait_for_condition("Pipeline", "fail", "Failed",
+                                      timeout=60)
+        assert final.status["steps"]["bad"] == "Failed"
+        assert final.status["steps"]["after"] == "Skipped"
+
+    def test_delete_cascades(self, cp):
+        cp.apply([_pipeline("del", [
+            _cmd_step("long", "import time; time.sleep(600)")])])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if cp.store.try_get("JAXJob", "del-long") is not None:
+                break
+            time.sleep(0.1)
+        assert cp.store.try_get("JAXJob", "del-long") is not None
+        cp.store.delete("Pipeline", "del")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if cp.store.try_get("JAXJob", "del-long") is None:
+                break
+            time.sleep(0.2)
+        assert cp.store.try_get("JAXJob", "del-long") is None
+
+    def test_resource_step_runs_experiment(self, cp):
+        """A resource step embeds an Experiment: the pipeline waits for
+        the sweep's terminal condition (DAG-over-HPO composition)."""
+        exp = {
+            "apiVersion": "kubeflow.org/v1", "kind": "Experiment",
+            "spec": {
+                "objective": {"type": "maximize",
+                              "objectiveMetricName": "score"},
+                "algorithm": {"algorithmName": "random"},
+                "maxTrialCount": 2, "parallelTrialCount": 2,
+                "parameters": [{
+                    "name": "x", "parameterType": "double",
+                    "feasibleSpace": {"min": "0.0", "max": "1.0"}}],
+                "trialTemplate": {
+                    "trialParameters": [{"name": "x", "reference": "x"}],
+                    "trialSpec": {
+                        "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+                        "spec": {"jaxReplicaSpecs": {"Worker": {
+                            "replicas": 1, "restartPolicy": "Never",
+                            "template": {"spec": {"containers": [{
+                                "name": "t",
+                                "command": [
+                                    PY, "-c",
+                                    "print('score=${trialParameters.x}')"],
+                            }]}}}}}}}}}
+        cp.apply([_pipeline("sweep", [
+            {"name": "hpo", "resource": exp},
+            _cmd_step("report", "pass", depends=["hpo"]),
+        ])])
+        final = cp.wait_for_condition("Pipeline", "sweep", "Succeeded",
+                                      timeout=120)
+        assert final.status["steps"] == {"hpo": "Succeeded",
+                                         "report": "Succeeded"}
