@@ -190,7 +190,9 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path == "/kfam/v1/bindings":
                 return self._kfam_post(json.loads(text))
             return self._error(404, f"no route {url.path}")
-        except (ValidationError, Conflict, AlreadyExists, NotFound,
+        except NotFound as e:
+            return self._error(404, str(e))
+        except (ValidationError, Conflict, AlreadyExists,
                 KeyError, ValueError) as e:
             return self._error(400, str(e))
         except Exception as e:

@@ -67,6 +67,11 @@ class TransformerConfig:
     # dense path; "flash"/"xla" force one. cp>1 always rides ring
     # attention (its own seq-sharded kernel).
     attn_impl: str = "auto"
+    # Autoregressive decoding: every attention layer keeps a KV cache
+    # ("cache" collection) of max_seq_len slots and calls attend the new
+    # tokens against it. Position ids must be passed explicitly (pads are
+    # -1 and masked out of the cache). Built via models.generate.
+    decode: bool = False
 
     @property
     def qkv_features(self) -> int:
@@ -144,11 +149,16 @@ class Attention(nn.Module):
         q = proj("query", (cfg.n_heads, cfg.head_dim))(x)
         k = proj("key", (cfg.n_heads, cfg.head_dim))(x)
         v = proj("value", (cfg.n_heads, cfg.head_dim))(x)
-        q = rope(q, positions)
-        k = rope(k, positions)
+        # RoPE with absolute positions (pads carry -1; their rows are
+        # masked out of every decode-mode attention, so the garbage
+        # rotation never contributes).
+        q = rope(q, jnp.maximum(positions, 0))
+        k = rope(k, jnp.maximum(positions, 0))
         q = q / np.sqrt(cfg.head_dim)
 
-        if cfg.cp > 1:
+        if cfg.decode:
+            out = self._decode_attend(q, k, v, positions)
+        elif cfg.cp > 1:
             # Context-parallel path: seq sharded over "ctx", heads over
             # "model" (each head attends independently, so tp composes),
             # exact causal ring attention rotating K/V between neighbours.
@@ -196,6 +206,40 @@ class Attention(nn.Module):
         return nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                                name="out")(out)
+
+    def _decode_attend(self, q, k, v, positions):
+        """KV-cache attention: write the S new (already-roped) K/V rows
+        at the cache cursor, attend Q against every valid cached slot.
+        Per-slot validity is the cached position id (-1 = empty/pad), so
+        left- or right-padded prompts both stay exact."""
+        cfg = self.cfg
+        B, S, H, D = q.shape
+        L = cfg.max_seq_len
+        ck = self.variable("cache", "cached_key",
+                           lambda: jnp.zeros((B, L, H, D), cfg.dtype))
+        cv = self.variable("cache", "cached_value",
+                           lambda: jnp.zeros((B, L, H, D), cfg.dtype))
+        cpos = self.variable("cache", "cached_pos",
+                             lambda: jnp.full((B, L), -1, jnp.int32))
+        cur = self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((), jnp.int32))
+        i = cur.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, i, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, i, 0, 0))
+        cpos.value = jax.lax.dynamic_update_slice(cpos.value, positions,
+                                                  (0, i))
+        cur.value = i + S
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value)  # [B,H,S,L]
+        kp = cpos.value[:, None, None, :]                    # [B,1,1,L]
+        qp = positions[:, None, :, None]                     # [B,1,S,1]
+        mask = (kp >= 0) & (kp <= qp)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype),
+                          cv.value)
 
 
 class DenseFFN(nn.Module):
@@ -337,7 +381,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, positions=None):
         cfg = self.cfg
         if cfg.cp > 1:
             # Pin the token layout before the (vocab-sharded) embedding
@@ -354,15 +398,16 @@ class TransformerLM(nn.Module):
         if cfg.cp > 1:
             x = jax.lax.with_sharding_constraint(
                 x, P(AXIS_DATA, AXIS_CTX, None))
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
 
         block = Block
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         ScanBlock = nn.scan(
             block,
-            variable_axes={"params": 0, "aux_loss": 0},
+            variable_axes={"params": 0, "aux_loss": 0, "cache": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,  # positions broadcast to every layer
             length=cfg.n_layers,

@@ -208,7 +208,21 @@ class PipelineController(Controller):
                 continue
             deps = [str(d) for d in (steps[name].get("dependsOn") or [])]
             if all(phases[d] == P.STEP_SUCCEEDED for d in deps):
-                child = self._render_child(pipe, steps[name])
+                try:
+                    child = self._render_child(pipe, steps[name])
+                except (ValidationError, KeyError, TypeError) as e:
+                    # A step that cannot render (undefined parameter,
+                    # invalid embedded manifest) fails the pipeline with
+                    # a reason — never a silent retry loop.
+                    phases[name] = P.STEP_FAILED
+                    for n, ph in phases.items():
+                        if ph == P.STEP_PENDING:
+                            phases[n] = P.STEP_SKIPPED
+                    self._finish(pipe, phases, P.PIPELINE_FAILED,
+                                 "StepRenderError")
+                    self.record_event(pipe, "Warning", "StepRenderError",
+                                      f"step {name}: {e}")
+                    return None
                 try:
                     self.store.create(child)
                 except AlreadyExists:

@@ -43,6 +43,9 @@ def parse_args(argv=None):
     p.add_argument("--keep-checkpoints", type=int, default=2)
     p.add_argument("--no-checkpoint", action="store_true")
     p.add_argument("--fail-at-step", type=int, default=-1)
+    p.add_argument("--export-dir", default="",
+                   help="after training, write a servable LM export here "
+                        "(serving/lm_server.py format)")
     return p.parse_args(argv)
 
 
@@ -165,6 +168,11 @@ def main(argv=None) -> int:
     if ckpt is not None:
         ckpt.maybe_save(args.steps, state, force=True)
         ckpt.close()
+    if args.export_dir and rank == 0:
+        from ..serving.lm_server import export_lm
+
+        export_lm(args.export_dir, cfg, state.params)
+        print(f"exported_lm dir={args.export_dir}", flush=True)
     return 0
 
 

@@ -1,0 +1,125 @@
+"""LM serving: text-generation predictor behind the model server.
+
+Export format (``export_lm``): ``lm_config.json`` (the TransformerConfig,
+dtypes as strings) + ``params.msgpack``. The predictor wraps
+models/generate.LMGenerator — jitted KV-cache prefill + scan decode, one
+device dispatch per request — and serves a ``:generate`` verb:
+
+    POST /v1/models/{m}:generate
+    {"prompt_tokens": [[1,2,3], ...], "max_new_tokens": 32,
+     "temperature": 0.7, "top_k": 40, "seed": 1}
+    -> {"generated_tokens": [[...], ...]}
+
+Tokenization is caller-side (the platform is tokenizer-agnostic, like
+the reference's bring-your-own-model servers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from .server import Predictor
+
+CONFIG_FILE = "lm_config.json"
+PARAMS_FILE = "params.msgpack"
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+           "float16": jnp.float16}
+
+
+def export_lm(directory: str, cfg, params) -> str:
+    """Write a servable LM export from train-time config + params."""
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    d["param_dtype"] = jnp.dtype(cfg.param_dtype).name
+    with open(os.path.join(directory, CONFIG_FILE), "w") as f:
+        json.dump({"framework": "lm", "config": d}, f)
+    with open(os.path.join(directory, PARAMS_FILE), "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(params)))
+    return directory
+
+
+def load_lm(directory: str):
+    from ..models.transformer import TransformerConfig
+
+    with open(os.path.join(directory, CONFIG_FILE)) as f:
+        meta = json.load(f)
+    d = dict(meta["config"])
+    d["dtype"] = _DTYPES[d.get("dtype", "bfloat16")]
+    d["param_dtype"] = _DTYPES[d.get("param_dtype", "float32")]
+    cfg = TransformerConfig(**d)
+    with open(os.path.join(directory, PARAMS_FILE), "rb") as f:
+        params = serialization.msgpack_restore(f.read())
+    return cfg, params
+
+
+def is_lm_export(model_dir: str) -> bool:
+    return os.path.exists(os.path.join(model_dir, CONFIG_FILE))
+
+
+class LMPredictor(Predictor):
+    """Generate-only predictor (classification ``:predict`` does not
+    apply; the server routes ``:generate`` here)."""
+
+    def __init__(self, model_dir: str, name: str = "",
+                 max_batch_size: int = 8, device: str = "auto"):
+        self.model_dir = model_dir
+        self.name = name or "model"
+        self.max_batch_size = max_batch_size
+        self.device = device
+        self._gen = None
+        self.vocab_size = 0
+
+    def load(self) -> None:
+        import jax
+
+        from ..models.generate import LMGenerator
+
+        cfg, params = load_lm(self.model_dir)
+        if self.device == "cpu":
+            params = jax.device_put(params, jax.devices("cpu")[0])
+        self.vocab_size = cfg.vocab_size
+        self._gen = LMGenerator(cfg, params)
+        # Pre-warm the smallest bucket so the first request doesn't pay
+        # the prefill+decode compile.
+        self._gen.generate([[0]], max_new_tokens=8)
+        self.ready = True
+
+    def predict(self, instances, probabilities: bool = False
+                ) -> Dict[str, Any]:
+        raise NotImplementedError(
+            "LM models serve :generate, not :predict")
+
+    def generate(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        prompts = body.get("prompt_tokens")
+        if not prompts or not isinstance(prompts, list):
+            raise ValueError("prompt_tokens (list of token-id lists) "
+                             "is required")
+        if isinstance(prompts[0], int):  # single prompt convenience
+            prompts = [prompts]
+        if len(prompts) > self.max_batch_size:
+            raise ValueError(f"batch {len(prompts)} exceeds "
+                             f"max_batch_size {self.max_batch_size}")
+        for p in prompts:
+            arr = np.asarray(p)
+            if arr.size == 0 or arr.min() < 0 or \
+                    arr.max() >= self.vocab_size:
+                raise ValueError(
+                    f"prompt token ids must be in [0, {self.vocab_size})")
+        out = self._gen.generate(
+            [list(map(int, p)) for p in prompts],
+            max_new_tokens=int(body.get("max_new_tokens", 32)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            seed=int(body.get("seed", 0)))
+        return {"generated_tokens": out}

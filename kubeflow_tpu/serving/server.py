@@ -365,6 +365,8 @@ class ModelServer:
 
     def _handle_post(self, h) -> None:
         path = h.path
+        if path.startswith("/v1/models/") and path.endswith(":generate"):
+            return self._handle_generate(h)
         if not (path.startswith("/v1/models/") and path.endswith(":predict")):
             h._send(404, {"error": f"no route {path}"})
             return
@@ -390,6 +392,39 @@ class ModelServer:
             batcher = self.batchers.get(name)
             result = (batcher or p).predict(instances,
                                             probabilities=want_probs)
+        except Exception as e:
+            h._send(500, {"error": str(e)})
+            return
+        h._send(200, result)
+
+    def _handle_generate(self, h) -> None:
+        """LM text generation (serving/lm_server.py): token ids in,
+        generated token ids out."""
+        name = h.path[len("/v1/models/"):-len(":generate")]
+        p = self.predictors.get(name)
+        if p is None:
+            h._send(404, {"error": f"model {name!r} not found"})
+            return
+        if not getattr(p, "generate", None):
+            h._send(400, {"error": f"model {name!r} does not support "
+                                   f":generate"})
+            return
+        if not p.ready:
+            h._send(503, {"error": f"model {name!r} not ready"})
+            return
+        try:
+            length = int(h.headers.get("Content-Length", 0))
+            body = json.loads(h.rfile.read(length) or b"{}")
+        except ValueError as e:
+            h._send(400, {"error": f"bad request: {e}"})
+            return
+        with self._lock:
+            self.request_count += 1
+        try:
+            result = p.generate(body)
+        except ValueError as e:
+            h._send(400, {"error": str(e)})
+            return
         except Exception as e:
             h._send(500, {"error": str(e)})
             return
@@ -422,11 +457,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--batcher-max-latency-ms", type=float, default=0.0,
                    help=">0 enables the micro-batcher")
     p.add_argument("--batcher-reply-timeout-s", type=float, default=60.0)
+    p.add_argument("--framework", default="auto",
+                   choices=["auto", "jax", "pytorch", "lm"],
+                   help="predict backend; auto sniffs the export format")
     args = p.parse_args(argv)
 
-    predictor = JaxPredictor(args.model_dir, name=args.name,
-                             max_batch_size=args.max_batch_size,
-                             device=args.device)
+    framework = args.framework
+    if framework == "auto":
+        from .lm_server import is_lm_export
+        from .torch_server import is_torch_export
+
+        if is_lm_export(args.model_dir):
+            framework = "lm"
+        elif is_torch_export(args.model_dir):
+            framework = "pytorch"
+        else:
+            framework = "jax"
+    if framework == "lm":
+        from .lm_server import LMPredictor
+
+        predictor = LMPredictor(args.model_dir, name=args.name,
+                                max_batch_size=args.max_batch_size,
+                                device=args.device)
+    elif framework == "pytorch":
+        if args.device not in ("auto", "cpu"):
+            print(f"warning: --device={args.device} ignored "
+                  f"(torch backend runs CPU here)", flush=True)
+        from .torch_server import TorchPredictor
+
+        predictor = TorchPredictor(args.model_dir, name=args.name,
+                                   max_batch_size=args.max_batch_size)
+    else:
+        predictor = JaxPredictor(args.model_dir, name=args.name,
+                                 max_batch_size=args.max_batch_size,
+                                 device=args.device)
     t0 = time.time()
     predictor.load()
     server = ModelServer(port=args.port)
@@ -438,9 +502,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     server.register(predictor, batcher)
     server.start()
     print(f"server_ready name={args.name} port={server.port} "
+          f"framework={framework} "
           f"load_seconds={time.time() - t0:.1f} "
-          f"placement={json.dumps(predictor.placement)} "
-          f"probe_ms={json.dumps(predictor.probe_ms)}", flush=True)
+          f"placement={json.dumps(getattr(predictor, 'placement', {}))} "
+          f"probe_ms={json.dumps(getattr(predictor, 'probe_ms', {}))}",
+          flush=True)
     try:
         while True:
             time.sleep(3600)

@@ -1,0 +1,97 @@
+"""PyTorch predictor — KFServing pytorch-server parity (SURVEY.md §2.2
+"KFServing python servers" row: the reference ships per-framework model
+servers behind one protocol; here the V1 data plane and micro-batcher are
+shared and only the predict backend differs).
+
+Serves a TorchScript export: a directory with ``model.pt`` (and an
+optional ``config.json`` carrying input_shape/num_classes metadata).
+Inference runs torch CPU under ``torch.inference_mode()`` with intra-op
+threads left to torch's defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .server import Predictor
+
+MODEL_FILE = "model.pt"
+
+
+def export_torchscript(directory: str, module, input_shape=None,
+                       num_classes: Optional[int] = None) -> str:
+    """Write a servable TorchScript export (scripts the module)."""
+    import torch
+
+    os.makedirs(directory, exist_ok=True)
+    scripted = torch.jit.script(module)
+    scripted.save(os.path.join(directory, MODEL_FILE))
+    meta: Dict[str, Any] = {"framework": "pytorch"}
+    if input_shape is not None:
+        meta["input_shape"] = list(input_shape)
+    if num_classes is not None:
+        meta["num_classes"] = int(num_classes)
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(meta, f)
+    return directory
+
+
+def is_torch_export(model_dir: str) -> bool:
+    return os.path.exists(os.path.join(model_dir, MODEL_FILE))
+
+
+class TorchPredictor(Predictor):
+    """V1-protocol predictor over a TorchScript module (CPU torch)."""
+
+    def __init__(self, model_dir: str, name: str = "",
+                 max_batch_size: int = 64, device: str = "cpu"):
+        self.model_dir = model_dir
+        self.name = name or "model"
+        self.max_batch_size = max_batch_size
+        self._module = None
+        self.input_shape = None
+        self.num_classes = None
+
+    def load(self) -> None:
+        import torch
+
+        self._module = torch.jit.load(
+            os.path.join(self.model_dir, MODEL_FILE), map_location="cpu")
+        self._module.eval()
+        cfg_path = os.path.join(self.model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                meta = json.load(f)
+            if meta.get("input_shape"):
+                self.input_shape = tuple(meta["input_shape"])
+            if meta.get("num_classes"):
+                self.num_classes = int(meta["num_classes"])
+        # Warm one forward so the first request doesn't pay lazy init.
+        if self.input_shape:
+            x = np.zeros((1,) + self.input_shape, np.float32)
+            self.predict(x)
+        self.ready = True
+
+    def predict(self, instances: np.ndarray,
+                probabilities: bool = False) -> Dict[str, Any]:
+        import torch
+
+        x = torch.from_numpy(np.asarray(instances, np.float32))
+        outs = []
+        probs = []
+        with torch.inference_mode():
+            for i in range(0, len(x), self.max_batch_size):
+                logits = self._module(x[i:i + self.max_batch_size])
+                outs.append(logits.argmax(-1).numpy())
+                if probabilities:
+                    probs.append(
+                        torch.softmax(logits, -1).numpy())
+        result: Dict[str, Any] = {
+            "predictions": np.concatenate(outs).tolist()}
+        if probabilities:
+            result["probabilities"] = np.concatenate(probs).tolist()
+        return result

@@ -109,6 +109,17 @@ class TestExecution:
         assert final.status["steps"]["bad"] == "Failed"
         assert final.status["steps"]["after"] == "Skipped"
 
+    def test_undefined_param_fails_pipeline(self, cp):
+        """A step that cannot render (undefined ${params.x}) must FAIL
+        the pipeline with an event — never spin in a retry loop."""
+        cp.apply([_pipeline("badparam", [
+            _cmd_step("s", "print('${params.nope}')")])])
+        final = cp.wait_for_condition("Pipeline", "badparam", "Failed",
+                                      timeout=30)
+        assert final.status["steps"]["s"] in ("Failed", "Skipped")
+        events = cp.store.events_for("Pipeline", "default/badparam")
+        assert any(e.reason == "StepRenderError" for e in events)
+
     def test_delete_cascades(self, cp):
         cp.apply([_pipeline("del", [
             _cmd_step("long", "import time; time.sleep(600)")])])

@@ -47,6 +47,64 @@ def export_dir(tmp_path_factory):
     return str(out)
 
 
+class TestTorchServing:
+    """pytorch-server parity: a TorchScript export behind the same V1
+    protocol and InferenceService operator (framework auto-sniffed from
+    the export format)."""
+
+    @pytest.fixture(scope="class")
+    def torch_export(self, tmp_path_factory):
+        import torch
+
+        from kubeflow_tpu.serving.torch_server import export_torchscript
+
+        torch.manual_seed(0)
+        module = torch.nn.Sequential(
+            torch.nn.Flatten(), torch.nn.Linear(16, 8), torch.nn.ReLU(),
+            torch.nn.Linear(8, 3))
+        out = tmp_path_factory.mktemp("torch-export")
+        export_torchscript(str(out), module, input_shape=(4, 4),
+                           num_classes=3)
+        return str(out)
+
+    def test_predictor_direct(self, torch_export):
+        from kubeflow_tpu.serving.torch_server import TorchPredictor
+
+        p = TorchPredictor(torch_export, name="t")
+        p.load()
+        assert p.ready and p.input_shape == (4, 4)
+        out = p.predict(np.zeros((5, 4, 4), np.float32),
+                        probabilities=True)
+        assert len(out["predictions"]) == 5
+        assert np.allclose(np.sum(out["probabilities"], axis=-1), 1.0,
+                           atol=1e-5)
+
+    def test_isvc_e2e(self, torch_export, tmp_path):
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: torchy
+spec:
+  predictor:
+    minReplicas: 1
+    pytorch:
+      storageUri: file://{torch_export}
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "torchy",
+                                         "Ready", timeout=120)
+            url = isvc.status["url"]
+            x = np.zeros((2, 4, 4), np.float32)
+            status, body = _post(f"{url}/v1/models/torchy:predict",
+                                 {"instances": x.tolist()}, timeout=60)
+            assert status == 200 and len(body["predictions"]) == 2
+
+
 class TestModelServer:
     @pytest.fixture(scope="class")
     def server(self, export_dir):
