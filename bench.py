@@ -95,6 +95,7 @@ def main() -> int:
     # (attn_impl="auto" switches at S>=2048; measured 1.24x over the XLA
     # dense path at this shape on the v5e).
     lm.update(_bench_lm(batch=8, seq_len=2048, n_steps=6, prefix="lm_long_"))
+    lm.update(_bench_lm_decode())
     out = {
         "metric": "mnist_jaxjob_wall_clock_s",
         "value": round(wall, 2),
@@ -161,6 +162,47 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
         return {prefix + k: v for k, v in out.items()}
     except Exception as e:  # secondary metric must not sink the bench
         return {prefix + "error": str(e)[:200]}
+
+
+def _bench_lm_decode(preset: str = "small", batch: int = 4,
+                     prompt_len: int = 64, max_new: int = 64) -> dict:
+    """Generation throughput: jitted KV-cache prefill + scan decode
+    (models/generate.py) on the real TPU — decoded tokens per second
+    across the batch, measured after the one-time compile."""
+    try:
+        import numpy as np
+
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.models.transformer import (
+            TransformerLM, preset_config)
+
+        import jax
+
+        cfg = preset_config(preset, max_seq_len=512)
+        rng = np.random.default_rng(0)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
+        gen = LMGenerator(cfg, params)
+        prompts = [list(rng.integers(0, cfg.vocab_size, prompt_len))
+                   for _ in range(batch)]
+        gen.generate(prompts, max_new_tokens=max_new)  # compile + warm
+        t0 = time.perf_counter()
+        reps = 3
+        for i in range(reps):
+            out = gen.generate(prompts, max_new_tokens=max_new,
+                               temperature=0.7, seed=i)
+        dt = (time.perf_counter() - t0) / reps
+        return {
+            "lm_decode_model": preset,
+            "lm_decode_batch": batch,
+            "lm_decode_prompt_len": prompt_len,
+            "lm_decode_new_tokens": max_new,
+            "lm_decode_tokens_per_s": round(batch * max_new / dt, 1),
+            "lm_decode_ms_per_token": round(dt / max_new * 1000, 2),
+        }
+    except Exception as e:  # secondary metric must not sink the bench
+        return {"lm_decode_error": str(e)[:200]}
 
 
 def _bench_serving_p50(n_requests: int = 200) -> dict:
