@@ -190,8 +190,8 @@ def _bench_lm_decode(preset: str = "small", batch: int = 4,
         t0 = time.perf_counter()
         reps = 3
         for i in range(reps):
-            out = gen.generate(prompts, max_new_tokens=max_new,
-                               temperature=0.7, seed=i)
+            gen.generate(prompts, max_new_tokens=max_new,
+                         temperature=0.7, seed=i)
         dt = (time.perf_counter() - t0) / reps
         return {
             "lm_decode_model": preset,

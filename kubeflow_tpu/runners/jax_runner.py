@@ -41,6 +41,11 @@ def parse_args(argv=None):
     p.add_argument("--keep-checkpoints", type=int, default=2)
     p.add_argument("--eval-samples", type=int, default=2048)
     p.add_argument("--no-checkpoint", action="store_true")
+    p.add_argument("--data-pipeline", default="auto",
+                   choices=["auto", "device", "host"],
+                   help="auto/device: generate synthetic batches ON "
+                        "DEVICE inside the training scan (zero input "
+                        "transfer); host: classic host feed + prefetch")
     p.add_argument("--scan-steps", type=int, default=1,
                    help="steps fused into one XLA dispatch via lax.scan "
                         "(amortises host↔device round-trips)")
@@ -131,12 +136,21 @@ def main(argv=None) -> int:
 
     t_start = time.time()
     t_last = t_start
-    it = dataset.batches(args.batch_size, shard_index=rank, num_shards=world,
-                         steps=None, epoch_seed=0)
-    # Skip the batches already consumed before the restart so the data
-    # stream continues where the checkpoint left off.
-    for _ in range(start_step):
-        next(it)
+    device_capable = (args.data_pipeline != "host"
+                      and hasattr(dataset, "device_batch_fn"))
+    if args.data_pipeline == "device" and not device_capable:
+        print(f"error: --data-pipeline=device but dataset "
+              f"{args.dataset!r} has no device batch generator",
+              file=sys.stderr)
+        return 2
+    if not device_capable:
+        it = dataset.batches(args.batch_size, shard_index=rank,
+                             num_shards=world, steps=None, epoch_seed=0)
+        # Skip the batches already consumed before the restart so the
+        # data stream continues where the checkpoint left off (device
+        # mode needs no skip: keys fold in the absolute step).
+        for _ in range(start_step):
+            next(it)
 
     # Chunk size: constant K aligned to log/checkpoint/fault boundaries so
     # fused dispatch never skips a contract point (exactly one compiled
@@ -151,6 +165,12 @@ def main(argv=None) -> int:
     loss = acc = 0.0
     step = start_step
     import numpy as np
+
+    device_data = device_capable
+    if device_data:
+        log("data_pipeline=device (batches generated on device; zero "
+            "input transfer per step)")
+        batch_fn = dataset.device_batch_fn()
 
     # Host-side prefetch: the next chunk is generated while the device
     # runs the current one (hides input-pipeline latency behind compute).
@@ -186,7 +206,9 @@ def main(argv=None) -> int:
         except BaseException as e:
             prefetch_q.put(e)
 
-    _threading.Thread(target=_prefetch, daemon=True).start()
+    if not device_data:
+        _threading.Thread(target=_prefetch, daemon=True).start()
+    chunks = _plan_chunks() if device_data else None
     while step < args.steps:
         if step == args.fail_at_step:
             if ckpt is not None:
@@ -197,15 +219,21 @@ def main(argv=None) -> int:
             log(f"fault_injection_crash step={step}")
             sys.stdout.flush()
             os._exit(17)
-        got = prefetch_q.get()
-        if isinstance(got, BaseException):
-            raise RuntimeError("input prefetch thread failed") from got
-        s, k, (images, labels) = got
-        assert s == step, f"prefetch desync: {s} != {step}"
-        if k <= 1:
-            state, loss, acc = loop.train_step(state, images, labels)
+        if device_data:
+            s, k = next(chunks)
+            assert s == step, f"chunk desync: {s} != {step}"
+            state, loss, acc = loop.train_steps_device(
+                state, batch_fn, args.batch_size, s, k)
         else:
-            state, loss, acc = loop.train_steps(state, images, labels)
+            got = prefetch_q.get()
+            if isinstance(got, BaseException):
+                raise RuntimeError("input prefetch thread failed") from got
+            s, k, (images, labels) = got
+            assert s == step, f"prefetch desync: {s} != {step}"
+            if k <= 1:
+                state, loss, acc = loop.train_step(state, images, labels)
+            else:
+                state, loss, acc = loop.train_steps(state, images, labels)
         step += k
         now = time.time()
         if step % args.log_every == 0 or step == args.steps:

@@ -61,6 +61,11 @@ class TrainLoop:
         self._train_step = None
         self._train_many_fn = None
         self._eval_step = None
+        # Device-data pipeline: compiled fns keyed by (generator identity,
+        # chunk length, batch size); values pin the batch_fn so id() can
+        # never be recycled while its compile is cached.
+        self._device_fns: Dict[Any, Tuple[Any, Any]] = {}
+        self._device_key = jax.random.PRNGKey(seed + 1)
 
     # -- state -------------------------------------------------------------
     def init_state(self, sample_shape: Tuple[int, ...]) -> TrainState:
@@ -136,6 +141,51 @@ class TrainLoop:
             out_shardings=(self.repl, self.repl, self.repl),
             donate_argnums=(0,),
         )
+
+    def _build_train_many_device(self, batch_fn, batch_size: int,
+                                 n_steps: int):
+        """K steps per dispatch where each step's batch is GENERATED on
+        device by ``batch_fn(key, batch_size)`` — no input transfer at
+        all (see data/synthetic.Dataset.device_batch_fn). Keys fold in
+        the absolute step index, so restarts resume the same stream."""
+        step = self._step_body()
+        spec_x = self.batch_sharding
+        spec_y = self.batch_sharding
+
+        def many(state: TrainState, base_key, start_step):
+            def one(state, i):
+                key = jax.random.fold_in(base_key, start_step + i)
+                images, labels = batch_fn(key, batch_size)
+                images = jax.lax.with_sharding_constraint(images, spec_x)
+                labels = jax.lax.with_sharding_constraint(labels, spec_y)
+                state, loss, acc = step(state, images, labels)
+                return state, (loss, acc)
+
+            state, (losses, accs) = jax.lax.scan(
+                one, state, jnp.arange(n_steps))
+            return state, losses[-1], accs[-1]
+
+        return jax.jit(
+            many,
+            in_shardings=(self.repl, self.repl, self.repl),
+            out_shardings=(self.repl, self.repl, self.repl),
+            donate_argnums=(0,),
+        )
+
+    def train_steps_device(self, state: TrainState, batch_fn,
+                           batch_size: int, start_step: int, n_steps: int
+                           ) -> Tuple[TrainState, float, float]:
+        """Run n_steps with device-generated batches in one dispatch."""
+        fn_key = (id(batch_fn), n_steps, batch_size)
+        entry = self._device_fns.get(fn_key)
+        if entry is None:
+            entry = (batch_fn, self._build_train_many_device(
+                batch_fn, batch_size, n_steps))
+            self._device_fns[fn_key] = entry
+        _, fn = entry
+        state, loss, acc = fn(state, self._device_key,
+                              jnp.int32(start_step))
+        return state, float(loss), float(acc)
 
     def train_steps(self, state: TrainState, images: np.ndarray,
                     labels: np.ndarray) -> Tuple[TrainState, float, float]:
