@@ -67,17 +67,23 @@ def main() -> int:
 
     from kubeflow_tpu.controlplane import ControlPlane
 
+    import shutil
+
     home = tempfile.mkdtemp(prefix="kfx-bench-")
     # worker_platform="" -> the worker inherits the machine's default JAX
     # platform (the attached TPU); single worker, whole chip.
     t0 = time.time()
-    with ControlPlane(home=home, worker_platform="") as cp:
-        cp.apply_text(MANIFEST.format(python=sys.executable,
-                                      steps=args.steps,
-                                      batch_size=args.batch_size))
-        job = cp.wait_for_job("JAXJob", "bench-mnist", timeout=args.timeout)
-        wall = time.time() - t0
-        log = cp.job_logs("JAXJob", "bench-mnist")
+    try:
+        with ControlPlane(home=home, worker_platform="") as cp:
+            cp.apply_text(MANIFEST.format(python=sys.executable,
+                                          steps=args.steps,
+                                          batch_size=args.batch_size))
+            job = cp.wait_for_job("JAXJob", "bench-mnist",
+                                  timeout=args.timeout)
+            wall = time.time() - t0
+            log = cp.job_logs("JAXJob", "bench-mnist")
+    finally:
+        shutil.rmtree(home, ignore_errors=True)
     if not job.has_condition("Succeeded"):
         print(json.dumps({"metric": "mnist_jaxjob_wall_clock_s",
                           "value": -1.0, "unit": "s", "vs_baseline": 0.0,
@@ -89,13 +95,32 @@ def main() -> int:
         if line.startswith("accuracy="):
             acc = float(line.split("=", 1)[1])
 
+    # Optional sections run oldest-contract-first under a wall budget so
+    # a driver-side timeout can only cost the newest metrics, never the
+    # whole JSON line (KFX_BENCH_BUDGET_S to tune; sections check before
+    # starting, not mid-flight).
+    budget = float(os.environ.get("KFX_BENCH_BUDGET_S", "1500"))
+    bench_t0 = t0  # whole-run clock: the mnist phase counts too
+
+    def have_time(est_s: float) -> bool:
+        return (time.time() - bench_t0) + est_s < budget
+
     serving = _bench_serving_p50()
-    lm = _bench_lm()
-    # Long-context config: S=2048 rides the pallas flash-attention kernel
-    # (attn_impl="auto" switches at S>=2048; measured 1.24x over the XLA
-    # dense path at this shape on the v5e).
-    lm.update(_bench_lm(batch=8, seq_len=2048, n_steps=6, prefix="lm_long_"))
-    lm.update(_bench_lm_decode())
+    lm: dict = {}
+    if have_time(240):
+        lm.update(_bench_lm())
+    if have_time(300):
+        # Long-context config: S=2048 rides the pallas flash-attention
+        # kernel (attn_impl="auto" switches at S>=2048; measured 1.24x
+        # over the XLA dense path at this shape on the v5e).
+        lm.update(_bench_lm(batch=8, seq_len=2048, n_steps=6,
+                            prefix="lm_long_"))
+    if have_time(420):
+        lm.update(_bench_baseline_configs(
+            deadline=bench_t0 + budget))
+    if have_time(300):
+        lm.update(_bench_lm_decode())
+    lm["bench_wall_s"] = round(time.time() - bench_t0, 1)
     out = {
         "metric": "mnist_jaxjob_wall_clock_s",
         "value": round(wall, 2),
@@ -162,6 +187,64 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
         return {prefix + k: v for k, v in out.items()}
     except Exception as e:  # secondary metric must not sink the bench
         return {prefix + "error": str(e)[:200]}
+
+
+def _bench_baseline_configs(deadline: float) -> dict:
+    """BASELINE.md configs #1-#4: apply -> Succeeded wall-clock for the
+    stock tf-operator/pytorch-operator/mpi-operator examples and the
+    Katib random sweep, through full resource semantics (the same
+    `kfx run` path a user takes). Config #5 (serving p50) and the
+    north-star (#mnist JAXJob) are measured separately. Every wait is
+    bounded by ``deadline`` so one wedged config can never eat the whole
+    bench budget (the JSON line must always print)."""
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.controlplane import ControlPlane
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    configs = {
+        "tfjob_mnist_wall_s": "tfjob-mnist.yaml",
+        "pytorchjob_mnist_wall_s": "pytorchjob-mnist.yaml",
+        "mpijob_resnet_cifar10_wall_s": "mpijob-resnet-cifar10.yaml",
+        "katib_random_sweep_wall_s": "experiment-random-mnist.yaml",
+    }
+    out: dict = {}
+    for key, fname in configs.items():
+        budget_left = deadline - time.time()
+        if budget_left < 30:
+            out[key.replace("_wall_s", "_error")] = "skipped: bench budget"
+            continue
+        path = os.path.join(here, "examples", fname)
+        home = tempfile.mkdtemp(prefix=f"kfx-bench-{key}-")
+        try:
+            t0 = time.time()
+            # worker_platform=None: single-replica workers inherit the
+            # machine default (the TPU); multi-replica gangs go to the
+            # virtual CPU backend (the emulated TPU is single-chip).
+            with ControlPlane(home=home, worker_platform=None) as cp:
+                applied = cp.apply_file(path)
+                for obj, _ in applied:
+                    if obj.KIND == "Experiment":
+                        final = cp.wait_for_condition(
+                            obj.KIND, obj.name, "Succeeded",
+                            namespace=obj.namespace, timeout=budget_left)
+                    else:
+                        final = cp.wait_for_job(obj.KIND, obj.name,
+                                                timeout=budget_left)
+                        if not final.has_condition("Succeeded"):
+                            raise RuntimeError(f"{obj.KIND} failed")
+            out[key] = round(time.time() - t0, 2)
+            if key == "katib_random_sweep_wall_s":
+                best = final.status.get("currentOptimalTrial", {})
+                metrics = best.get("observation", {}).get("metrics", [])
+                if metrics:
+                    out["katib_best_objective"] = metrics[0].get("latest")
+        except Exception as e:
+            out[key.replace("_wall_s", "_error")] = str(e)[:160]
+        finally:
+            shutil.rmtree(home, ignore_errors=True)
+    return out
 
 
 def _bench_lm_decode(preset: str = "small", batch: int = 4,

@@ -18,6 +18,7 @@ splitting default/canary traffic, KPA scaling on concurrency. Here:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import os
@@ -60,6 +61,8 @@ class _Revision:
         self.device = device
         self.replicas: List[_Replica] = []
         self.restarts = 0
+        # (timestamp, desired) samples for the autoscaler's damping window.
+        self.scale_window: "collections.deque" = collections.deque()
 
     def spawn(self) -> None:
         port = free_port()
@@ -264,9 +267,44 @@ class InferenceServiceController(Controller):
                     backend_set.set_endpoints([])
                 else:
                     want = 1
+            # The spec-guaranteed floor (minReplicas, or the activator's 1
+            # for a traffic-woken zero-scale revision): readiness is
+            # judged against this, never against autoscaler targets.
+            base_want = want
+            # Concurrency autoscaler (Knative KPA analogue, SURVEY.md §3
+            # CS3 step 4): with maxReplicas above the floor, desired
+            # replicas = ceil(peak in-flight / targetConcurrency),
+            # clamped to [floor, max]. Scale-down is damped by taking the
+            # max desired over a sliding window so a burst's replicas
+            # aren't torn down between its waves.
+            max_repl = int(spec.get("maxReplicas", max(want, 1)))
+            if max_repl > max(base_want, 1):
+                target = float(spec.get("targetConcurrency", 4.0))
+                window_s = float(spec.get("scaleDownWindowSeconds", 30.0))
+                peak = getattr(rt.router, rev_name).take_peak_concurrency()
+                desired = -(-peak // max(target, 1e-9)) if peak else 0
+                now = time.monotonic()
+                hist = rev.scale_window
+                hist.append((now, int(desired)))
+                while hist and hist[0][0] < now - window_s:
+                    hist.popleft()
+                damped = max((d for _, d in hist), default=0)
+                if damped > want:
+                    want = min(damped, max_repl)
+            if want < len(rev.replicas):
+                # Scale-down ordering (same rule as scale-to-zero below):
+                # drop the doomed replicas from the router BEFORE killing
+                # them, or a racing request 502s against a dead port.
+                keep = [f"127.0.0.1:{r.port}"
+                        for r in rev.replicas[:want] if r.ready]
+                getattr(rt.router, rev_name).set_endpoints(keep)
             rev.reap_and_respawn(want)
             ready = rev.probe()
-            if ready < max(want, 1) and want > 0:
+            # Readiness is judged against the spec's guarantee (base
+            # replicas), not the autoscaler's transient target — a burst
+            # must not flip a healthy, serving ISVC to NotReady while
+            # extra replicas warm up.
+            if ready < max(base_want, 1) and base_want > 0:
                 all_ready = False
 
         # Router wiring + traffic split.

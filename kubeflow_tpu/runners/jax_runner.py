@@ -136,9 +136,17 @@ def main(argv=None) -> int:
 
     t_start = time.time()
     t_last = t_start
-    device_capable = (args.data_pipeline != "host"
-                      and hasattr(dataset, "device_batch_fn"))
-    if args.data_pipeline == "device" and not device_capable:
+    # auto: on-device generation only where there is a transfer to save
+    # (an accelerator backend). On the CPU backend host feeding is free
+    # of transfer AND avoids XLA:CPU's very slow compiles of conv models
+    # inside the generation scan (resnet18: minutes). --data-pipeline=
+    # device forces it anywhere.
+    device_capable = (hasattr(dataset, "device_batch_fn")
+                      and (args.data_pipeline == "device"
+                           or (args.data_pipeline == "auto"
+                               and jax.default_backend() != "cpu")))
+    if args.data_pipeline == "device" and \
+            not hasattr(dataset, "device_batch_fn"):
         print(f"error: --data-pipeline=device but dataset "
               f"{args.dataset!r} has no device batch generator",
               file=sys.stderr)

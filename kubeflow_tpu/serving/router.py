@@ -36,6 +36,28 @@ class BackendSet:
         # Stamped by the Router when this set serves a request; drives
         # per-revision scale-to-zero idle accounting.
         self.last_request_time: float = time.monotonic()
+        # Concurrency accounting (the KPA signal): current in-flight
+        # requests and the peak since the operator last sampled.
+        self._in_flight = 0
+        self._peak_in_flight = 0
+
+    def enter(self) -> None:
+        with self._lock:
+            self._in_flight += 1
+            self._peak_in_flight = max(self._peak_in_flight,
+                                       self._in_flight)
+
+    def exit(self) -> None:
+        with self._lock:
+            self._in_flight = max(self._in_flight - 1, 0)
+
+    def take_peak_concurrency(self) -> int:
+        """Peak in-flight since the last call (resets to the current
+        level — a long-running request keeps counting)."""
+        with self._lock:
+            peak = self._peak_in_flight
+            self._peak_in_flight = self._in_flight
+            return peak
 
     def set_endpoints(self, endpoints: List[str]) -> None:
         with self._lock:
@@ -116,6 +138,13 @@ class Router:
             h.end_headers()
             h.wfile.write(body)
             return
+        chosen.enter()
+        try:
+            self._forward(h, backend, has_body)
+        finally:
+            chosen.exit()
+
+    def _forward(self, h, backend: str, has_body: bool) -> None:
         data = b""
         if has_body:
             length = int(h.headers.get("Content-Length", 0))

@@ -366,6 +366,72 @@ spec:
                               {"instances": x.tolist()}, timeout=60)
             assert status == 200
 
+    def test_concurrency_autoscale_up_and_down(self, export_dir, tmp_path):
+        """KPA analogue: concurrent traffic grows replicas toward
+        maxReplicas; after the damping window they fall back to min."""
+        import threading
+        import time
+
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: kpa
+spec:
+  predictor:
+    minReplicas: 1
+    maxReplicas: 3
+    targetConcurrency: 1
+    scaleDownWindowSeconds: 4
+    jax:
+      storageUri: file://{export_dir}
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "kpa", "Ready",
+                                         timeout=120)
+            url = isvc.status["url"]
+            x = np.zeros((4, 28, 28, 1), np.float32).tolist()
+
+            stop = threading.Event()
+            deadline = time.monotonic() + 45
+
+            def hammer():
+                while not stop.is_set() and time.monotonic() < deadline:
+                    try:
+                        _post(f"{url}/v1/models/kpa:predict",
+                              {"instances": x}, timeout=30)
+                    except Exception:
+                        time.sleep(0.1)
+
+            threads = [threading.Thread(target=hammer) for _ in range(6)]
+            for t in threads:
+                t.start()
+            grown = 0
+            while time.monotonic() < deadline:
+                cur = cp.store.get("InferenceService", "kpa")
+                grown = max(grown, cur.status.get(
+                    "readyReplicas", {}).get("default", 0))
+                if grown >= 2:
+                    break
+                time.sleep(0.3)
+            stop.set()  # end the load phase as soon as scale-up is seen
+            for t in threads:
+                t.join()
+            assert grown >= 2, f"never scaled past 1 (saw {grown})"
+
+            deadline = time.monotonic() + 40
+            while time.monotonic() < deadline:
+                cur = cp.store.get("InferenceService", "kpa")
+                if cur.status.get("readyReplicas", {}).get("default") == 1:
+                    break
+                time.sleep(0.5)
+            assert cp.store.get("InferenceService", "kpa").status[
+                "readyReplicas"]["default"] == 1, "never scaled back down"
+
     def test_scale_to_zero_round_trip(self, export_dir, tmp_path):
         """minReplicas=0: cold request scales 0->1, idle scales 1->0."""
         import time
