@@ -392,6 +392,32 @@ class _Handler(BaseHTTPRequestHandler):
         return self._html(200, self._notebooks_page(
             f"created {ns}/{name}"))
 
+    def _experiment_trials_section(self, ns: str, name: str) -> str:
+        """Katib-UI analogue: the experiment's trials with assignments
+        and objective values, on the experiment's dashboard page."""
+        rows = []
+        for t in self.cp.store.list("Trial", ns):
+            if t.metadata.labels.get(
+                    "katib.kubeflow.org/experiment") != name:
+                continue
+            st = display_state(t.conditions)
+            assigns = ", ".join(
+                f"{a.get('name')}={a.get('value')}"
+                for a in (t.spec.get("parameterAssignments") or []))
+            val = ""
+            for m in (t.status.get("observation") or {}).get("metrics", []):
+                val = str(m.get("latest", ""))
+                break
+            rows.append(f"<tr><td>{html.escape(t.name)}</td>"
+                        f"<td>{html.escape(assigns)}</td>"
+                        f"<td>{html.escape(val)}</td>"
+                        f"<td class='{st}'>{st}</td></tr>")
+        if not rows:
+            return "<h2>trials</h2><p>none yet.</p>"
+        return ("<h2>trials</h2><table><tr><th>trial</th><th>assignments"
+                "</th><th>objective</th><th>state</th></tr>"
+                + "".join(rows) + "</table>")
+
     def _resource_page(self, kind: str, ns: str, name: str) -> str:
         cls = resource_class(kind)
         obj = self.cp.store.get(cls.KIND, name, ns)
@@ -410,6 +436,19 @@ class _Handler(BaseHTTPRequestHandler):
                         f"<td>{html.escape(e.reason)}</td>"
                         f"<td>{html.escape(e.message)}</td></tr>")
         body.append("</table>")
+        if cls.KIND == "Experiment":
+            body.append(self._experiment_trials_section(ns, name))
+        if cls.KIND == "Pipeline":
+            steps = obj.status.get("steps") or {}
+            if steps:
+                body.append("<h2>steps</h2><table><tr><th>step</th>"
+                            "<th>phase</th></tr>")
+                for sname, phase in steps.items():
+                    body.append(
+                        f"<tr><td>{html.escape(str(sname))}</td>"
+                        f"<td class='{html.escape(str(phase))}'>"
+                        f"{html.escape(str(phase))}</td></tr>")
+                body.append("</table>")
         try:
             log = self.cp.job_logs(cls.KIND, name, ns, "")
             if log:

@@ -267,6 +267,59 @@ class TestDashboard:
         assert "conditions" in page and "events" in page
         assert "served hello" in page  # chief log tail embedded
 
+    def test_experiment_page_lists_trials(self, server):
+        """Katib-UI analogue: the experiment's dashboard page shows its
+        trials with assignments and objective values."""
+        import time
+
+        exp = f"""
+apiVersion: kubeflow.org/v1
+kind: Experiment
+metadata:
+  name: ui-exp
+spec:
+  objective:
+    type: maximize
+    objectiveMetricName: score
+  algorithm:
+    algorithmName: random
+  maxTrialCount: 2
+  parallelTrialCount: 2
+  maxFailedTrialCount: 1
+  parameters:
+  - name: x
+    parameterType: double
+    feasibleSpace: {{min: "0.0", max: "1.0"}}
+  trialTemplate:
+    trialParameters:
+    - name: x
+      reference: x
+    trialSpec:
+      apiVersion: kubeflow.org/v1
+      kind: JAXJob
+      spec:
+        jaxReplicaSpecs:
+          Worker:
+            replicas: 1
+            restartPolicy: Never
+            template:
+              spec:
+                containers:
+                - name: t
+                  command: ["{PY}", "-c",
+                            "print('score=${{trialParameters.x}}')"]
+"""
+        _req(f"{server.url}/apis", exp.encode())
+        deadline = time.monotonic() + 90
+        page = ""
+        while time.monotonic() < deadline:
+            _, page = _get(f"{server.url}/ui/experiment/default/ui-exp")
+            if "Succeeded" in page and "x=" in page:
+                break
+            time.sleep(0.3)
+        assert "trials" in page and "x=" in page  # assignments rendered
+        assert "ui-exp-" in page  # trial names linkable content
+
     def test_html_escapes_content(self, server, tmp_path):
         evil = JOB.format(py=PY).replace(
             "api-job", "xss").replace(
