@@ -5,6 +5,7 @@ channel dims padded to MXU-friendly multiples where it matters, no
 data-dependent python control flow (everything jit-traceable).
 """
 
+from .cnn import CNN  # noqa: F401
 from .mlp import MLP  # noqa: F401
 from .registry import get_model, model_names, register_model  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet50  # noqa: F401

@@ -1,0 +1,35 @@
+"""Small convnet classifier — the reference tf-operator mnist example is
+a conv net (conv/pool x2 + dense head); this is the flax/bfloat16
+equivalent. Channel counts sit on MXU-friendly multiples (64/128) so the
+convs tile cleanly onto the systolic array."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import register_model
+
+
+class CNN(nn.Module):
+    num_classes: int = 10
+    features: tuple = (64, 128)
+    dense: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for f in self.features:
+            x = nn.Conv(f, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense, dtype=self.dtype)(x))
+        # Logits in float32 for a numerically stable softmax/CE.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register_model("cnn")
+def _cnn(num_classes: int = 10, **_):
+    return CNN(num_classes=num_classes)

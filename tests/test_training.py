@@ -76,6 +76,28 @@ class TestModels:
         out, new_vars = m.apply(v, x, train=True, mutable=["batch_stats"])
         assert out.shape == (2, 10)
 
+    def test_cnn_forward_and_trains(self):
+        """The conv mnist model (tf-operator example parity): forward
+        shape + a few sharded train steps reduce the loss."""
+        import jax
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import TrainLoop
+
+        m = get_model("cnn", num_classes=10)
+        x = np.zeros((2, 28, 28, 1), np.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(v, x)
+        assert out.shape == (2, 10) and out.dtype == np.float32
+
+        ds = get_dataset("mnist")
+        loop = TrainLoop(get_model("cnn"), learning_rate=1e-3)
+        state = loop.init_state(ds.shape)
+        losses = []
+        for images, labels in ds.batches(64, steps=8):
+            state, loss, _ = loop.train_step(state, images, labels)
+            losses.append(loss)
+        assert losses[-1] < losses[0]
+
     def test_registry_unknown(self):
         from kubeflow_tpu.models import get_model
 
