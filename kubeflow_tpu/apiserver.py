@@ -395,10 +395,11 @@ class _Handler(BaseHTTPRequestHandler):
     def _experiment_trials_section(self, ns: str, name: str) -> str:
         """Katib-UI analogue: the experiment's trials with assignments
         and objective values, on the experiment's dashboard page."""
+        from .operators.hpo import EXPERIMENT_LABEL
+
         rows = []
         for t in self.cp.store.list("Trial", ns):
-            if t.metadata.labels.get(
-                    "katib.kubeflow.org/experiment") != name:
+            if t.metadata.labels.get(EXPERIMENT_LABEL) != name:
                 continue
             st = display_state(t.conditions)
             assigns = ", ".join(

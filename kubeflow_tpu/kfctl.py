@@ -60,21 +60,15 @@ _PARAM_RE = re.compile(r"\$\{param\.([A-Za-z0-9_-]+)\}")
 
 
 def _substitute(node: Any, params: Dict[str, str], app: str) -> Any:
-    if isinstance(node, str):
-        def repl(m):
-            key = m.group(1)
-            if key not in params:
-                raise ValidationError(
-                    f"applications[{app}]",
-                    f"undefined parameter ${{param.{key}}}")
-            return str(params[key])
+    from .utils.template import substitute_refs
 
-        return _PARAM_RE.sub(repl, node)
-    if isinstance(node, dict):
-        return {k: _substitute(v, params, app) for k, v in node.items()}
-    if isinstance(node, list):
-        return [_substitute(v, params, app) for v in node]
-    return node
+    def resolve(key: str) -> str:
+        if key not in params:
+            raise ValidationError(f"applications[{app}]",
+                                  f"undefined parameter ${{param.{key}}}")
+        return str(params[key])
+
+    return substitute_refs(node, _PARAM_RE, resolve)
 
 
 def render_kfdef(doc: Dict[str, Any], base_dir: str

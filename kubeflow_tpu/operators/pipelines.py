@@ -30,20 +30,15 @@ _PARAM_RE = re.compile(r"\$\{params\.([A-Za-z0-9_-]+)\}")
 
 
 def _substitute(node: Any, params: Dict[str, str]) -> Any:
-    if isinstance(node, str):
-        def repl(m):
-            key = m.group(1)
-            if key not in params:
-                raise ValidationError("spec.params",
-                                      f"undefined ${{params.{key}}}")
-            return params[key]
+    from ..utils.template import substitute_refs
 
-        return _PARAM_RE.sub(repl, node)
-    if isinstance(node, dict):
-        return {k: _substitute(v, params) for k, v in node.items()}
-    if isinstance(node, list):
-        return [_substitute(v, params) for v in node]
-    return node
+    def resolve(key: str) -> str:
+        if key not in params:
+            raise ValidationError("spec.params",
+                                  f"undefined ${{params.{key}}}")
+        return params[key]
+
+    return substitute_refs(node, _PARAM_RE, resolve)
 
 
 def _inject_workspace(spec: Dict[str, Any], workspace: str) -> None:

@@ -277,15 +277,19 @@ class InferenceServiceController(Controller):
             # clamped to [floor, max]. Scale-down is damped by taking the
             # max desired over a sliding window so a burst's replicas
             # aren't torn down between its waves.
+            backend_set = getattr(rt.router, rev_name)
             max_repl = int(spec.get("maxReplicas", max(want, 1)))
             if max_repl > max(base_want, 1):
-                target = float(spec.get("targetConcurrency", 4.0))
+                import math
+
+                target = max(float(spec.get("targetConcurrency", 4.0)),
+                             1e-9)
                 window_s = float(spec.get("scaleDownWindowSeconds", 30.0))
-                peak = getattr(rt.router, rev_name).take_peak_concurrency()
-                desired = -(-peak // max(target, 1e-9)) if peak else 0
+                peak = backend_set.take_peak_concurrency()
+                desired = math.ceil(peak / target)
                 now = time.monotonic()
                 hist = rev.scale_window
-                hist.append((now, int(desired)))
+                hist.append((now, desired))
                 while hist and hist[0][0] < now - window_s:
                     hist.popleft()
                 damped = max((d for _, d in hist), default=0)
@@ -295,9 +299,9 @@ class InferenceServiceController(Controller):
                 # Scale-down ordering (same rule as scale-to-zero below):
                 # drop the doomed replicas from the router BEFORE killing
                 # them, or a racing request 502s against a dead port.
-                keep = [f"127.0.0.1:{r.port}"
-                        for r in rev.replicas[:want] if r.ready]
-                getattr(rt.router, rev_name).set_endpoints(keep)
+                backend_set.set_endpoints(
+                    [f"127.0.0.1:{r.port}"
+                     for r in rev.replicas[:want] if r.ready])
             rev.reap_and_respawn(want)
             ready = rev.probe()
             # Readiness is judged against the spec's guarantee (base

@@ -174,8 +174,7 @@ def main(argv=None) -> int:
     step = start_step
     import numpy as np
 
-    device_data = device_capable
-    if device_data:
+    if device_capable:
         log("data_pipeline=device (batches generated on device; zero "
             "input transfer per step)")
         batch_fn = dataset.device_batch_fn()
@@ -214,9 +213,9 @@ def main(argv=None) -> int:
         except BaseException as e:
             prefetch_q.put(e)
 
-    if not device_data:
+    if not device_capable:
         _threading.Thread(target=_prefetch, daemon=True).start()
-    chunks = _plan_chunks() if device_data else None
+    chunks = _plan_chunks() if device_capable else None
     while step < args.steps:
         if step == args.fail_at_step:
             if ckpt is not None:
@@ -227,7 +226,7 @@ def main(argv=None) -> int:
             log(f"fault_injection_crash step={step}")
             sys.stdout.flush()
             os._exit(17)
-        if device_data:
+        if device_capable:
             s, k = next(chunks)
             assert s == step, f"chunk desync: {s} != {step}"
             state, loss, acc = loop.train_steps_device(

@@ -30,10 +30,6 @@ from .server import Predictor
 CONFIG_FILE = "lm_config.json"
 PARAMS_FILE = "params.msgpack"
 
-_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
-           "float16": jnp.float16}
-
-
 def export_lm(directory: str, cfg, params) -> str:
     """Write a servable LM export from train-time config + params."""
     import jax
@@ -55,8 +51,8 @@ def load_lm(directory: str):
     with open(os.path.join(directory, CONFIG_FILE)) as f:
         meta = json.load(f)
     d = dict(meta["config"])
-    d["dtype"] = _DTYPES[d.get("dtype", "bfloat16")]
-    d["param_dtype"] = _DTYPES[d.get("param_dtype", "float32")]
+    d["dtype"] = jnp.dtype(d.get("dtype", "bfloat16"))
+    d["param_dtype"] = jnp.dtype(d.get("param_dtype", "float32"))
     cfg = TransformerConfig(**d)
     with open(os.path.join(directory, PARAMS_FILE), "rb") as f:
         params = serialization.msgpack_restore(f.read())

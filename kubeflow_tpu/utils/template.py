@@ -1,0 +1,23 @@
+"""Recursive ``${...}`` substitution over manifest trees — the shared
+mechanism behind KfDef ``${param.x}``, Pipeline ``${params.x}``, and
+(Katib-style) trial-parameter rendering."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+
+def substitute_refs(node: Any, pattern: "re.Pattern[str]",
+                    resolve: Callable[[str], str]) -> Any:
+    """Deep-copying substitution: every string in ``node`` has matches of
+    ``pattern`` replaced by ``resolve(group1)``; dicts/lists recurse,
+    other leaves pass through. ``resolve`` raises for unknown keys."""
+    if isinstance(node, str):
+        return pattern.sub(lambda m: resolve(m.group(1)), node)
+    if isinstance(node, dict):
+        return {k: substitute_refs(v, pattern, resolve)
+                for k, v in node.items()}
+    if isinstance(node, list):
+        return [substitute_refs(v, pattern, resolve) for v in node]
+    return node
