@@ -116,6 +116,8 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import __version__
 
                 return self._json(200, {"version": __version__})
+            if url.path == "/metrics":
+                return self._json(200, self._metrics())
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
             if parts == ["ui", "notebooks"]:
@@ -216,6 +218,23 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             return self._error(500, f"{type(e).__name__}: {e}")
         return self._json(200, {"deleted": f"{parts[1]}/{parts[3]}"})
+
+    def _metrics(self) -> dict:
+        """Operator observability (SURVEY.md §5.5 Prometheus-metrics
+        role): per-kind resource counts, per-controller workqueue stats,
+        live gang count, event-log size."""
+        resources = {}
+        for kind in registered_kinds():
+            n = len(self.cp.store.list(kind))
+            if n:
+                resources[kind] = n
+        controllers = {
+            kind: ctrl.queue.stats()
+            for kind, ctrl in self.cp.manager.controllers.items()}
+        return {"resources": resources,
+                "controllers": controllers,
+                "gangs": self.cp.gangs.count(),
+                "events": self.cp.store.event_count()}
 
     # -- kfam (access management, SURVEY.md §2.1) ---------------------------
     def _kfam_list(self, namespace: Optional[str]) -> List[dict]:

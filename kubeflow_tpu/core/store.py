@@ -260,6 +260,10 @@ class ResourceStore:
                 self._watchers.remove(q)
 
     # -- events ------------------------------------------------------------
+    def event_count(self) -> int:
+        with self._lock:
+            return len(self._events)
+
     def record_event(self, obj: Resource, etype: str, reason: str,
                      message: str) -> None:
         ev = Event(obj.KIND, obj.key, etype, reason, message)

@@ -27,6 +27,15 @@ class RateLimitingQueue:
         self._max_delay = max_delay
         self._shutdown = False
 
+    def stats(self) -> Dict[str, int]:
+        """Observability snapshot (the Prometheus-workqueue-metrics role):
+        ready depth, delayed backlog, in-flight keys, keys in backoff."""
+        with self._cond:
+            return {"depth": len(self._queue),
+                    "delayed": len(self._delayed),
+                    "processing": len(self._processing),
+                    "retrying": len(self._failures)}
+
     # -- adding ------------------------------------------------------------
     def add(self, key: str) -> None:
         with self._cond:

@@ -376,6 +376,10 @@ class GangManager:
         with self._lock:
             return self._gangs.get(key)
 
+    def count(self) -> int:
+        with self._lock:
+            return len(self._gangs)
+
     def workdir_for(self, key: str) -> str:
         """The (stable) workdir a gang for `key` uses — also valid for
         finished gangs that were forgotten (log retrieval)."""

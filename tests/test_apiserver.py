@@ -62,6 +62,18 @@ class TestRestApi:
         kinds = json.loads(body)["kinds"]
         assert "JAXJob" in kinds and "Experiment" in kinds
 
+    def test_metrics(self, server):
+        _req(f"{server.url}/apis", JOB.format(py=PY).encode())
+        st, body = _get(f"{server.url}/metrics")
+        assert st == 200
+        m = json.loads(body)
+        assert m["resources"].get("JAXJob") == 1
+        assert "JAXJob" in m["controllers"]
+        assert set(m["controllers"]["JAXJob"]) == {
+            "depth", "delayed", "processing", "retrying"}
+        assert "gangs" in m and "events" in m
+        _req(f"{server.url}/apis/jaxjob/default/api-job", method="DELETE")
+
     def test_apply_get_logs_events_delete(self, server):
         st, body = _req(f"{server.url}/apis",
                         JOB.format(py=PY).encode())
