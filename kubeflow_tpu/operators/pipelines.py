@@ -94,7 +94,12 @@ class PipelineController(Controller):
 
     def _render_child(self, pipe: P.Pipeline, step: Dict[str, Any]
                       ) -> Resource:
-        params = pipe.params()
+        workspace = os.path.join(self.workspace_root,
+                                 f"{pipe.namespace}_{pipe.name}")
+        # ${params.workspace} is implicit: the shared artifact directory,
+        # usable in resource specs (e.g. a serving step's storageUri
+        # pointing at a training step's --export-dir).
+        params = {**pipe.params(), "workspace": workspace}
         if step.get("resource"):
             manifest = _substitute(copy.deepcopy(step["resource"]), params)
         else:
@@ -114,8 +119,6 @@ class PipelineController(Controller):
         meta["ownerReferences"] = [{"kind": "Pipeline", "name": pipe.name}]
         meta.setdefault("labels", {})["pipelines.kubeflow.org/pipeline"] = \
             pipe.name
-        workspace = os.path.join(self.workspace_root,
-                                 f"{pipe.namespace}_{pipe.name}")
         os.makedirs(workspace, exist_ok=True)
         _inject_workspace(manifest.get("spec") or {}, workspace)
         child = from_manifest(manifest)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import signal
 import subprocess
 import threading
@@ -34,6 +35,10 @@ SUCCEEDED = "Succeeded"
 FAILED = "Failed"
 RESTARTING = "Restarting"
 KILLED = "Killed"
+
+# k8s $(VAR) references in container command/args (expanded from env).
+_ENV_VAR_RE = re.compile(r"\$\(([A-Za-z_][A-Za-z0-9_]*)\)")
+
 
 # Exit codes considered retryable under restartPolicy=ExitCode (reference
 # semantics: >128 = killed by signal = retryable infrastructure failure).
@@ -187,13 +192,18 @@ class Gang:
                 env.update(spec.env)
                 env.update(overrides.get("*", {}))
                 env.update(overrides.get(spec.id, {}))
+                # k8s container semantics: $(VAR) in command/args expands
+                # from the container env; unresolved refs stay verbatim.
+                argv = [_ENV_VAR_RE.sub(
+                    lambda m: env.get(m.group(1), m.group(0)), a)
+                    for a in spec.argv]
                 logf = open(self.log_path(spec.id), "ab")
                 logf.write(
                     f"==== attempt {attempt} {time.strftime('%Y-%m-%dT%H:%M:%S')}"
                     f" ====\n".encode())
                 logf.flush()
                 p = subprocess.Popen(
-                    spec.argv, env=env, cwd=spec.cwd or self.workdir,
+                    argv, env=env, cwd=spec.cwd or self.workdir,
                     stdout=logf, stderr=subprocess.STDOUT,
                     start_new_session=True)
                 logf.close()  # child holds the fd

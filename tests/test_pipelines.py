@@ -137,6 +137,38 @@ class TestExecution:
             time.sleep(0.2)
         assert cp.store.try_get("JAXJob", "del-long") is None
 
+    @pytest.mark.slow
+    def test_train_serve_pipeline_generates(self, cp):
+        """The shipped train-then-serve example: the LM trains and
+        exports into ${params.workspace}, the serving step goes Ready on
+        that export, and :generate works against the served model."""
+        import json as _json
+        import urllib.request
+
+        from kubeflow_tpu.api.manifest import load_manifest_file
+
+        objs = load_manifest_file(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "examples",
+                "lm-train-serve-pipeline.yaml"))
+        # shrink for CI
+        objs[0].spec["params"]["steps"] = "6"
+        cp.apply(objs)
+        final = cp.wait_for_condition("Pipeline", "lm-train-serve",
+                                      "Succeeded", timeout=300)
+        assert final.status["steps"] == {"train": "Succeeded",
+                                         "serve": "Succeeded"}
+        isvc = cp.store.get("InferenceService", "lm-train-serve-serve")
+        url = isvc.status["url"]
+        req = urllib.request.Request(
+            f"{url}/v1/models/lm-train-serve-serve:generate",
+            data=_json.dumps({"prompt_tokens": [[1, 2, 3]],
+                              "max_new_tokens": 6}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = _json.load(r)
+        assert len(body["generated_tokens"][0]) == 6
+
     def test_resource_step_runs_experiment(self, cp):
         """A resource step embeds an Experiment: the pipeline waits for
         the sweep's terminal condition (DAG-over-HPO composition)."""
