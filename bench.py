@@ -27,6 +27,36 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 PARITY_BUDGET_S = 60.0
 
+
+def _box_check() -> dict:
+    """Idle-box guard: every number below is wall-clock on a shared
+    machine, so record (a) stray framework worker processes — a leaked
+    100k-step test worker contended the entire round-2 measurement
+    window — and (b) the 1-minute load average at start. Strays are
+    reported, not killed: they are evidence, and killing them here would
+    hide the contention that tainted the numbers."""
+    me = os.getpid()
+    strays = []
+    try:
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit() or int(pid_s) == me:
+                continue
+            try:
+                with open(f"/proc/{pid_s}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        "utf-8", "replace").strip()
+            except OSError:
+                continue
+            if "kubeflow_tpu.runners" in cmd or "kfx-bench" in cmd:
+                strays.append({"pid": int(pid_s), "cmd": cmd[:120]})
+    except OSError:
+        pass
+    out = {"stray_workers_at_start": len(strays),
+           "load_avg_at_start": round(os.getloadavg()[0], 2)}
+    if strays:
+        out["stray_workers"] = strays[:5]
+    return out
+
 MANIFEST = """
 apiVersion: kubeflow.org/v1
 kind: JAXJob
@@ -69,6 +99,7 @@ def main() -> int:
 
     import shutil
 
+    box = _box_check()
     home = tempfile.mkdtemp(prefix="kfx-bench-")
     # worker_platform="" -> the worker inherits the machine's default JAX
     # platform (the attached TPU); single worker, whole chip.
@@ -130,6 +161,7 @@ def main() -> int:
         "batch_size": args.batch_size,
         "final_accuracy": acc,
     }
+    out.update(box)
     out.update(serving)
     out.update(lm)
     print(json.dumps(out))

@@ -89,6 +89,9 @@ def enable_compile_cache() -> None:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    from kubeflow_tpu.runtime.lifetime import install_parent_watch
+
+    install_parent_watch()
     initialize_distributed()
 
     import jax  # after distributed init

@@ -51,6 +51,9 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    from ..runtime.lifetime import install_parent_watch
+
+    install_parent_watch()
     from .jax_runner import enable_compile_cache, initialize_distributed
 
     initialize_distributed()

@@ -93,6 +93,9 @@ def _hostfile_slots() -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from kubeflow_tpu.runtime import lifetime
+
+    lifetime.install_parent_watch()  # die with the gang supervisor
     argv = sys.argv[1:] if argv is None else argv
     np, extra_env, cmd = parse_argv(argv)
     if not cmd:
@@ -127,6 +130,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGTERM, forward)
     signal.signal(signal.SIGINT, forward)
 
+    # Ranks must die with the launcher the same way the launcher dies with
+    # its gang supervisor: fresh keepalive pipe + PDEATHSIG (the inherited
+    # KFX_PARENT_FD names a fd that does not exist here, so re-point it).
+    ka_r, ka_w = os.pipe()
+    preexec = lifetime.make_child_preexec(os.getpid())
     for rank in range(np):
         env = dict(os.environ)
         env.update(extra_env)
@@ -137,10 +145,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             "OMPI_COMM_WORLD_LOCAL_SIZE": str(np),
             "PMI_RANK": str(rank),
             "PMI_SIZE": str(np),
+            lifetime.PARENT_FD_ENV: str(ka_r),
         })
         if coordinator:
             env["KFX_COORDINATOR_ADDRESS"] = coordinator
-        procs.append(subprocess.Popen(cmd, env=env))
+        # Own session per rank: its EOF handler killpg(0)s only its own
+        # subtree, and signal forwarding below is already explicit.
+        procs.append(subprocess.Popen(cmd, env=env, pass_fds=(ka_r,),
+                                      preexec_fn=preexec,
+                                      start_new_session=True))
 
     # Poll ALL ranks so a crash in any rank aborts the job even while
     # earlier ranks are blocked in collectives (mpirun fail-fast semantics).

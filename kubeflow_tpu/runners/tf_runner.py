@@ -32,6 +32,9 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    from kubeflow_tpu.runtime.lifetime import install_parent_watch
+
+    install_parent_watch()
     # Keep TF off any accelerator plugin; this compat path is CPU-only
     # (reference config #1 is explicitly CPU).
     os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")

@@ -28,6 +28,9 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    from kubeflow_tpu.runtime.lifetime import install_parent_watch
+
+    install_parent_watch()
     import numpy as np
     import torch
     import torch.distributed as dist

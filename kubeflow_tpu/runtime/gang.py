@@ -28,6 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import training as T
+from . import lifetime
 
 PENDING = "Pending"
 RUNNING = "Running"
@@ -140,6 +141,13 @@ class Gang:
         self._monitor: Optional[threading.Thread] = None
         self._started_at: Optional[float] = None
         self.log_dir = os.path.join(workdir, "logs")
+        # Keepalive pipe (created when supervision starts, so a Gang that
+        # loses GangManager.ensure's create race and is never started
+        # leaks no fds): members inherit the read end; the write end lives
+        # only in this process. Supervisor death closes it -> EOF ->
+        # runners' parent-watch kills their own process group
+        # (runtime/lifetime.py).
+        self._keepalive_r = self._keepalive_w = -1
 
     # -- observability -----------------------------------------------------
     def status(self) -> GangStatus:
@@ -186,12 +194,14 @@ class Gang:
         if self.restart_env_hook is not None:
             overrides = self.restart_env_hook(attempt) or {}
         launched: Dict[str, subprocess.Popen] = {}
+        preexec = lifetime.make_child_preexec(os.getpid())
         try:
             for spec in self.specs:
                 env = dict(os.environ)
                 env.update(spec.env)
                 env.update(overrides.get("*", {}))
                 env.update(overrides.get(spec.id, {}))
+                env[lifetime.PARENT_FD_ENV] = str(self._keepalive_r)
                 # k8s container semantics: $(VAR) in command/args expands
                 # from the container env; unresolved refs stay verbatim.
                 argv = [_ENV_VAR_RE.sub(
@@ -205,7 +215,8 @@ class Gang:
                 p = subprocess.Popen(
                     argv, env=env, cwd=spec.cwd or self.workdir,
                     stdout=logf, stderr=subprocess.STDOUT,
-                    start_new_session=True)
+                    start_new_session=True, preexec_fn=preexec,
+                    pass_fds=(self._keepalive_r,))
                 logf.close()  # child holds the fd
                 launched[spec.id] = p
         except Exception as e:  # spawn failure -> tear down the partial gang
@@ -226,26 +237,51 @@ class Gang:
         return True
 
     def _supervise(self) -> None:
-        attempt = 0
+        try:
+            self._keepalive_r, self._keepalive_w = os.pipe()
+            os.set_inheritable(self._keepalive_r, True)
+            attempt = 0
+            while not self._stop.is_set():
+                if not self._launch_all(attempt):
+                    self._set_phase(FAILED, "SpawnFailed",
+                                    self._status.message)
+                    return
+                self._set_phase(RUNNING, "GangRunning",
+                                f"{len(self.specs)} processes running"
+                                + (f" (restart {attempt})" if attempt else ""))
+                outcome = self._watch_attempt()
+                if outcome in (SUCCEEDED, FAILED, KILLED):
+                    return
+                # outcome == RESTARTING
+                attempt += 1
+                with self._lock:
+                    self._status.restart_count = attempt
+                delay = min(self.RESTART_BASE_DELAY * (2 ** (attempt - 1)),
+                            self.RESTART_MAX_DELAY)
+                self._set_phase(RESTARTING, "GangRestarting",
+                                f"restart {attempt} after {delay:.1f}s backoff")
+                if self._stop.wait(delay):
+                    return
+        finally:
+            # PR_SET_PDEATHSIG fires when the forking THREAD dies, so this
+            # thread must outlive every member it forked — otherwise
+            # cleanPodPolicy=None survivors (chief succeeded, workers
+            # intentionally left running) would be killed the moment we
+            # return. Linger until they exit or the gang is deleted.
+            self._linger()
+            for fd in (self._keepalive_w, self._keepalive_r):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+    def _linger(self) -> None:
         while not self._stop.is_set():
-            if not self._launch_all(attempt):
-                self._set_phase(FAILED, "SpawnFailed", self._status.message)
-                return
-            self._set_phase(RUNNING, "GangRunning",
-                            f"{len(self.specs)} processes running"
-                            + (f" (restart {attempt})" if attempt else ""))
-            outcome = self._watch_attempt()
-            if outcome in (SUCCEEDED, FAILED, KILLED):
-                return
-            # outcome == RESTARTING
-            attempt += 1
             with self._lock:
-                self._status.restart_count = attempt
-            delay = min(self.RESTART_BASE_DELAY * (2 ** (attempt - 1)),
-                        self.RESTART_MAX_DELAY)
-            self._set_phase(RESTARTING, "GangRestarting",
-                            f"restart {attempt} after {delay:.1f}s backoff")
-            if self._stop.wait(delay):
+                alive = any(p.poll() is None for p in self._procs.values())
+            if not alive:
+                return
+            if self._stop.wait(0.2):
                 return
 
     def _watch_attempt(self) -> str:

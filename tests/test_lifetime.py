@@ -1,0 +1,147 @@
+"""Child-lifetime hardening tests (SURVEY.md §5.3): gang members must not
+outlive a SIGKILLed supervisor — the reference gets this from kubelet
+killing the pod cgroup; we get it from PR_SET_PDEATHSIG plus the keepalive
+pipe (runtime/lifetime.py). Round-2 evidence this matters: a leaked
+100k-step test worker ran as a PPID-1 orphan through the whole bench
+window."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+PY = sys.executable
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _wait_dead(pid: int, timeout: float) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not _pid_alive(pid):
+            return True
+        time.sleep(0.05)
+    return not _pid_alive(pid)
+
+
+# A supervisor process that starts a one-member gang (plain sleep — an
+# arbitrary container command with NO cooperative watchdog), prints the
+# member pid, then idles. The test SIGKILLs it and asserts the kernel
+# (PDEATHSIG) reaps the member.
+HOST_SCRIPT = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, {root!r})
+    from kubeflow_tpu.runtime.gang import Gang, ProcessSpec
+    g = Gang("lifetime", [ProcessSpec("worker", 0,
+        [{py!r}, "-c", "import time; time.sleep(120)"])], {workdir!r})
+    g.start()
+    while True:
+        st = g.status()
+        pid = st.replicas["worker-0"].pid
+        if pid:
+            print(pid, flush=True)
+            break
+        time.sleep(0.02)
+    time.sleep(120)
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="PDEATHSIG is Linux")
+def test_sigkilled_supervisor_takes_gang_down(tmp_path):
+    host = subprocess.Popen(
+        [PY, "-c", HOST_SCRIPT.format(root=REPO_ROOT, py=PY,
+                                      workdir=str(tmp_path))],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        child_pid = int(host.stdout.readline())
+        assert _pid_alive(child_pid)
+        os.kill(host.pid, signal.SIGKILL)
+        host.wait(timeout=5)
+        assert _wait_dead(child_pid, 5.0), \
+            "gang member survived SIGKILL of its supervisor"
+    finally:
+        if host.poll() is None:
+            host.kill()
+        if _pid_alive(locals().get("child_pid", -1)):
+            os.kill(child_pid, signal.SIGKILL)
+
+
+def test_parent_watch_pipe_eof_kills_child():
+    """Portable half: a runner-style child holding the keepalive read end
+    dies when the write end closes (= supervisor process exited)."""
+    r, w = os.pipe()
+    os.set_inheritable(r, True)
+    child = subprocess.Popen(
+        [PY, "-c", textwrap.dedent(f"""
+            import sys, time
+            sys.path.insert(0, {REPO_ROOT!r})
+            from kubeflow_tpu.runtime.lifetime import install_parent_watch
+            assert install_parent_watch()
+            print("armed", flush=True)
+            time.sleep(120)
+        """)],
+        env={**os.environ, "KFX_PARENT_FD": str(r)},
+        pass_fds=(r,), start_new_session=True, stdout=subprocess.PIPE,
+        text=True)
+    try:
+        os.close(r)
+        assert child.stdout.readline().strip() == "armed"
+        os.close(w)
+        assert child.wait(timeout=5) != 0  # SIGKILLed its own group
+    finally:
+        if child.poll() is None:
+            os.killpg(os.getpgid(child.pid), signal.SIGKILL)
+
+
+def test_parent_watch_ppid_fallback_installs():
+    """Without a pipe the watcher falls back to polling getppid()."""
+    out = subprocess.run(
+        [PY, "-c", textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {REPO_ROOT!r})
+            import os
+            os.environ.pop("KFX_PARENT_FD", None)
+            from kubeflow_tpu.runtime.lifetime import install_parent_watch
+            print(install_parent_watch())
+        """)],
+        capture_output=True, text=True, timeout=30)
+    assert out.stdout.strip() == "True", out.stderr
+
+
+def test_clean_pod_none_survivors_not_killed_by_thread_exit(tmp_path):
+    """PDEATHSIG fires on forking-THREAD death; the supervisor thread must
+    linger while cleanPodPolicy=None survivors run, or chief success would
+    kill workers it promised to leave alone."""
+    from kubeflow_tpu.api import training as T
+    from kubeflow_tpu.runtime.gang import Gang, ProcessSpec
+
+    g = Gang(
+        "linger",
+        [ProcessSpec("chief", 0, [PY, "-c", "pass"]),
+         ProcessSpec("worker", 0, [PY, "-c", "import time; time.sleep(8)"])],
+        str(tmp_path), clean_policy=T.CLEAN_POD_NONE,
+        chief_replica_type="chief")
+    g.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and g.status().phase != "Succeeded":
+        time.sleep(0.05)
+    assert g.status().phase == "Succeeded"
+    worker_pid = g.status().replicas["worker-0"].pid
+    time.sleep(1.0)  # the window where a non-lingering thread would exit
+    assert _pid_alive(worker_pid), \
+        "cleanPodPolicy=None survivor was killed by supervisor-thread exit"
+    g.delete()
+    assert _wait_dead(worker_pid, 5.0)
