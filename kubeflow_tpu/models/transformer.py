@@ -383,21 +383,28 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False, positions=None):
         cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed")
         if cfg.cp > 1:
-            # Pin the token layout before the (vocab-sharded) embedding
-            # gather so the lookup's output lands directly on the
-            # (data, ctx) layout the layer stack keeps — otherwise SPMD
-            # falls back to a full rematerialisation of the activations.
+            # Context-parallel lookup as a one-hot einsum instead of a
+            # gather: with tokens pinned to the (data, ctx) layout and the
+            # table sharded (vocab→model, embed→data under fsdp), SPMD
+            # cannot partition the gather without involuntarily
+            # rematerialising the full activation; the einsum shards
+            # cleanly (contraction over vocab → psum over "model") and
+            # rides the MXU besides.
             from ..parallel.mesh import AXIS_CTX, AXIS_DATA
             from jax.sharding import PartitionSpec as P
 
             tokens = jax.lax.with_sharding_constraint(
                 tokens, P(AXIS_DATA, AXIS_CTX))
-        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="embed")(tokens)
-        if cfg.cp > 1:
+            one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+            x = jnp.einsum("bsv,vd->bsd", one_hot,
+                           embed.embedding.astype(cfg.dtype))
             x = jax.lax.with_sharding_constraint(
                 x, P(AXIS_DATA, AXIS_CTX, None))
+        else:
+            x = embed(tokens)
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)

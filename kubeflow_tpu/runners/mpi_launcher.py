@@ -131,8 +131,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, forward)
 
     # Ranks must die with the launcher the same way the launcher dies with
-    # its gang supervisor: fresh keepalive pipe + PDEATHSIG (the inherited
-    # KFX_PARENT_FD names a fd that does not exist here, so re-point it).
+    # its gang supervisor: fresh keepalive pipe + PDEATHSIG. The gang's own
+    # pipe can't be reused — install_parent_watch above consumed it (its
+    # watcher thread owns the read end, now non-inheritable), and its EOF
+    # means "gang supervisor died", not "launcher died".
     ka_r, ka_w = os.pipe()
     preexec = lifetime.make_child_preexec(os.getpid())
     for rank in range(np):

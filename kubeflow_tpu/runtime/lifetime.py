@@ -71,7 +71,13 @@ def install_parent_watch() -> bool:
 
     def _die() -> None:
         try:
-            os.killpg(0, signal.SIGKILL)  # we are our session's leader
+            # Take our own subtree only when we lead the group (gang
+            # members are session leaders via start_new_session); a
+            # hand-started runner shares its parent's group, where
+            # killpg(0) would blast unrelated siblings.
+            if os.getpgrp() == os.getpid():
+                os.killpg(0, signal.SIGKILL)
+            os.kill(os.getpid(), signal.SIGKILL)
         except Exception:
             os._exit(1)
 
@@ -85,19 +91,22 @@ def install_parent_watch() -> bool:
             fd = int(fd_s)
             os.set_inheritable(fd, False)  # don't leak into our children
         except (ValueError, OSError):
-            return False
+            fd = -1  # stale fd (e.g. closed by close_fds in a grandchild)
 
-        def _watch_pipe() -> None:
-            try:
-                while os.read(fd, 1):  # supervisor never writes; EOF = dead
+        if fd >= 0:
+            def _watch_pipe() -> None:
+                try:
+                    while os.read(fd, 1):  # supervisor writes nothing;
+                        pass                # EOF = dead
+                except OSError:
                     pass
-            except OSError:
-                pass
-            _die()
+                _die()
 
-        threading.Thread(target=_watch_pipe, name="kfx-parent-watch",
-                         daemon=True).start()
-        return True
+            threading.Thread(target=_watch_pipe, name="kfx-parent-watch",
+                             daemon=True).start()
+            return True
+        # fall through to the ppid poll: a bad fd must degrade to the
+        # weaker watch, never to no watch at all
 
     parent = os.getppid()
     if parent <= 1:  # already orphaned, or direct child of init

@@ -65,6 +65,7 @@ def test_sigkilled_supervisor_takes_gang_down(tmp_path):
         [PY, "-c", HOST_SCRIPT.format(root=REPO_ROOT, py=PY,
                                       workdir=str(tmp_path))],
         stdout=subprocess.PIPE, text=True)
+    child_pid = -1
     try:
         child_pid = int(host.stdout.readline())
         assert _pid_alive(child_pid)
@@ -75,7 +76,7 @@ def test_sigkilled_supervisor_takes_gang_down(tmp_path):
     finally:
         if host.poll() is None:
             host.kill()
-        if _pid_alive(locals().get("child_pid", -1)):
+        if child_pid > 0 and _pid_alive(child_pid):
             os.kill(child_pid, signal.SIGKILL)
 
 
