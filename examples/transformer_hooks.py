@@ -1,0 +1,15 @@
+"""Transformer-component hooks for the inference-graph example
+(inference-graph.yaml). The InferenceService transformer loads this file
+and chains it in front of the predictor: preprocess rescales raw 0-255
+pixels to the unit range the model was trained on; postprocess wraps the
+class ids in labeled objects."""
+
+import numpy as np
+
+
+def preprocess(instances):
+    return (np.asarray(instances, dtype="float32") / 255.0).tolist()
+
+
+def postprocess(predictions):
+    return [{"label": int(p)} for p in predictions]

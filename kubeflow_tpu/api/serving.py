@@ -16,11 +16,21 @@ from .base import Resource, ValidationError, register
 ISVC_READY = "Ready"
 ISVC_PREDICTOR_READY = "PredictorReady"
 ISVC_TRANSFORMER_READY = "TransformerReady"
+ISVC_EXPLAINER_READY = "ExplainerReady"
 ISVC_FAILED = "Failed"
 
+# Accepted predictor frameworks. Servers exist for jax (serving/server.py),
+# pytorch (TorchScript, serving/torch_server.py), tensorflow (SavedModel,
+# serving/tf_server.py) and the LM export (:generate). sklearn / xgboost /
+# onnx / triton match the reference API surface but are NOT serveable in
+# this environment — those runtimes are not installed and there is no
+# network to fetch them (SURVEY.md §0.1); applying one fails at revision
+# startup with a clear server-side error rather than at validation, so the
+# same manifest works on an environment that has them.
 PREDICTOR_FRAMEWORKS = ["jax", "sklearn", "xgboost", "pytorch", "tensorflow",
                         "onnx", "triton", "custom"]
 COMPONENTS = ["predictor", "transformer", "explainer"]
+EXPLAINER_METHODS = ["occlusion"]
 
 
 @register
@@ -124,3 +134,15 @@ class InferenceService(Resource):
                     raise ValidationError(
                         f"spec.{rev}.device",
                         f"{dev!r} not one of auto/default/cpu")
+        tr = self.spec.get("transformer")
+        if tr is not None and not tr.get("module"):
+            raise ValidationError(
+                "spec.transformer.module",
+                "required: python file providing preprocess()/postprocess()")
+        ex = self.spec.get("explainer")
+        if ex is not None:
+            method = str(ex.get("method", "occlusion"))
+            if method not in EXPLAINER_METHODS:
+                raise ValidationError(
+                    "spec.explainer.method",
+                    f"{method!r} not one of {EXPLAINER_METHODS}")

@@ -30,8 +30,10 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..api.serving import (
+    ISVC_EXPLAINER_READY,
     ISVC_PREDICTOR_READY,
     ISVC_READY,
+    ISVC_TRANSFORMER_READY,
     InferenceService,
 )
 from ..core.controller import Controller, Result
@@ -48,17 +50,22 @@ class _Replica:
 
 
 class _Revision:
-    """Supervised replica set for one revision of one InferenceService."""
+    """Supervised replica set for one component revision of one
+    InferenceService: a predictor revision (default/canary) or an
+    inference-graph component (transformer/explainer, serving/graph.py)."""
 
     def __init__(self, name: str, model_name: str, model_dir: str,
                  workdir: str, batcher: Optional[dict],
-                 device: str = "auto"):
+                 device: str = "auto", role: str = "predictor",
+                 graph: Optional[dict] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
         self.workdir = workdir
         self.batcher = batcher
         self.device = device
+        self.role = role
+        self.graph = graph or {}
         self.replicas: List[_Replica] = []
         self.restarts = 0
         # (timestamp, desired) samples for the autoscaler's damping window.
@@ -66,15 +73,30 @@ class _Revision:
 
     def spawn(self) -> None:
         port = free_port()
-        argv = [sys.executable, "-m", "kubeflow_tpu.serving.server",
-                f"--model-dir={self.model_dir}", f"--name={self.model_name}",
-                f"--port={port}", f"--device={self.device}"]
-        if self.batcher:
-            argv += [f"--max-batch-size={self.batcher.get('maxBatchSize', 32)}",
-                     "--batcher-max-latency-ms="
-                     f"{self.batcher.get('maxLatencyMs', 2.0)}",
-                     "--batcher-reply-timeout-s="
-                     f"{self.batcher.get('replyTimeoutS', 60.0)}"]
+        if self.role == "predictor":
+            argv = [sys.executable, "-m", "kubeflow_tpu.serving.server",
+                    f"--model-dir={self.model_dir}",
+                    f"--name={self.model_name}",
+                    f"--port={port}", f"--device={self.device}"]
+            if self.batcher:
+                argv += [
+                    f"--max-batch-size={self.batcher.get('maxBatchSize', 32)}",
+                    "--batcher-max-latency-ms="
+                    f"{self.batcher.get('maxLatencyMs', 2.0)}",
+                    "--batcher-reply-timeout-s="
+                    f"{self.batcher.get('replyTimeoutS', 60.0)}"]
+        else:
+            argv = [sys.executable, "-m", "kubeflow_tpu.serving.graph",
+                    self.role, f"--name={self.model_name}",
+                    f"--port={port}",
+                    f"--predictor-url={self.graph['predictor_url']}"]
+            if self.role == "transformer" and self.graph.get("module"):
+                argv.append(f"--module={self.graph['module']}")
+            if self.role == "explainer":
+                argv += [f"--method={self.graph.get('method', 'occlusion')}",
+                         "--feature-groups="
+                         f"{self.graph.get('featureGroups', 16)}",
+                         f"--baseline={self.graph.get('baseline', 0.0)}"]
         os.makedirs(self.workdir, exist_ok=True)
         env = inject_pythonpath(dict(os.environ))
         logf = open(os.path.join(
@@ -311,6 +333,60 @@ class InferenceServiceController(Controller):
             if ready < max(base_want, 1) and base_want > 0:
                 all_ready = False
 
+        # Inference-graph components (SURVEY.md §2.1 KFServing row, §3
+        # CS3): transformer chained in front of the predictor, explainer
+        # on :explain — each a supervised single-role replica set the
+        # router routes by path/header (serving/graph.py).
+        graph_ready: Dict[str, Optional[bool]] = {}
+        for comp in ("transformer", "explainer"):
+            spec = isvc.component_spec(comp)
+            rev = rt.revisions.get(comp)
+            backend_set = getattr(rt.router, comp)
+            if spec is None:
+                setattr(rt.router, f"{comp}_configured", False)
+                if rev is not None:
+                    backend_set.set_endpoints([])
+                    rev.teardown()
+                    del rt.revisions[comp]
+                graph_ready[comp] = None  # drop any stale condition
+                continue
+            module = str(spec.get("module", ""))
+            if "://" in module:
+                # storage-initializer the hook file too — a single file,
+                # not an export directory
+                from ..serving.storage import fetch_file
+
+                module = fetch_file(
+                    module, os.path.join(self.home, "storage-cache"))
+            graph = {
+                "predictor_url": f"http://127.0.0.1:{rt.router.port}",
+                "module": module,
+                "method": str(spec.get("method", "occlusion")),
+                "featureGroups": int(spec.get("featureGroups", 16)),
+                "baseline": float(spec.get("baseline", 0.0)),
+            }
+            if rev is None or rev.graph != graph:
+                if rev is not None:
+                    rev.teardown()
+                rev = _Revision(
+                    name=comp, model_name=isvc.name, model_dir="",
+                    workdir=os.path.join(self.home, "serving",
+                                         key.replace("/", "_")),
+                    batcher=None, role=comp, graph=graph)
+                rt.revisions[comp] = rev
+                self.record_event(isvc, "Normal", "ComponentCreated",
+                                  f"{comp} component")
+            want = max(1, int(spec.get("minReplicas", 1)))
+            rev.reap_and_respawn(want)
+            ready = rev.probe()
+            backend_set.set_endpoints(rev.endpoints())
+            setattr(rt.router, f"{comp}_configured", True)
+            # Readiness against the spec's floor, same rule as the
+            # predictor revisions above.
+            graph_ready[comp] = ready >= want
+            if ready < want:
+                all_ready = False
+
         # Router wiring + traffic split.
         default_rev = rt.revisions.get("default")
         canary_rev = rt.revisions.get("canary")
@@ -322,12 +398,13 @@ class InferenceServiceController(Controller):
         else:
             rt.router.canary_percent = 0
 
-        self._sync_status(isvc, rt, all_ready)
+        self._sync_status(isvc, rt, all_ready, graph_ready)
         return Result(requeue=True, requeue_after=0.25) if not all_ready \
             else None
 
     def _sync_status(self, isvc: InferenceService, rt: _IsvcRuntime,
-                     all_ready: bool) -> None:
+                     all_ready: bool,
+                     graph_ready: Optional[Dict[str, bool]] = None) -> None:
         fresh = self.get_resource(isvc.key)
         if fresh is None:
             return
@@ -348,6 +425,25 @@ class InferenceServiceController(Controller):
                 isvc.set_condition(ctype, status,
                                    "RevisionsReady" if all_ready
                                    else "RevisionsNotReady", "")
+                changed = True
+        comp_conditions = {"transformer": ISVC_TRANSFORMER_READY,
+                           "explainer": ISVC_EXPLAINER_READY}
+        for comp, ok in (graph_ready or {}).items():
+            ctype = comp_conditions[comp]
+            if ok is None:
+                # Component removed from the spec: its condition must not
+                # linger at a stale True.
+                conds = isvc.status.get("conditions", [])
+                kept = [c for c in conds if c.get("type") != ctype]
+                if len(kept) != len(conds):
+                    isvc.status["conditions"] = kept
+                    changed = True
+                continue
+            cstat = "True" if ok else "False"
+            if not isvc.has_condition(ctype, cstat):
+                isvc.set_condition(ctype, cstat,
+                                   "ComponentReady" if ok
+                                   else "ComponentNotReady", "")
                 changed = True
         if changed:
             try:

@@ -1,0 +1,273 @@
+"""Inference-graph components: transformer and explainer servers.
+
+Reference shape (SURVEY.md §2.1 KFServing row, §3 CS3): an
+InferenceService may chain a *transformer* (user pre/post-processing)
+in front of the predictor and expose a *explainer* on the ``:explain``
+verb. Both are separate services in the reference (own Knative service
+per component); here they are supervised server processes, and the
+operator's router chains them:
+
+    client :predict ──router──> transformer ──router(X-KFX-Component:
+                                predictor)──> predictor
+    client :explain ──router──> explainer  ──router(...)──> predictor
+
+* TransformerServer loads ``preprocess(instances)`` /
+  ``postprocess(predictions)`` hooks from a user python module (the
+  custom-container analogue) and forwards the transformed payload to the
+  predictor through the router, so the canary split still applies.
+* ExplainerServer implements a model-agnostic occlusion explainer: it
+  asks the predictor for class probabilities, re-predicts with
+  contiguous feature groups masked to a baseline, and reports the
+  per-group drop in the predicted class's probability — black-box
+  saliency in the spirit of the reference's Alibi explainer, with no
+  extra model dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# Header the router interprets as "skip the transformer chain, go to the
+# predictor revisions" — how graph components reach the predictor through
+# the same URL (keeping canary percentages in force) without looping.
+PREDICTOR_HEADER = "X-KFX-Component"
+
+
+class PredictorClient:
+    """HTTP client for the predictor behind the router, with short
+    retries over the scale-from-zero window (the router answers 503 +
+    Retry-After while the activator spawns a replica)."""
+
+    def __init__(self, base_url: str, model: str, timeout: float = 60.0,
+                 retries: int = 20):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.timeout = timeout
+        self.retries = retries
+
+    def predict(self, instances: List[Any],
+                probabilities: bool = False) -> Dict[str, Any]:
+        body = {"instances": instances}
+        if probabilities:
+            body["probabilities"] = True
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/models/{self.model}:predict",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     PREDICTOR_HEADER: "predictor"})
+        last: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return json.load(r)
+            except urllib.error.HTTPError as e:
+                if e.code == 503:  # cold predictor: wait for the activator
+                    last = e
+                    time.sleep(0.5)
+                    continue
+                raise RuntimeError(
+                    f"predictor {e.code}: {e.read()[:200]!r}") from e
+        raise RuntimeError(f"predictor unavailable after retries: {last}")
+
+
+def load_hooks(module_path: str) -> Dict[str, Any]:
+    """Load ``preprocess`` / ``postprocess`` callables from a user python
+    file (absent hooks default to identity)."""
+    spec = importlib.util.spec_from_file_location("kfx_transformer",
+                                                  module_path)
+    if spec is None or spec.loader is None:
+        raise ValueError(f"cannot load transformer module {module_path!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return {"preprocess": getattr(mod, "preprocess", None),
+            "postprocess": getattr(mod, "postprocess", None)}
+
+
+class _GraphHTTP:
+    """Small V1-protocol HTTP scaffold shared by both components."""
+
+    def __init__(self, name: str, port: int = 0, host: str = "127.0.0.1"):
+        self.name = name
+        self.ready = False
+        self.request_count = 0
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/", "/healthz"):
+                    self._send(200, {"status": "alive"})
+                elif self.path == f"/v1/models/{svc.name}":
+                    self._send(200, {"name": svc.name, "ready": svc.ready})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                svc.request_count += 1
+                try:
+                    code, payload = svc.handle(self.path, body)
+                except ValueError as e:
+                    code, payload = 400, {"error": str(e)}
+                except Exception as e:
+                    code, payload = 500, {"error": str(e)}
+                self._send(code, payload)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def handle(self, path: str, body: Dict[str, Any]):
+        raise NotImplementedError
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="kfx-graph")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TransformerServer(_GraphHTTP):
+    def __init__(self, name: str, predictor: PredictorClient,
+                 module_path: str = "", port: int = 0):
+        super().__init__(name, port)
+        self.predictor = predictor
+        self.hooks = load_hooks(module_path) if module_path else {}
+        self.ready = True
+
+    def handle(self, path: str, body: Dict[str, Any]):
+        if path != f"/v1/models/{self.name}:predict":
+            return 404, {"error": f"no route {path}"}
+        instances = body.get("instances")
+        if instances is None:
+            raise ValueError("'instances' required")
+        pre = self.hooks.get("preprocess")
+        if pre is not None:
+            instances = pre(instances)
+        result = self.predictor.predict(
+            instances, probabilities=bool(body.get("probabilities", False)))
+        post = self.hooks.get("postprocess")
+        if post is not None:
+            result["predictions"] = post(result.get("predictions"))
+        return 200, result
+
+
+class ExplainerServer(_GraphHTTP):
+    def __init__(self, name: str, predictor: PredictorClient,
+                 method: str = "occlusion", feature_groups: int = 16,
+                 baseline: float = 0.0, port: int = 0):
+        if method != "occlusion":
+            raise ValueError(f"unknown explainer method {method!r} "
+                             "(supported: occlusion)")
+        super().__init__(name, port)
+        self.predictor = predictor
+        self.feature_groups = max(1, int(feature_groups))
+        self.baseline = float(baseline)
+        self.ready = True
+
+    def handle(self, path: str, body: Dict[str, Any]):
+        if path != f"/v1/models/{self.name}:explain":
+            return 404, {"error": f"no route {path}"}
+        instances = body.get("instances")
+        if instances is None:
+            raise ValueError("'instances' required")
+        x = np.asarray(instances, np.float32)
+        return 200, {"explanations": [self._explain(inst) for inst in x]}
+
+    def _explain(self, inst: np.ndarray) -> Dict[str, Any]:
+        base = self.predictor.predict([inst.tolist()], probabilities=True)
+        cls = int(base["predictions"][0])
+        base_p = float(base["probabilities"][0][cls])
+        flat = inst.reshape(-1)
+        groups = min(self.feature_groups, flat.size)
+        bounds = np.linspace(0, flat.size, groups + 1, dtype=int)
+        masked = []
+        for g in range(groups):
+            m = flat.copy()
+            m[bounds[g]:bounds[g + 1]] = self.baseline
+            masked.append(m.reshape(inst.shape).tolist())
+        out = self.predictor.predict(masked, probabilities=True)
+        saliency = [round(base_p - float(p[cls]), 6)
+                    for p in out["probabilities"]]
+        return {"method": "occlusion", "predicted_class": cls,
+                "base_probability": round(base_p, 6),
+                "feature_groups": groups,
+                "group_bounds": bounds.tolist(),
+                "saliency": saliency}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description="kfx inference-graph component")
+    p.add_argument("role", choices=["transformer", "explainer"])
+    p.add_argument("--name", required=True,
+                   help="model name (the InferenceService name)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--predictor-url", required=True,
+                   help="router URL; calls carry " + PREDICTOR_HEADER)
+    p.add_argument("--module", default="",
+                   help="transformer: python file with preprocess/"
+                        "postprocess hooks")
+    p.add_argument("--method", default="occlusion")
+    p.add_argument("--feature-groups", type=int, default=16)
+    p.add_argument("--baseline", type=float, default=0.0)
+    args = p.parse_args(argv)
+
+    from ..runtime.lifetime import install_parent_watch
+
+    install_parent_watch()
+    client = PredictorClient(args.predictor_url, args.name)
+    if args.role == "transformer":
+        server: _GraphHTTP = TransformerServer(
+            args.name, client, module_path=args.module, port=args.port)
+    else:
+        server = ExplainerServer(
+            args.name, client, method=args.method,
+            feature_groups=args.feature_groups, baseline=args.baseline,
+            port=args.port)
+    server.start()
+    print(f"graph_ready role={args.role} name={args.name} "
+          f"port={server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -82,6 +82,18 @@ class Router:
         self.default = BackendSet()
         self.canary = BackendSet()
         self.canary_percent = 0
+        # Inference-graph components (SURVEY.md §3 CS3): when configured,
+        # :predict chains through the transformer and :explain routes to
+        # the explainer; both reach the predictor back through this router
+        # with the X-KFX-Component header (serving/graph.py), so canary
+        # splitting happens exactly once, at the predictor hop. The
+        # ``*_configured`` flags are set by the operator: a configured but
+        # not-yet-ready component must 503 (cold path), never silently
+        # skip its stage of the graph.
+        self.transformer = BackendSet()
+        self.explainer = BackendSet()
+        self.transformer_configured = False
+        self.explainer_configured = False
         self._rng = rng or random.Random(0xC0FFEE)
         # Called when a request arrives and no replica is live
         # (scale-from-zero activator hook).
@@ -121,7 +133,21 @@ class Router:
 
     def _proxy(self, h, has_body: bool) -> None:
         self.last_request_time = time.monotonic()
-        backend, chosen = self._pick_backend()
+        path = h.path.partition("?")[0]
+        internal = h.headers.get("X-KFX-Component", "").lower() == \
+            "predictor"
+        if not internal and self.explainer_configured and \
+                path.endswith(":explain"):
+            backend = self.explainer.pick()
+            chosen = self.explainer if backend is not None else None
+        elif not internal and self.transformer_configured and \
+                path.endswith(":predict"):
+            # :generate stays on the predictor chain — the transformer
+            # contract is instance pre/post-processing for :predict only.
+            backend = self.transformer.pick()
+            chosen = self.transformer if backend is not None else None
+        else:
+            backend, chosen = self._pick_backend()
         if chosen is not None:
             chosen.last_request_time = self.last_request_time
         if backend is None:

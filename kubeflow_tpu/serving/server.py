@@ -458,19 +458,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help=">0 enables the micro-batcher")
     p.add_argument("--batcher-reply-timeout-s", type=float, default=60.0)
     p.add_argument("--framework", default="auto",
-                   choices=["auto", "jax", "pytorch", "lm"],
+                   choices=["auto", "jax", "pytorch", "tensorflow", "lm"],
                    help="predict backend; auto sniffs the export format")
     args = p.parse_args(argv)
 
     framework = args.framework
     if framework == "auto":
         from .lm_server import is_lm_export
+        from .tf_server import is_tf_export
         from .torch_server import is_torch_export
 
         if is_lm_export(args.model_dir):
             framework = "lm"
         elif is_torch_export(args.model_dir):
             framework = "pytorch"
+        elif is_tf_export(args.model_dir):
+            framework = "tensorflow"
         else:
             framework = "jax"
     if framework == "lm":
@@ -487,6 +490,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         predictor = TorchPredictor(args.model_dir, name=args.name,
                                    max_batch_size=args.max_batch_size)
+    elif framework == "tensorflow":
+        if args.device not in ("auto", "cpu"):
+            print(f"warning: --device={args.device} ignored "
+                  f"(tf backend runs CPU here)", flush=True)
+        from .tf_server import TFPredictor
+
+        predictor = TFPredictor(args.model_dir, name=args.name,
+                                max_batch_size=args.max_batch_size)
     else:
         predictor = JaxPredictor(args.model_dir, name=args.name,
                                  max_batch_size=args.max_batch_size,

@@ -23,13 +23,24 @@ import hashlib
 import os
 import shutil
 import tempfile
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List
 
-# The files that make up an export (export.py's format). Remote schemes
-# fetch exactly these; local schemes just point at the directory.
-EXPORT_FILES = ("config.json", "params.msgpack")
+# Known export formats, probed in order by marker file. Remote schemes
+# fetch the matching file set; local schemes just point at the
+# directory. Order matters: the LM export also carries params.msgpack
+# (but no config.json), and the TorchScript export also carries
+# config.json — so their markers must be probed before the classifier's.
+# TensorFlow SavedModels (saved_model.pb + a variables/ tree) are
+# multi-file directories remote schemes cannot enumerate; serve those
+# from file:// or pvc:// URIs.
+EXPORT_FORMATS = (
+    ("lm_config.json", ("lm_config.json", "params.msgpack")),
+    ("model.pt", ("model.pt", "config.json")),
+    ("config.json", ("config.json", "params.msgpack")),
+)
 
 ENV_PVC_ROOT = "KFX_PVC_ROOT"
 ENV_S3_ENDPOINT = "KFX_S3_ENDPOINT"
@@ -48,13 +59,33 @@ def _http(uri: str, cache_dir: str) -> str:
         return dest
     os.makedirs(cache_dir, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=cache_dir, prefix=f".{digest}.")
+    base = uri.rstrip("/")
+
+    def fetch(fname: str) -> None:
+        with urllib.request.urlopen(f"{base}/{fname}", timeout=60) as r, \
+                open(os.path.join(tmp, fname), "wb") as f:
+            shutil.copyfileobj(r, f)
+
     try:
-        base = uri.rstrip("/")
-        for fname in EXPORT_FILES:
-            with urllib.request.urlopen(f"{base}/{fname}",
-                                        timeout=60) as r, \
-                    open(os.path.join(tmp, fname), "wb") as f:
-                shutil.copyfileobj(r, f)
+        probe_errors = []
+        for marker, files in EXPORT_FORMATS:
+            try:
+                fetch(marker)
+            except urllib.error.HTTPError as e:
+                if e.code != 404:  # 404 = probe miss; anything else is real
+                    raise
+                probe_errors.append(f"{marker}: {e}")
+                continue
+            for fname in files:
+                if fname != marker:
+                    fetch(fname)
+            break
+        else:
+            raise ValueError(
+                f"no known export format at {uri} — probed "
+                + "; ".join(probe_errors)
+                + " (note: tf SavedModel trees are not downloadable; "
+                  "use file:// or pvc://)")
         try:
             os.replace(tmp, dest)
         except OSError:  # a concurrent initializer completed first
@@ -90,6 +121,51 @@ _SCHEMES: Dict[str, Callable[[str, str], str]] = {
 
 def supported_schemes() -> List[str]:
     return ["file"] + sorted(_SCHEMES)
+
+
+def fetch_file(uri: str, cache_dir: str) -> str:
+    """Resolve a SINGLE-file URI (e.g. a transformer hook module) to a
+    local path — unlike ``initialize``, which resolves export
+    directories. Remote schemes download just that file, atomically, into
+    the cache."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if "://" not in uri:
+        return uri
+    scheme = urllib.parse.urlparse(uri).scheme
+    if scheme == "pvc":
+        return _pvc(uri, cache_dir)
+    if scheme == "gs":
+        bucket, _, obj = uri[len("gs://"):].partition("/")
+        uri = f"https://storage.googleapis.com/{bucket}/{obj}"
+    elif scheme == "s3":
+        bucket, _, obj = uri[len("s3://"):].partition("/")
+        endpoint = os.environ.get(ENV_S3_ENDPOINT)
+        uri = (f"{endpoint.rstrip('/')}/{bucket}/{obj}" if endpoint
+               else f"https://{bucket}.s3.amazonaws.com/{obj}")
+    elif scheme not in ("http", "https"):
+        raise ValueError(
+            f"unsupported file URI scheme {scheme!r} (supported: "
+            f"{', '.join(supported_schemes())})")
+    digest = hashlib.sha256(uri.encode()).hexdigest()[:16]
+    fname = os.path.basename(urllib.parse.urlparse(uri).path) or "file"
+    dest = os.path.join(cache_dir, digest, fname)
+    if os.path.exists(dest):
+        return dest
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest), prefix=".dl.")
+    try:
+        with urllib.request.urlopen(uri, timeout=60) as r, \
+                os.fdopen(fd, "wb") as f:
+            shutil.copyfileobj(r, f)
+        os.replace(tmp, dest)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return dest
 
 
 def initialize(uri: str, cache_dir: str) -> str:

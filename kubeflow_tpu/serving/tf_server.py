@@ -1,0 +1,132 @@
+"""TensorFlow SavedModel predictor — the reference's flagship serving
+runtime (TFServing, SURVEY.md §2.1 KFServing row) behind the same V1 data
+plane.
+
+TPU-first twist on the export side: rather than maintaining a separate TF
+model zoo, ``export_savedmodel`` converts any registry flax model's
+forward function to a SavedModel via ``jax2tf`` — one set of trained
+params serves through either runtime. The serve side is pure TF
+(``tf.saved_model.load`` + the ``serving_default`` signature, host CPU —
+the reference's TFServing predictor is likewise a CPU/GPU container, and
+TF has no claim on the TPU here).
+
+Export layout: standard SavedModel tree (``saved_model.pb`` +
+``variables/``) plus a ``kfx_config.json`` sidecar with input shape /
+class count. Remote storageUri schemes do not support SavedModel trees
+(multi-file directory; see serving/storage.py) — use file:// or pvc://.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .server import Predictor
+
+SAVED_MODEL_FILE = "saved_model.pb"
+SIDECAR_FILE = "kfx_config.json"
+
+
+def is_tf_export(model_dir: str) -> bool:
+    return os.path.exists(os.path.join(model_dir, SAVED_MODEL_FILE))
+
+
+def export_savedmodel(directory: str, model_name: str, input_shape,
+                      num_classes: int, state) -> str:
+    """Write a SavedModel export of a registry model's forward pass.
+
+    ``state`` is a TrainLoop state (``.params`` + optional
+    ``.batch_stats``). The batch dimension is polymorphic, so any batch
+    size serves through one signature."""
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    import jax
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    from ..models import get_model
+
+    model = get_model(model_name, num_classes=num_classes)
+    variables: Dict[str, Any] = {"params": jax.device_get(state.params)}
+    bs = getattr(state, "batch_stats", None)
+    if bs:
+        variables["batch_stats"] = jax.device_get(bs)
+
+    def fwd(x):
+        return model.apply(variables, x, train=False)
+
+    shape_sig = "(b, " + ", ".join(str(int(d)) for d in input_shape) + ")"
+    tf_fn = tf.function(
+        jax2tf.convert(fwd, polymorphic_shapes=[shape_sig],
+                       with_gradient=False),
+        input_signature=[tf.TensorSpec([None, *input_shape], tf.float32,
+                                       name="instances")],
+        autograph=False)
+    module = tf.Module()
+    module.serve = tf_fn
+    tf.saved_model.save(
+        module, directory,
+        signatures={"serving_default": tf_fn.get_concrete_function()})
+    with open(os.path.join(directory, SIDECAR_FILE), "w") as f:
+        json.dump({"framework": "tensorflow", "model": model_name,
+                   "input_shape": list(input_shape),
+                   "num_classes": int(num_classes)}, f)
+    return directory
+
+
+class TFPredictor(Predictor):
+    """V1-protocol predictor over a SavedModel's serving_default."""
+
+    def __init__(self, model_dir: str, name: str = "",
+                 max_batch_size: int = 64, device: str = "cpu"):
+        self.model_dir = model_dir
+        self.name = name or "model"
+        self.max_batch_size = max_batch_size
+        self.input_shape: Optional[tuple] = None
+        self.num_classes: Optional[int] = None
+        self._fn = None
+        self._loaded = None  # keep the SavedModel object alive
+
+    def load(self) -> None:
+        os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+        os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+        import tensorflow as tf
+
+        self._loaded = tf.saved_model.load(self.model_dir)
+        self._fn = self._loaded.signatures["serving_default"]
+        sidecar = os.path.join(self.model_dir, SIDECAR_FILE)
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                meta = json.load(f)
+            self.input_shape = tuple(meta.get("input_shape") or ())
+            self.num_classes = meta.get("num_classes")
+        if not self.input_shape:
+            # Fall back to the signature (batch dim is polymorphic/None).
+            _, kw = self._fn.structured_input_signature
+            spec = next(iter(kw.values()))
+            self.input_shape = tuple(int(d) for d in spec.shape[1:])
+        # Warm the function (first trace/XlaCallModule init).
+        self._call(np.zeros((1, *self.input_shape), np.float32))
+        self.ready = True
+
+    def _call(self, x: np.ndarray) -> np.ndarray:
+        import tensorflow as tf
+
+        out = self._fn(tf.constant(x))
+        return next(iter(out.values())).numpy()
+
+    def predict(self, instances: np.ndarray,
+                probabilities: bool = False) -> Dict[str, Any]:
+        logits = []
+        for start in range(0, instances.shape[0], self.max_batch_size):
+            chunk = np.asarray(instances[start:start + self.max_batch_size],
+                               np.float32)
+            logits.append(self._call(chunk))
+        lg = np.concatenate(logits, 0)
+        out: Dict[str, Any] = {"predictions": lg.argmax(-1).tolist()}
+        if probabilities:
+            e = np.exp(lg - lg.max(-1, keepdims=True))
+            out["probabilities"] = (e / e.sum(-1, keepdims=True)).tolist()
+        return out

@@ -485,3 +485,185 @@ spec:
                 time.sleep(0.3)
             else:
                 raise AssertionError("never scaled back to zero")
+
+
+TRANSFORMER_MODULE = '''
+import numpy as np
+
+
+def preprocess(instances):
+    # Undo the client's 0-255 encoding: the predictor was trained on
+    # unit-scaled pixels.
+    return (np.asarray(instances, dtype="float32") / 255.0).tolist()
+
+
+def postprocess(predictions):
+    return [{"label": int(p)} for p in predictions]
+'''
+
+
+class TestInferenceGraph:
+    """Transformer + explainer components chained by the router
+    (SURVEY.md §2.1 KFServing row, §3 CS3)."""
+
+    @pytest.fixture(scope="class")
+    def module_file(self, tmp_path_factory):
+        path = tmp_path_factory.mktemp("hooks") / "transform.py"
+        path.write_text(TRANSFORMER_MODULE)
+        return str(path)
+
+    def test_components_inprocess(self, export_dir, module_file):
+        from kubeflow_tpu.serving.graph import (
+            ExplainerServer, PredictorClient, TransformerServer)
+        from kubeflow_tpu.serving.router import Router
+        from kubeflow_tpu.serving.server import JaxPredictor, ModelServer
+
+        predictor = JaxPredictor(export_dir, name="m", max_batch_size=16)
+        predictor.load()
+        ms = ModelServer(port=0)
+        ms.register(predictor)
+        ms.start()
+        router = Router().start()
+        router.default.set_endpoints([f"127.0.0.1:{ms.port}"])
+        client = PredictorClient(f"http://127.0.0.1:{router.port}", "m",
+                                 retries=3)
+        tr = TransformerServer("m", client, module_path=module_file).start()
+        ex = ExplainerServer("m", client, feature_groups=8).start()
+        router.transformer.set_endpoints([f"127.0.0.1:{tr.port}"])
+        router.explainer.set_endpoints([f"127.0.0.1:{ex.port}"])
+        router.transformer_configured = True
+        router.explainer_configured = True
+        try:
+            x = (np.zeros((2, 28, 28, 1)) + 128).tolist()
+            url = f"http://127.0.0.1:{router.port}"
+            status, body = _post(f"{url}/v1/models/m:predict",
+                                 {"instances": x}, timeout=60)
+            assert status == 200
+            # postprocess shape proves the transformer chain ran
+            assert all(isinstance(p, dict) and "label" in p
+                       for p in body["predictions"])
+            status, body = _post(f"{url}/v1/models/m:explain",
+                                 {"instances": [np.zeros((28, 28, 1)).tolist()]},
+                                 timeout=60)
+            assert status == 200
+            e = body["explanations"][0]
+            assert e["method"] == "occlusion"
+            assert len(e["saliency"]) == 8
+            assert 0.0 <= e["base_probability"] <= 1.0
+        finally:
+            tr.stop()
+            ex.stop()
+            router.stop()
+            ms.stop()
+
+    def test_isvc_full_graph_e2e(self, export_dir, module_file, tmp_path):
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: graphy
+spec:
+  predictor:
+    minReplicas: 1
+    jax:
+      storageUri: file://{export_dir}
+  transformer:
+    module: {module_file}
+  explainer:
+    method: occlusion
+    featureGroups: 4
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "graphy",
+                                         "Ready", timeout=120)
+            assert isvc.has_condition("TransformerReady", "True")
+            assert isvc.has_condition("ExplainerReady", "True")
+            url = isvc.status["url"]
+            x = (np.zeros((2, 28, 28, 1)) + 128).tolist()
+            status, body = _post(f"{url}/v1/models/graphy:predict",
+                                 {"instances": x}, timeout=60)
+            assert status == 200
+            assert all(isinstance(p, dict) and "label" in p
+                       for p in body["predictions"])
+            status, body = _post(
+                f"{url}/v1/models/graphy:explain",
+                {"instances": [np.zeros((28, 28, 1)).tolist()]}, timeout=60)
+            assert status == 200
+            e = body["explanations"][0]
+            assert len(e["saliency"]) == 4 and e["feature_groups"] == 4
+
+
+class TestTFServing:
+    """TF SavedModel predictor (the reference's TFServing runtime): a
+    registry model exported via jax2tf, served by pure TF on CPU."""
+
+    @pytest.fixture(scope="class")
+    def tf_export(self, tmp_path_factory, export_dir):
+        import jax
+
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.serving.tf_server import export_savedmodel
+        from kubeflow_tpu.training import TrainLoop
+
+        ds = get_dataset("mnist")
+        model = get_model("mlp", num_classes=ds.num_classes)
+        loop = TrainLoop(model)
+        state = loop.init_state(ds.shape)
+        for images, labels in ds.batches(128, steps=10):
+            state, *_ = loop.train_step(state, images, labels)
+        out = tmp_path_factory.mktemp("tf-export")
+        export_savedmodel(str(out), "mlp", ds.shape, ds.num_classes, state)
+        self._state = state
+        return str(out), state, model
+
+    def test_export_and_predict_matches_jax(self, tf_export):
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.serving.tf_server import (
+            TFPredictor, is_tf_export)
+
+        path, state, model = tf_export
+        assert is_tf_export(path)
+        p = TFPredictor(path, name="tfm")
+        p.load()
+        assert p.ready and p.input_shape == (28, 28, 1)
+        x = np.random.default_rng(0).normal(
+            size=(5, 28, 28, 1)).astype(np.float32)
+        out = p.predict(x, probabilities=True)
+        assert np.allclose(np.sum(out["probabilities"], -1), 1.0, atol=1e-5)
+        # Numerics parity with the jax forward on the same params.
+        jax_logits = model.apply({"params": state.params}, jnp.asarray(x),
+                                 train=False)
+        assert out["predictions"] == \
+            np.asarray(jax_logits).argmax(-1).tolist()
+
+    def test_isvc_tensorflow_e2e(self, tf_export, tmp_path):
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        path, _, _ = tf_export
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: tfserve
+spec:
+  predictor:
+    minReplicas: 1
+    tensorflow:
+      storageUri: file://{path}
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "tfserve",
+                                         "Ready", timeout=120)
+            url = isvc.status["url"]
+            x = np.zeros((3, 28, 28, 1), np.float32)
+            status, body = _post(f"{url}/v1/models/tfserve:predict",
+                                 {"instances": x.tolist()}, timeout=60)
+            assert status == 200 and len(body["predictions"]) == 3
