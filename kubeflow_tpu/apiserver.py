@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import html
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
@@ -598,9 +599,40 @@ class Client:
         return self._json(f"/apis/{kind}/{namespace}/{name}/events")["events"]
 
 
+SERVER_MARKER = "server.json"
+
+
+def write_server_marker(home: str, url: str) -> str:
+    """Advertise a live server on its home (``<home>/server.json``), so
+    plain `kfx` invocations against the same home route through it
+    instead of silently diverging from the owning process's state. The
+    marker may go stale on SIGKILL — readers must health-check the URL."""
+    path = os.path.join(home, SERVER_MARKER)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"url": url, "pid": os.getpid()}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def live_server_url(home: str) -> Optional[str]:
+    """URL of a live `kfx server` owning ``home``, else None (no marker,
+    or a stale one from a killed server)."""
+    try:
+        with open(os.path.join(home, SERVER_MARKER)) as f:
+            info = json.load(f)
+    except (OSError, ValueError):
+        return None
+    url = info.get("url")
+    if url and Client(url, timeout=2.0).healthy():
+        return url
+    return None
+
+
 def serve_forever(home: Optional[str] = None, port: int = 8134) -> int:
     with ControlPlane(home=home, journal=True) as cp:
         server = ApiServer(cp, port=port)
+        marker = write_server_marker(cp.home, server.url)
         print(f"kfx apiserver + dashboard on {server.url} "
               f"(KFX_SERVER={server.url} for client mode)", flush=True)
         try:
@@ -609,4 +641,8 @@ def serve_forever(home: Optional[str] = None, port: int = 8134) -> int:
             pass
         finally:
             server.httpd.server_close()
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
     return 0

@@ -371,8 +371,9 @@ def _main(argv: Optional[List[str]] = None) -> int:
         for p in generate(args.filename, args.output):
             print(p)
         return 0
-    if os.environ.get("KFX_SERVER") and args.cmd in (
-            "apply", "run", "get", "describe", "delete", "logs", "events"):
+    _REMOTE_VERBS = ("apply", "run", "get", "describe", "delete", "logs",
+                     "events")
+    if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
     if args.cmd == "server":
         try:
@@ -383,11 +384,33 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return 1
         return serve_forever(home=args.home, port=args.port)
 
+    # A running `kfx server` owns its home: its in-memory store, watches
+    # and gangs are authoritative, and a local-mode mutation (apply /
+    # delete) against the same sqlite would silently diverge — the server
+    # never observes it, and its next status write resurrects the row.
+    # Detect the owner (health-checked marker it wrote at startup) and
+    # route through it.
+    server_url = _detect_server(args.home)
+    if server_url is not None:
+        if args.cmd in _REMOTE_VERBS:
+            print(f"note: routing through the running kfx server at "
+                  f"{server_url} (it owns this home)", file=sys.stderr)
+            return _remote_main(args, url=server_url)
+        if args.cmd == "kill-replica":
+            print(f"error: this home is owned by the kfx server at "
+                  f"{server_url}; kill-replica must run in the owning "
+                  f"process (its gangs are not visible here)",
+                  file=sys.stderr)
+            return 1
+        # profile is read-only cross-process (profiler ports are
+        # advertised on disk) and safe to run locally.
+
     # Verbs that don't launch work must never reconcile: a second control
     # plane on the same home would adopt Running jobs and spawn duplicate
-    # gangs next to their owner. delete is store-only (an owning server
-    # observes it through its own store watch); kill-replica only acts on
-    # gangs this process owns.
+    # gangs next to their owner. kill-replica only acts on gangs this
+    # process owns; delete without a live server is store-only and the
+    # finished/ownerless gang case is the only one left after the routing
+    # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
                            "delete", "kill-replica")
     with ControlPlane(home=args.home, journal=True, passive=passive) as cp:
@@ -440,14 +463,24 @@ def _dict_state(obj: dict) -> str:
     return display_state(obj.get("status", {}).get("conditions", []))
 
 
-def _remote_main(args) -> int:
-    """Thin-client mode: KFX_SERVER points at a running `kfx server`;
-    state and gangs live there (the kubectl model — see apiserver)."""
+def _detect_server(home: Optional[str]) -> Optional[str]:
+    """URL of a live `kfx server` owning this home, else None."""
+    try:
+        from .apiserver import live_server_url
+    except ImportError:
+        return None
+    return live_server_url(os.path.abspath(home or default_home()))
+
+
+def _remote_main(args, url: Optional[str] = None) -> int:
+    """Thin-client mode: KFX_SERVER points at a running `kfx server`
+    (or one was detected owning the home); state and gangs live there
+    (the kubectl model — see apiserver)."""
     import urllib.error
 
     from .apiserver import ApiError, Client
 
-    url = os.environ["KFX_SERVER"]
+    url = url or os.environ["KFX_SERVER"]
     client = Client(url)
     try:
         return _remote_dispatch(client, args)

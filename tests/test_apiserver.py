@@ -347,3 +347,57 @@ spec:
             time.sleep(0.2)
         assert "<script>alert(1)</script>" not in page
         assert "&lt;script&gt;" in page
+
+
+import os
+
+
+class TestOwnedHomeRouting:
+    """A home owned by a live `kfx server` must not accept diverging
+    local-mode mutations (round-2 advisor finding): the CLI detects the
+    owner via its health-checked marker and routes through HTTP."""
+
+    def test_marker_write_and_liveness(self, server, tmp_path):
+        from kubeflow_tpu.apiserver import (
+            live_server_url, write_server_marker)
+
+        home = str(tmp_path / "owned")
+        os.makedirs(home)
+        write_server_marker(home, server.url)
+        assert live_server_url(home) == server.url
+        # A stale marker (dead server) must read as no owner.
+        write_server_marker(home, "http://127.0.0.1:1")
+        assert live_server_url(home) is None
+
+    def test_local_delete_routes_through_owner(self, server, tmp_path,
+                                               capsys, monkeypatch):
+        from kubeflow_tpu.apiserver import write_server_marker
+        from kubeflow_tpu.cli import main as kfx_main
+
+        monkeypatch.delenv("KFX_SERVER", raising=False)
+        home = str(tmp_path / "owned")
+        os.makedirs(home)
+        write_server_marker(home, server.url)
+
+        manifest = tmp_path / "isvc.yaml"
+        manifest.write_text("""
+apiVersion: kubeflow.org/v1
+kind: Profile
+metadata:
+  name: routed-prof
+spec:
+  owner:
+    name: someone
+""")
+        rc = kfx_main(["--home", home, "apply", "-f", str(manifest)])
+        err = capsys.readouterr().err
+        assert rc == 0
+        assert "routing through the running kfx server" in err
+        # The resource landed in the SERVER's store, not a divergent
+        # local one.
+        assert any(p.name == "routed-prof"
+                   for p in server.cp.store.list("Profile"))
+        rc = kfx_main(["--home", home, "delete", "profile", "routed-prof"])
+        assert rc == 0
+        assert not any(p.name == "routed-prof"
+                       for p in server.cp.store.list("Profile"))
