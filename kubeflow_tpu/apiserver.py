@@ -55,6 +55,35 @@ from .controlplane import ControlPlane
 from .core.store import AlreadyExists, Conflict, NotFound
 
 
+def prometheus_text(m: dict) -> str:
+    """Encode the metrics snapshot in Prometheus exposition format 0.0.4
+    (SURVEY.md §5.5: the reference's operators expose Prometheus-scrapable
+    text; JSON stays available via /metrics?format=json)."""
+    lines = [
+        "# HELP kfx_resources Number of stored resources by kind.",
+        "# TYPE kfx_resources gauge",
+    ]
+    for kind, n in sorted(m["resources"].items()):
+        lines.append(f'kfx_resources{{kind="{kind}"}} {n}')
+    for stat in ("depth", "delayed", "processing", "retrying"):
+        lines.append(
+            f"# HELP kfx_workqueue_{stat} Workqueue {stat} by controller.")
+        lines.append(f"# TYPE kfx_workqueue_{stat} gauge")
+        for kind, stats in sorted(m["controllers"].items()):
+            lines.append(
+                f'kfx_workqueue_{stat}{{controller="{kind}"}} '
+                f'{stats.get(stat, 0)}')
+    lines += [
+        "# HELP kfx_gangs Live process gangs.",
+        "# TYPE kfx_gangs gauge",
+        f"kfx_gangs {m['gangs']}",
+        "# HELP kfx_events_total Events recorded since startup.",
+        "# TYPE kfx_events_total counter",
+        f"kfx_events_total {m['events']}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "kfx-apiserver"
     protocol_version = "HTTP/1.1"
@@ -112,13 +141,23 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in url.path.split("/") if p]
         try:
             if url.path == "/healthz":
-                return self._text(200, "ok")
+                # X-Kfx-Home lets marker readers verify the responder
+                # actually owns the home they found the marker in (a
+                # stale marker + default-port reuse must not route one
+                # home's mutations into another's store).
+                return self._send(
+                    200, b"ok", "text/plain; charset=utf-8",
+                    {"X-Kfx-Home": os.path.realpath(self.cp.home)})
             if url.path == "/version":
                 from . import __version__
 
                 return self._json(200, {"version": __version__})
             if url.path == "/metrics":
-                return self._json(200, self._metrics())
+                if (q.get("format") or [""])[0] == "json":
+                    return self._json(200, self._metrics())
+                return self._send(
+                    200, prometheus_text(self._metrics()).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
             if parts == ["ui", "notebooks"]:
@@ -159,21 +198,20 @@ class _Handler(BaseHTTPRequestHandler):
         if len(parts) == 4 and parts[3] == "logs":
             ns, name = parts[1], parts[2]
             replica = (q.get("replica") or [""])[0]
-            offset = int((q.get("offset") or ["0"])[0])
+            try:
+                offset = int((q.get("offset") or ["0"])[0])
+            except ValueError:
+                return self._error(400, "offset must be an integer")
+            if offset < 0:
+                return self._error(400, "offset must be >= 0")
             # job_logs_from returns ("", offset) before the gang has
             # written anything — pollers between apply and launch get an
             # empty 200, never an aborted connection.
             text, new_off = self.cp.job_logs_from(
                 cls.KIND, name, ns, replica, offset)
-            body = text.encode()
-            self._drain()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.send_header("X-Kfx-Log-Offset", str(new_off))
-            self.end_headers()
-            self.wfile.write(body)
-            return None
+            return self._send(200, text.encode(),
+                              "text/plain; charset=utf-8",
+                              {"X-Kfx-Log-Offset": str(new_off)})
         return self._error(404, f"no route /apis/{'/'.join(parts)}")
 
     def do_POST(self):  # noqa: N802
@@ -561,10 +599,18 @@ class Client:
         return json.loads(self._call(path, **kw)[1])
 
     def healthy(self) -> bool:
+        return self.served_home() is not None
+
+    def served_home(self) -> Optional[str]:
+        """Canonical home path the responding server owns, or None if
+        unreachable (or an old server that predates the header)."""
         try:
-            return self._call("/healthz")[0] == 200
+            code, _, headers = self._call("/healthz")
         except Exception:
-            return False
+            return None
+        if code != 200:
+            return None
+        return headers.get("X-Kfx-Home")
 
     def apply_text(self, text: str) -> List[dict]:
         return self._json("/apis", data=text.encode(),
@@ -617,20 +663,45 @@ def write_server_marker(home: str, url: str) -> str:
 
 def live_server_url(home: str) -> Optional[str]:
     """URL of a live `kfx server` owning ``home``, else None (no marker,
-    or a stale one from a killed server)."""
+    or a stale one from a killed server). The responder must report this
+    very home: after a SIGKILL leaves a stale marker, a *different*
+    server reusing the same default port would otherwise answer the
+    health check and silently receive this home's mutations."""
     try:
         with open(os.path.join(home, SERVER_MARKER)) as f:
             info = json.load(f)
     except (OSError, ValueError):
         return None
     url = info.get("url")
-    if url and Client(url, timeout=2.0).healthy():
+    if not url:
+        return None
+    served = Client(url, timeout=2.0).served_home()
+    if served is not None and served == os.path.realpath(home):
         return url
     return None
 
 
 def serve_forever(home: Optional[str] = None, port: int = 8134) -> int:
-    with ControlPlane(home=home, journal=True) as cp:
+    # Two servers on one home would each run a full control plane over
+    # the same sqlite: the second would adopt Running jobs and spawn
+    # duplicate gangs next to their owner. Refuse while an owner lives.
+    import sys
+
+    from .controlplane import HomeBusy, resolve_home
+
+    # ControlPlane's home flock is the authoritative single-owner guard
+    # (atomic, kernel-released on any death, so no stale-lock problem);
+    # the marker liveness check only names the owner in the error.
+    try:
+        plane = ControlPlane(home=home, journal=True)
+    except HomeBusy:
+        owner = live_server_url(resolve_home(home))
+        at = f" at {owner}; use KFX_SERVER={owner} for client mode" \
+            if owner else ""
+        print(f"error: {resolve_home(home)} is already served by a live "
+              f"kfx process{at}", file=sys.stderr, flush=True)
+        return 1
+    with plane as cp:
         server = ApiServer(cp, port=port)
         marker = write_server_marker(cp.home, server.url)
         print(f"kfx apiserver + dashboard on {server.url} "
@@ -641,8 +712,21 @@ def serve_forever(home: Optional[str] = None, port: int = 8134) -> int:
             pass
         finally:
             server.httpd.server_close()
-            try:
-                os.unlink(marker)
-            except OSError:
-                pass
+            _unlink_own_marker(marker)
     return 0
+
+
+def _unlink_own_marker(marker: str) -> None:
+    """Remove the server marker only if it is still ours — a successor
+    that claimed the home must not have its advertisement deleted by
+    the predecessor's shutdown path."""
+    try:
+        with open(marker) as f:
+            if json.load(f).get("pid") != os.getpid():
+                return
+    except (OSError, ValueError):
+        return
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass

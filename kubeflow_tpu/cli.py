@@ -26,7 +26,7 @@ from typing import List, Optional
 
 from .api.base import Resource, resource_class
 from .api.training import TrainingJob
-from .controlplane import ControlPlane, default_home
+from .controlplane import ControlPlane, HomeBusy, default_home, resolve_home
 
 
 def _fmt_age(created: str) -> str:
@@ -413,7 +413,17 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
                            "delete", "kill-replica")
-    with ControlPlane(home=args.home, journal=True, passive=passive) as cp:
+    try:
+        plane = ControlPlane(home=args.home, journal=True, passive=passive)
+    except HomeBusy:
+        # Owner without a marker (e.g. another local `kfx run`, or a
+        # server that hasn't finished startup): refuse rather than
+        # reconcile the same sqlite twice.
+        print("error: this home's reconcile loops are owned by another "
+              "live kfx process; re-run when it exits, or start a "
+              "`kfx server` and use client mode", file=sys.stderr)
+        return 1
+    with plane as cp:
         cli = KfxCLI(cp)
         if args.cmd == "apply":
             if args.wait:
@@ -469,7 +479,7 @@ def _detect_server(home: Optional[str]) -> Optional[str]:
         from .apiserver import live_server_url
     except ImportError:
         return None
-    return live_server_url(os.path.abspath(home or default_home()))
+    return live_server_url(resolve_home(home))
 
 
 def _remote_main(args, url: Optional[str] = None) -> int:

@@ -26,6 +26,17 @@ def default_home() -> str:
         os.path.expanduser("~"), ".kfx")
 
 
+def resolve_home(home: Optional[str] = None) -> str:
+    """Single normalization for a home path. Every participant in the
+    single-owner protocol (flock, server marker, X-Kfx-Home comparison)
+    must resolve identically or the guard silently splits."""
+    return os.path.abspath(home or default_home())
+
+
+class HomeBusy(RuntimeError):
+    """Another live process owns this home's reconcile loops."""
+
+
 class ControlPlane:
     """Hosts the store and every registered controller.
 
@@ -43,8 +54,25 @@ class ControlPlane:
         # process on the same home cannot adopt Running jobs and spawn
         # duplicate gangs next to the process that owns them.
         self.passive = passive
-        self.home = os.path.abspath(home or default_home())
+        self.home = resolve_home(home)
         os.makedirs(self.home, exist_ok=True)
+        # Exactly one process may run reconcile loops over a home: two
+        # control planes on one sqlite would each adopt Running jobs and
+        # spawn duplicate gangs. The kernel releases the flock on any
+        # death, so a SIGKILLed owner never leaves a stale claim. Passive
+        # (read-only) planes skip it.
+        self._lock = None
+        if not passive:
+            import fcntl
+
+            lock = open(os.path.join(self.home, "server.lock"), "w")
+            try:
+                fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                lock.close()
+                raise HomeBusy(
+                    f"{self.home} is owned by another live kfx process")
+            self._lock = lock
         journal_path = os.path.join(self.home, "state.db") if journal else None
         self.store = ResourceStore(journal_path=journal_path)
         self.gangs = GangManager(os.path.join(self.home, "gangs"))
@@ -107,6 +135,9 @@ class ControlPlane:
         self.gangs.shutdown()
         self.observations.close()
         self.store.close()
+        if self._lock is not None:
+            self._lock.close()
+            self._lock = None
 
     def __enter__(self) -> "ControlPlane":
         return self.start()

@@ -38,7 +38,17 @@ RESTARTING = "Restarting"
 KILLED = "Killed"
 
 # k8s $(VAR) references in container command/args (expanded from env).
-_ENV_VAR_RE = re.compile(r"\$\(([A-Za-z_][A-Za-z0-9_]*)\)")
+# "$$" is the k8s escape and collapses to a literal "$", so "$$(VAR)"
+# yields the text "$(VAR)" without expansion (matched first, leftmost).
+_ENV_VAR_RE = re.compile(r"\$\$|\$\(([A-Za-z_][A-Za-z0-9_]*)\)")
+
+
+def expand_k8s_refs(text: str, env: Dict[str, str]) -> str:
+    """Kubernetes container command/args expansion: $(VAR) from env,
+    unresolved refs stay verbatim, $$ escapes to a literal $."""
+    return _ENV_VAR_RE.sub(
+        lambda m: "$" if m.group(0) == "$$"
+        else env.get(m.group(1), m.group(0)), text)
 
 
 # Exit codes considered retryable under restartPolicy=ExitCode (reference
@@ -202,11 +212,7 @@ class Gang:
                 env.update(overrides.get("*", {}))
                 env.update(overrides.get(spec.id, {}))
                 env[lifetime.PARENT_FD_ENV] = str(self._keepalive_r)
-                # k8s container semantics: $(VAR) in command/args expands
-                # from the container env; unresolved refs stay verbatim.
-                argv = [_ENV_VAR_RE.sub(
-                    lambda m: env.get(m.group(1), m.group(0)), a)
-                    for a in spec.argv]
+                argv = [expand_k8s_refs(a, env) for a in spec.argv]
                 logf = open(self.log_path(spec.id), "ab")
                 logf.write(
                     f"==== attempt {attempt} {time.strftime('%Y-%m-%dT%H:%M:%S')}"

@@ -2,6 +2,7 @@
 the dashboard-lite HTML views (SURVEY.md §2.2 centraldashboard row)."""
 
 import json
+import os
 import sys
 import urllib.error
 import urllib.request
@@ -64,7 +65,7 @@ class TestRestApi:
 
     def test_metrics(self, server):
         _req(f"{server.url}/apis", JOB.format(py=PY).encode())
-        st, body = _get(f"{server.url}/metrics")
+        st, body = _get(f"{server.url}/metrics?format=json")
         assert st == 200
         m = json.loads(body)
         assert m["resources"].get("JAXJob") == 1
@@ -72,6 +73,13 @@ class TestRestApi:
         assert set(m["controllers"]["JAXJob"]) == {
             "depth", "delayed", "processing", "retrying"}
         assert "gangs" in m and "events" in m
+        # default exposition is Prometheus text 0.0.4
+        st, body = _get(f"{server.url}/metrics")
+        assert st == 200
+        assert '# TYPE kfx_resources gauge' in body
+        assert 'kfx_resources{kind="JAXJob"} 1' in body
+        assert 'kfx_workqueue_depth{controller="JAXJob"}' in body
+        assert "kfx_events_total" in body
         _req(f"{server.url}/apis/jaxjob/default/api-job", method="DELETE")
 
     def test_apply_get_logs_events_delete(self, server):
@@ -112,6 +120,17 @@ class TestRestApi:
         _get(f"{server.url}/apis/nosuchkind", expect=404)
         _get(f"{server.url}/apis/jaxjob/default/ghost", expect=404)
         _get(f"{server.url}/nope", expect=404)
+        # malformed query param is the client's fault, not a 500
+        _req(f"{server.url}/apis", JOB.format(py=PY).encode())
+        st, body = _get(
+            f"{server.url}/apis/jaxjob/default/api-job/logs?offset=xyz",
+            expect=400)
+        assert st == 400 and "offset" in body
+        st, body = _get(
+            f"{server.url}/apis/jaxjob/default/api-job/logs?offset=-5",
+            expect=400)
+        assert st == 400 and "offset" in body
+        _req(f"{server.url}/apis/jaxjob/default/api-job", method="DELETE")
         # invalid manifest -> 400 with the validation message
         try:
             _req(f"{server.url}/apis", b"apiVersion: v1\nkind: JAXJob\n")
@@ -349,9 +368,6 @@ spec:
         assert "&lt;script&gt;" in page
 
 
-import os
-
-
 class TestOwnedHomeRouting:
     """A home owned by a live `kfx server` must not accept diverging
     local-mode mutations (round-2 advisor finding): the CLI detects the
@@ -361,22 +377,27 @@ class TestOwnedHomeRouting:
         from kubeflow_tpu.apiserver import (
             live_server_url, write_server_marker)
 
-        home = str(tmp_path / "owned")
-        os.makedirs(home)
+        home = server.cp.home
         write_server_marker(home, server.url)
         assert live_server_url(home) == server.url
+        # A marker in a DIFFERENT home pointing at this (live) server
+        # must read as no owner: a stale marker plus default-port reuse
+        # must never route one home's mutations into another's store.
+        other = str(tmp_path / "other-home")
+        os.makedirs(other)
+        write_server_marker(other, server.url)
+        assert live_server_url(other) is None
         # A stale marker (dead server) must read as no owner.
         write_server_marker(home, "http://127.0.0.1:1")
         assert live_server_url(home) is None
 
-    def test_local_delete_routes_through_owner(self, server, tmp_path,
-                                               capsys, monkeypatch):
+    def test_local_delete_routes_through_owner(self, server, capsys,
+                                               tmp_path, monkeypatch):
         from kubeflow_tpu.apiserver import write_server_marker
         from kubeflow_tpu.cli import main as kfx_main
 
         monkeypatch.delenv("KFX_SERVER", raising=False)
-        home = str(tmp_path / "owned")
-        os.makedirs(home)
+        home = server.cp.home
         write_server_marker(home, server.url)
 
         manifest = tmp_path / "isvc.yaml"
@@ -401,3 +422,69 @@ spec:
         assert rc == 0
         assert not any(p.name == "routed-prof"
                        for p in server.cp.store.list("Profile"))
+
+    def test_second_server_refuses_owned_home(self, server, capsys):
+        """Two control planes on one sqlite would spawn duplicate gangs;
+        the home flock (held by the fixture's live ControlPlane) makes
+        the claim atomic — no check-then-write race between starters."""
+        from kubeflow_tpu.apiserver import serve_forever, write_server_marker
+
+        write_server_marker(server.cp.home, server.url)
+        rc = serve_forever(home=server.cp.home, port=0)
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "already served" in err and server.url in err
+
+    def test_clean_shutdown_returns_zero_and_unlinks_marker(self, tmp_path):
+        """Success-path shutdown: SIGINT must exit 0, remove the marker,
+        and release the home for the next owner."""
+        import signal
+        import subprocess
+        import time
+
+        home = str(tmp_path / "srv-home")
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "from kubeflow_tpu.apiserver import serve_forever; "
+             f"raise SystemExit(serve_forever({home!r}, port=0))"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        deadline = time.monotonic() + 30
+        marker = os.path.join(home, "server.json")
+        while time.monotonic() < deadline and not os.path.exists(marker):
+            time.sleep(0.05)
+        assert os.path.exists(marker), proc.stdout
+        proc.send_signal(signal.SIGINT)
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+        assert not os.path.exists(marker)
+        from kubeflow_tpu.controlplane import ControlPlane
+        ControlPlane(home=home, passive=False,
+                     worker_platform="cpu").stop()
+
+    def test_home_flock_excludes_any_second_plane(self, server):
+        """The duplicate-gang hazard is not server-vs-server only: ANY
+        non-passive control plane (e.g. a local `kfx run`) must be
+        excluded while an owner lives. Passive (read-only) planes pass."""
+        from kubeflow_tpu.controlplane import ControlPlane, HomeBusy
+
+        with pytest.raises(HomeBusy):
+            ControlPlane(home=server.cp.home, worker_platform="cpu")
+        passive = ControlPlane(home=server.cp.home, passive=True)
+        passive.stop()
+
+    def test_shutdown_keeps_successor_marker(self, server, tmp_path):
+        """A predecessor's shutdown must not delete a marker that a
+        successor server has since written over it."""
+        import json as _json
+
+        from kubeflow_tpu.apiserver import _unlink_own_marker
+
+        marker = os.path.join(str(tmp_path), "server.json")
+        with open(marker, "w") as f:
+            _json.dump({"url": server.url, "pid": os.getpid() + 1}, f)
+        _unlink_own_marker(marker)
+        assert os.path.exists(marker)
+        with open(marker, "w") as f:
+            _json.dump({"url": server.url, "pid": os.getpid()}, f)
+        _unlink_own_marker(marker)
+        assert not os.path.exists(marker)

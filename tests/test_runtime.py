@@ -67,6 +67,25 @@ class TestRendezvousEnv:
         assert mpi_worker_env(1, 4)["OMPI_COMM_WORLD_RANK"] == "1"
 
 
+class TestArgvExpansion:
+    """k8s container command/args expansion semantics ($(VAR), $$ escape)."""
+
+    def test_expand_and_unresolved(self):
+        from kubeflow_tpu.runtime.gang import expand_k8s_refs
+        env = {"PORT": "8080"}
+        assert expand_k8s_refs("--port=$(PORT)", env) == "--port=8080"
+        assert expand_k8s_refs("$(MISSING)", env) == "$(MISSING)"
+
+    def test_double_dollar_escape(self):
+        from kubeflow_tpu.runtime.gang import expand_k8s_refs
+        env = {"PORT": "8080"}
+        # $$(VAR) is the k8s escape for a literal $(VAR), even when the
+        # var exists in the env.
+        assert expand_k8s_refs("$$(PORT)", env) == "$(PORT)"
+        assert expand_k8s_refs("a$$b", env) == "a$b"
+        assert expand_k8s_refs("$$$(PORT)", env) == "$8080"
+
+
 def specs_for(cmds):
     return [ProcessSpec(replica_type="Worker", index=i, argv=argv)
             for i, argv in enumerate(cmds)]
