@@ -8,6 +8,8 @@ Reference shapes (SURVEY.md §2.1): notebook-controller's ``Notebook`` CR
 
 from __future__ import annotations
 
+import re
+
 from typing import Any, Dict, List
 
 from .base import Resource, ValidationError, register
@@ -43,11 +45,75 @@ class Notebook(Resource):
         return int(self.metadata.annotations.get(
             "notebooks.kubeflow.org/idle-seconds", "0"))
 
+    def resource_requests(self) -> Dict[str, str]:
+        """containers[0].resources.requests (the web-app's CPU/RAM/
+        accelerator pickers land here, reference jupyter-web-app form)."""
+        return ((self.container().get("resources") or {})
+                .get("requests")) or {}
+
+    def volumes(self) -> List[Dict[str, Any]]:
+        return list((self.template().get("spec") or {})
+                    .get("volumes") or [])
+
+    def volume_mounts(self) -> List[Dict[str, Any]]:
+        return list(self.container().get("volumeMounts") or [])
+
     def validate(self) -> None:
         super().validate()
         if not self.argv():
             raise ValidationError(
                 "spec.template.spec.containers[0].command", "required")
+        # Quantities are parsed inside the reconcile loop (quota
+        # admission); reject garbage at apply time so a typo'd picker
+        # value is a 400, not a silent controller retry loop. Negative
+        # requests would offset the quota sum and bypass the cap.
+        for key, val in self.resource_requests().items():
+            try:
+                q = parse_quantity(val)
+            except (TypeError, ValueError):
+                raise ValidationError(
+                    f"spec...resources.requests.{key}",
+                    f"unparseable quantity {val!r}") from None
+            if q < 0:
+                raise ValidationError(
+                    f"spec...resources.requests.{key}",
+                    f"must be non-negative, got {val!r}")
+        # Claim names become host directory names under the home's
+        # volumes root; anything path-like would escape it.
+        for v in self.volumes():
+            claim = ((v.get("persistentVolumeClaim") or {})
+                     .get("claimName")) or v.get("name") or ""
+            if not _SAFE_NAME_RE.fullmatch(str(claim)):
+                raise ValidationError(
+                    "spec.template.spec.volumes",
+                    f"unsafe claim name {claim!r} (expected "
+                    f"[a-z0-9]([-a-z0-9.]*[a-z0-9])?)")
+
+
+# DNS-1123-subdomain-ish: what k8s accepts for claim names, and safe to
+# use as a single path component (no separators, no dot-dot, no leading
+# dot or dash).
+_SAFE_NAME_RE = re.compile(r"[a-z0-9]([-a-z0-9.]*[a-z0-9])?")
+
+_QUANTITY_SUFFIXES = (
+    ("Ki", 2 ** 10), ("Mi", 2 ** 20), ("Gi", 2 ** 30), ("Ti", 2 ** 40),
+    ("Pi", 2 ** 50), ("Ei", 2 ** 60),
+    ("k", 1e3), ("K", 1e3), ("M", 1e6), ("G", 1e9), ("T", 1e12),
+    ("P", 1e15), ("E", 1e18),
+)
+
+
+def parse_quantity(q) -> float:
+    """k8s resource-quantity parser for the subset quotas use: plain
+    numbers, milli-cpu ("500m"), and binary/decimal byte suffixes
+    ("2Gi", "500M")."""
+    s = str(q).strip()
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suf, mult in _QUANTITY_SUFFIXES:
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
 
 
 @register
@@ -71,6 +137,21 @@ class Profile(Resource):
         super().validate()
         if not self.owner().get("name"):
             raise ValidationError("spec.owner.name", "required")
+        # Quota limits are parsed inside admission checks at reconcile
+        # time; a malformed limit must be a 400 here, not a controller
+        # retry loop there.
+        for key, val in ((self.resource_quota().get("hard")) or {}).items():
+            try:
+                q = (float(int(val)) if key.startswith("count/")
+                     else parse_quantity(val))
+            except (TypeError, ValueError):
+                raise ValidationError(
+                    f"spec.resourceQuotaSpec.hard.{key}",
+                    f"unparseable quantity {val!r}") from None
+            if q < 0:
+                raise ValidationError(
+                    f"spec.resourceQuotaSpec.hard.{key}",
+                    f"must be non-negative, got {val!r}")
 
 
 @register

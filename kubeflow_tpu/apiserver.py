@@ -15,6 +15,17 @@ Server mode (`kfx server`) hosts a persistent ControlPlane behind:
   GET/POST/DELETE /kfam/v1/bindings manage a Profile's contributors;
   the profile controller folds them into status.bindings.
 
+Authorization (SURVEY.md §2.1 profile/kfam rows): the reference trusts
+Istio to inject `kubeflow-userid` and RBAC to enforce it; self-hosted,
+the apiserver is the enforcement point. Callers identify via
+`X-Kfx-User`. Writes into a profile-owned namespace (profile name ==
+namespace) require the profile owner or a contributor; binding and
+profile management require the owner or an admin-role contributor;
+namespaces without a Profile are unmanaged and open. Possession of the
+home's 0600 `admin.token` (sent as `X-Kfx-Admin-Token`) is
+cluster-admin — the kubectl-kubeconfig analogue used by local kfx
+invocations on the server's own box.
+
 Routes:
   GET    /healthz                                 liveness
   GET    /version
@@ -53,6 +64,49 @@ from .api.base import (
 from .api.manifest import load_manifests
 from .controlplane import ControlPlane
 from .core.store import AlreadyExists, Conflict, NotFound
+
+
+# Caller identity header — the kubeflow-userid analogue. The reference
+# trusts Istio to inject it and RBAC/kfam to enforce it (SURVEY.md §2.1
+# profile/kfam rows); in a self-hosted control plane the apiserver is
+# both the injection boundary and the enforcement point.
+USER_HEADER = "X-Kfx-User"
+ADMIN_HEADER = "X-Kfx-Admin-Token"
+ADMIN_TOKEN_FILE = "admin.token"
+
+
+class Forbidden(Exception):
+    """Caller identity lacks the required binding (HTTP 403)."""
+
+
+def write_admin_token(home: str) -> str:
+    """Mint (or reuse) the home's admin bearer token, mode 0600. Anyone
+    who can read the home dir already owns the sqlite and the gangs, so
+    file possession == cluster-admin; the token merely extends that
+    fact across the HTTP boundary."""
+    import secrets
+
+    path = os.path.join(home, ADMIN_TOKEN_FILE)
+    try:
+        with open(path) as f:
+            tok = f.read().strip()
+        if tok:
+            return tok
+    except OSError:
+        pass
+    tok = secrets.token_hex(16)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(tok)
+    return tok
+
+
+def read_admin_token(home: str) -> Optional[str]:
+    try:
+        with open(os.path.join(home, ADMIN_TOKEN_FILE)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
 
 
 def prometheus_text(m: dict) -> str:
@@ -221,16 +275,27 @@ class _Handler(BaseHTTPRequestHandler):
         self._body_consumed = True
         try:
             if url.path == "/apis":
-                applied = self.cp.apply(load_manifests(text))
+                resources = load_manifests(text)
+                self._authorize_apply(resources)
+                applied = self.cp.apply(resources)
                 return self._json(200, {"applied": [
                     {"kind": o.KIND, "name": o.name,
                      "namespace": o.namespace, "verb": verb}
                     for o, verb in applied]})
             if url.path == "/ui/notebooks":
-                return self._notebooks_form(parse_qs(text))
+                form = parse_qs(text)
+                self._authorize_write(
+                    (form.get("namespace") or ["default"])[0])
+                return self._notebooks_form(form)
             if url.path == "/kfam/v1/bindings":
-                return self._kfam_post(json.loads(text))
+                body = json.loads(text)
+                ns = body.get("namespace") or body.get("referredNamespace")
+                if ns:
+                    self._authorize_admin(ns)
+                return self._kfam_post(body)
             return self._error(404, f"no route {url.path}")
+        except Forbidden as e:
+            return self._error(403, str(e))
         except NotFound as e:
             return self._error(404, str(e))
         except (ValidationError, Conflict, AlreadyExists,
@@ -247,11 +312,19 @@ class _Handler(BaseHTTPRequestHandler):
                 q = parse_qs(url.query)
                 ns = (q.get("namespace") or [""])[0]
                 user = (q.get("user") or [""])[0]
+                if ns:
+                    self._authorize_admin(ns)
                 return self._kfam_delete(ns, user)
             if len(parts) != 4 or parts[0] != "apis":
                 return self._error(404, f"no route {self.path}")
             cls = resource_class(parts[1])
+            if cls.KIND == "Profile":
+                self._authorize_admin(parts[3])
+            else:
+                self._authorize_write(parts[2])
             self.cp.store.delete(cls.KIND, parts[3], parts[2])
+        except Forbidden as e:
+            return self._error(403, str(e))
         except (NotFound, KeyError) as e:
             return self._error(404, str(e.args[0] if e.args else e))
         except Exception as e:
@@ -274,6 +347,77 @@ class _Handler(BaseHTTPRequestHandler):
                 "controllers": controllers,
                 "gangs": self.cp.gangs.count(),
                 "events": self.cp.store.event_count()}
+
+    # -- authorization ------------------------------------------------------
+    def _caller(self) -> str:
+        return self.headers.get(USER_HEADER, "")
+
+    def _is_admin(self) -> bool:
+        import hmac
+
+        tok = self.headers.get(ADMIN_HEADER, "")
+        ref = getattr(self.server, "admin_token", None)
+        return bool(tok and ref and hmac.compare_digest(tok, ref))
+
+    def _profile_for(self, namespace: str):
+        """The Profile owning ``namespace`` (profile name == namespace),
+        or None for an unmanaged namespace."""
+        return self.cp.store.try_get("Profile", namespace)
+
+    def _authorize(self, namespace: str, admin: bool = False) -> None:
+        """Gate a write into ``namespace``. Unmanaged namespaces (no
+        Profile; reference parity: no Istio AuthorizationPolicy was
+        stamped) and admin-token callers pass. Otherwise the caller
+        must be the profile owner, or a contributor — any role for
+        plain writes, the ``admin`` role for access management
+        (``admin=True``): edit-role contributors run workloads, they
+        do not grant access."""
+        prof = self._profile_for(namespace)
+        if prof is None or self._is_admin():
+            return
+        user = self._caller()
+        if prof.owner().get("name") == user:
+            return
+        if user and any(c.get("name") == user and
+                        (not admin or c.get("role") == "admin")
+                        for c in prof.contributors()):
+            return
+        who = f"user {user!r}" if user else "anonymous caller"
+        if admin:
+            raise Forbidden(f"{who} is not the owner or an admin of "
+                            f"profile {namespace!r}")
+        raise Forbidden(
+            f"{who} is not the owner or a contributor of profile-owned "
+            f"namespace {namespace!r} (bind via POST /kfam/v1/bindings)")
+
+    def _authorize_write(self, namespace: str) -> None:
+        self._authorize(namespace)
+
+    def _authorize_admin(self, namespace: str) -> None:
+        self._authorize(namespace, admin=True)
+
+    def _authorize_apply(self, resources) -> None:
+        for obj in resources:
+            if obj.KIND == "Profile":
+                # Creating a new profile is self-service registration —
+                # but only over an EMPTY namespace: claiming one that
+                # already holds other users' resources would lock them
+                # out (namespace seizure). Mutating an existing profile
+                # is access management.
+                if self.cp.store.try_get("Profile", obj.name) is not None:
+                    self._authorize_admin(obj.name)
+                elif self._namespace_in_use(obj.name) and \
+                        not self._is_admin():
+                    raise Forbidden(
+                        f"namespace {obj.name!r} already holds resources;"
+                        f" claiming it as a profile requires the admin "
+                        f"token")
+            else:
+                self._authorize_write(obj.namespace)
+
+    def _namespace_in_use(self, namespace: str) -> bool:
+        return any(self.cp.store.list(kind, namespace)
+                   for kind in registered_kinds())
 
     # -- kfam (access management, SURVEY.md §2.1) ---------------------------
     def _kfam_list(self, namespace: Optional[str]) -> List[dict]:
@@ -400,7 +544,17 @@ class _Handler(BaseHTTPRequestHandler):
         table = ("<table><tr><th>name</th><th>namespace</th><th>state</th>"
                  "<th>url</th><th></th></tr>" + "".join(rows) + "</table>"
                  if rows else "<p>no notebooks yet.</p>")
-        form = """
+        pd_rows = []
+        for pd in self.cp.store.list("PodDefault"):
+            val = html.escape(f"{pd.namespace}/{pd.name}")
+            desc = html.escape(pd.spec.get("desc") or pd.name)
+            pd_rows.append(
+                f"<label><input type='checkbox' name='poddefault' "
+                f"value='{val}'> {desc} "
+                f"<small>({html.escape(pd.namespace)})</small></label><br>")
+        pd_section = ("".join(pd_rows)
+                      if pd_rows else "<small>none defined</small>")
+        form = f"""
         <h2>spawn a notebook</h2>
         <form method='post' action='/ui/notebooks'>
         <input type='hidden' name='action' value='create'>
@@ -413,6 +567,19 @@ class _Handler(BaseHTTPRequestHandler):
             </td></tr>
         <tr><td>image label</td>
             <td><input name='image' value='kfx/notebook:latest'></td></tr>
+        <tr><td>CPU request</td>
+            <td><input name='cpu' value='1' size='8'></td></tr>
+        <tr><td>memory request</td>
+            <td><input name='memory' value='1Gi' size='8'></td></tr>
+        <tr><td>accelerator chips</td>
+            <td><input name='accelerator' value='0' size='8'></td></tr>
+        <tr><td>workspace volume</td>
+            <td><input name='workspace' placeholder='{{name}}-workspace'>
+            </td></tr>
+        <tr><td>data volumes</td>
+            <td><input name='datavols' size='40'
+                 placeholder='claim1, claim2'></td></tr>
+        <tr><td>configurations</td><td>{pd_section}</td></tr>
         <tr><td>idle cull (s)</td>
             <td><input name='idle' value='0'></td></tr>
         </table>
@@ -430,19 +597,65 @@ class _Handler(BaseHTTPRequestHandler):
                 f"deleted {ns}/{name}"))
         import shlex
 
+        container = {
+            "name": "notebook",
+            "image": get("image", "kfx/notebook:latest"),
+            "command": shlex.split(get("command")),
+        }
+        # Resource pickers (reference jupyter-web-app form): requests
+        # feed the profile quota admission; the accelerator count is the
+        # GPU-picker analogue (TPU chips).
+        requests = {}
+        if get("cpu"):
+            requests["cpu"] = get("cpu")
+        if get("memory"):
+            requests["memory"] = get("memory")
+        if get("accelerator") and get("accelerator") != "0":
+            requests["kubeflow.org/tpu"] = get("accelerator")
+        if requests:
+            container["resources"] = {"requests": requests}
+        # Volume pickers: workspace + data claims become pvc-backed
+        # volumes the controller maps to durable per-claim directories.
+        claims = []
+        if get("workspace"):
+            claims.append(get("workspace"))
+        claims += [c.strip() for c in get("datavols").split(",")
+                   if c.strip()]
+        volumes, mounts = [], []
+        for i, claim in enumerate(claims):
+            vname = f"vol-{i}"
+            volumes.append({"name": vname,
+                            "persistentVolumeClaim": {"claimName": claim}})
+            mounts.append({"name": vname, "mountPath": f"/mnt/{claim}"})
+        if mounts:
+            container["volumeMounts"] = mounts
+        # Configuration (PodDefault) selection: adopt each chosen
+        # PodDefault's selector labels so its admission match fires.
+        labels = {}
+        for ref in form.get("poddefault") or []:
+            pd_ns, _, pd_name = ref.partition("/")
+            if pd_ns != ns:
+                # Silently dropping a selected configuration would
+                # spawn without the credential the user asked for.
+                return self._error(
+                    400, f"PodDefault {ref!r} is in namespace "
+                    f"{pd_ns!r}, not the notebook's {ns!r}")
+            pd = self.cp.store.try_get("PodDefault", pd_name, pd_ns)
+            if pd is not None:
+                labels.update(pd.selector())
         manifest = {
             "apiVersion": "kubeflow.org/v1",
             "kind": "Notebook",
             "metadata": {
                 "name": name, "namespace": ns,
+                "labels": labels,
                 "annotations": {"notebooks.kubeflow.org/idle-seconds":
                                 get("idle", "0")},
             },
-            "spec": {"template": {"spec": {"containers": [{
-                "name": "notebook",
-                "image": get("image", "kfx/notebook:latest"),
-                "command": shlex.split(get("command")),
-            }]}}},
+            "spec": {"template": {"spec": {
+                "containers": [container],
+                **({"volumes": volumes} if volumes else {}),
+            }}},
         }
         from .api.base import from_manifest
 
@@ -530,6 +743,12 @@ class ApiServer:
         self.cp = cp
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.cp = cp  # type: ignore[attr-defined]
+        # Possession of the home's admin token (0600 file) is
+        # cluster-admin — the kubectl-kubeconfig analogue. Local kfx
+        # invocations on the same box read it and bypass kfam checks;
+        # plain HTTP callers are subject to them.
+        self.admin_token = write_admin_token(cp.home)
+        self.httpd.admin_token = self.admin_token  # type: ignore
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -573,9 +792,15 @@ class Client:
     becomes when ``KFX_SERVER`` points at a running `kfx server` (the
     kubectl model: state and gangs live in the server process)."""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 user: Optional[str] = None,
+                 admin_token: Optional[str] = None):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        # Caller identity for profile-owned namespaces (KFX_USER is the
+        # kubeflow-userid analogue the reference gets from Istio).
+        self.user = user if user is not None else os.environ.get("KFX_USER")
+        self.admin_token = admin_token
 
     def _call(self, path: str, data: Optional[bytes] = None,
               method: str = "GET") -> Tuple[int, str, dict]:
@@ -584,6 +809,10 @@ class Client:
 
         req = urllib.request.Request(self.base + path, data=data,
                                      method=method)
+        if self.user:
+            req.add_header(USER_HEADER, self.user)
+        if self.admin_token:
+            req.add_header(ADMIN_HEADER, self.admin_token)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return r.status, r.read().decode(), dict(r.headers)

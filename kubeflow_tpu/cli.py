@@ -488,10 +488,21 @@ def _remote_main(args, url: Optional[str] = None) -> int:
     (the kubectl model — see apiserver)."""
     import urllib.error
 
-    from .apiserver import ApiError, Client
+    from .apiserver import ApiError, Client, read_admin_token
 
     url = url or os.environ["KFX_SERVER"]
-    client = Client(url)
+    # Local possession of the home's 0600 token file == cluster-admin —
+    # but only toward the server that OWNS this home. Sending it to an
+    # arbitrary KFX_SERVER would hand the credential to whoever runs
+    # that endpoint (cleartext HTTP), so verify ownership first.
+    home = resolve_home(getattr(args, "home", None))
+    token = read_admin_token(home)
+    # served_home() reports realpath — compare like for like, or a
+    # symlinked home would silently drop the owner's own credential.
+    if token and Client(url, timeout=2.0).served_home() != \
+            os.path.realpath(home):
+        token = None
+    client = Client(url, admin_token=token)
     try:
         return _remote_dispatch(client, args)
     except ApiError as e:

@@ -112,7 +112,7 @@ class ControlPlane:
         for ctrl in platform_controllers(self.store, self.gangs):
             self.manager.register(ctrl)
         # Wire quota + PodDefault admission into every workload controller.
-        admission = PlatformAdmission(self.store)
+        admission = PlatformAdmission(self.store, self.gangs)
         for ctrl in self.manager.controllers.values():
             if hasattr(ctrl, "admission"):
                 ctrl.admission = admission

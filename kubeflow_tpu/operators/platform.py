@@ -34,6 +34,7 @@ from ..api.platform import (
     Notebook,
     PodDefault,
     Profile,
+    parse_quantity,
 )
 from ..api.training import JOB_QUEUED, TrainingJob
 from ..core.controller import Controller, Result
@@ -53,8 +54,10 @@ class PlatformAdmission:
     installs the quota; SURVEY §2.1) and the PodDefault mutating webhook.
     """
 
-    def __init__(self, store: ResourceStore):
+    def __init__(self, store: ResourceStore,
+                 gangs: Optional[G.GangManager] = None):
         self.store = store
+        self.gangs = gangs
 
     # -- quota (profile-controller / ResourceQuota parity) ------------------
     def check_job(self, job: TrainingJob) -> Optional[str]:
@@ -91,6 +94,50 @@ class PlatformAdmission:
             return (f"profile {profile.name}: count/replicas={max_replicas} "
                     f"exhausted ({replicas} active + "
                     f"{job.total_replicas()} requested)")
+        return None
+
+    def check_notebook(self, nb: Notebook) -> Optional[str]:
+        """Quota admission for notebooks: ``count/notebooks`` plus the
+        ``requests.cpu`` / ``requests.memory`` sums the web-app pickers
+        feed (reference: ResourceQuota rejects the StatefulSet's pod)."""
+        profile = self.store.try_get("Profile", nb.namespace)
+        if not isinstance(profile, Profile):
+            return None
+        hard = (profile.resource_quota().get("hard")) or {}
+        watched = {k: hard.get(k) for k in
+                   ("count/notebooks", "requests.cpu", "requests.memory")
+                   if hard.get(k) is not None}
+        if not watched:
+            return None
+        count, cpu, mem = 1, parse_quantity(
+            nb.resource_requests().get("cpu", 0)), parse_quantity(
+            nb.resource_requests().get("memory", 0))
+        for other in self.store.list("Notebook", namespace=nb.namespace):
+            assert isinstance(other, Notebook)
+            if other.name == nb.name or other.has_condition(NOTEBOOK_CULLED):
+                continue
+            # Only notebooks that actually hold a gang charge quota:
+            # counting pending ones would let two notebooks applied
+            # together deny each other forever over free capacity.
+            if self.gangs is not None and \
+                    self.gangs.get(f"notebook/{other.key}") is None:
+                continue
+            count += 1
+            req = other.resource_requests()
+            cpu += parse_quantity(req.get("cpu", 0))
+            mem += parse_quantity(req.get("memory", 0))
+        limit = watched.get("count/notebooks")
+        if limit is not None and count > int(limit):
+            return (f"profile {profile.name}: count/notebooks={limit} "
+                    f"exhausted")
+        limit = watched.get("requests.cpu")
+        if limit is not None and cpu > parse_quantity(limit):
+            return (f"profile {profile.name}: requests.cpu={limit} "
+                    f"exhausted ({cpu:g} requested)")
+        limit = watched.get("requests.memory")
+        if limit is not None and mem > parse_quantity(limit):
+            return (f"profile {profile.name}: requests.memory={limit} "
+                    f"exhausted")
         return None
 
     # -- PodDefault injection (admission-webhook parity) --------------------
@@ -154,6 +201,20 @@ class NotebookController(Controller):
 
         gang = self.gangs.get(gkey)
         if gang is None:
+            if self.admission is not None:
+                denial = self.admission.check_notebook(nb)
+                if denial:
+                    from ..api.base import get_condition
+
+                    cur = get_condition(nb.conditions, NOTEBOOK_READY)
+                    if cur is None or (cur.reason, cur.message) != \
+                            ("QuotaExceeded", denial):
+                        nb.set_condition(NOTEBOOK_READY, "False",
+                                         "QuotaExceeded", denial)
+                        self._update_status(nb)
+                        self.record_event(nb, "Warning", "QuotaExceeded",
+                                          denial)
+                    return Result(requeue=True, requeue_after=1.0)
             gang = self._create_gang(nb, gkey, int(port))
             self.record_event(nb, "Normal", "NotebookStarted",
                               f"serving on {nb.status.get('url')}")
@@ -176,6 +237,41 @@ class NotebookController(Controller):
             self._maybe_cull(nb, gang, gkey)
         return None
 
+    def _volume_env(self, nb: Notebook) -> Dict[str, str]:
+        """Resolve the notebook's pvc-backed volumes to durable host
+        directories (reference: the StatefulSet mounts the claims; a
+        local process gets them as env paths that survive restarts and
+        culls — ``KFX_VOLUME_<NAME>`` per mount, ``KFX_WORKSPACE`` for
+        the first, and ``KFX_PVC_ROOT`` so ``pvc://claim/...`` URIs in
+        serving resolve to the same data)."""
+        import re as _re
+
+        vols = {v.get("name"): v for v in nb.volumes()}
+        root = os.path.join(os.path.dirname(self.gangs.base_workdir),
+                            "volumes", nb.namespace)
+        env: Dict[str, str] = {}
+        for m in nb.volume_mounts():
+            v = vols.get(m.get("name"))
+            if v is None:
+                continue
+            claim = ((v.get("persistentVolumeClaim") or {})
+                     .get("claimName")) or v.get("name")
+            # Belt-and-braces with Notebook.validate(): a claim name is
+            # one safe path component, never a traversal.
+            from ..api.platform import _SAFE_NAME_RE
+
+            if not _SAFE_NAME_RE.fullmatch(str(claim)):
+                continue
+            path = os.path.join(root, claim)
+            os.makedirs(path, exist_ok=True)
+            key = "KFX_VOLUME_" + _re.sub(
+                r"[^A-Za-z0-9]", "_", str(m.get("name", ""))).upper()
+            env[key] = path
+            env.setdefault("KFX_WORKSPACE", path)
+        if env:
+            env["KFX_PVC_ROOT"] = root
+        return env
+
     def _create_gang(self, nb: Notebook, gkey: str, port: int) -> G.Gang:
         ctrl, key = self, nb.key
 
@@ -186,6 +282,7 @@ class NotebookController(Controller):
             env = {str(e.get("name")): str(e.get("value"))
                    for e in (nb.container().get("env") or [])}
             env["KFX_NOTEBOOK_PORT"] = str(port)
+            env.update(ctrl._volume_env(nb))
             inject_pythonpath(env)
             specs = [G.ProcessSpec(replica_type="Notebook", index=0,
                                    argv=argv, env=env)]

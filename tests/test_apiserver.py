@@ -48,8 +48,9 @@ def _get(url, expect=200):
         return e.code, e.read().decode()
 
 
-def _req(url, data=None, method="POST"):
-    req = urllib.request.Request(url, data=data, method=method)
+def _req(url, data=None, method="POST", headers=None):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
     with urllib.request.urlopen(req, timeout=30) as r:
         return r.status, r.read().decode()
 
@@ -217,6 +218,71 @@ class TestNotebookSpawner:
         assert st == 200 and "deleted default/web-nb" in page
         _get(f"{server.url}/apis/notebook/default/web-nb", expect=404)
 
+    def test_spawn_with_pickers(self, server):
+        """Reference form parity: resource requests, workspace/data
+        volumes, and PodDefault (configurations) selection at spawn
+        time all round-trip into the Notebook and its process env."""
+        import time
+        import urllib.parse
+
+        pd = """
+apiVersion: kubeflow.org/v1
+kind: PodDefault
+metadata:
+  name: add-secret
+  namespace: default
+spec:
+  desc: Inject test credential
+  selector:
+    matchLabels:
+      add-secret: "true"
+  env:
+  - name: MY_SECRET
+    value: s3cr3t
+"""
+        _req(f"{server.url}/apis", pd.encode())
+        st, page = _get(f"{server.url}/ui/notebooks")
+        assert "Inject test credential" in page  # picker is offered
+
+        dump = ("import os,json;open(os.environ['KFX_WORKSPACE']+"
+                "'/env.json','w').write(json.dumps(dict(os.environ)))")
+        form = urllib.parse.urlencode({
+            "action": "create", "name": "rich-nb", "namespace": "default",
+            "command": f"{PY} -c \"{dump}\"",
+            "cpu": "2", "memory": "1Gi", "accelerator": "4",
+            "workspace": "nb-ws", "datavols": "shared-data",
+            "poddefault": "default/add-secret", "idle": "0"})
+        st, page = _req(f"{server.url}/ui/notebooks", form.encode())
+        assert st == 200 and "created default/rich-nb" in page
+
+        st, body = _get(f"{server.url}/apis/notebook/default/rich-nb")
+        obj = json.loads(body)
+        c = obj["spec"]["template"]["spec"]["containers"][0]
+        assert c["resources"]["requests"] == {
+            "cpu": "2", "memory": "1Gi", "kubeflow.org/tpu": "4"}
+        claims = [v["persistentVolumeClaim"]["claimName"]
+                  for v in obj["spec"]["template"]["spec"]["volumes"]]
+        assert claims == ["nb-ws", "shared-data"]
+        assert obj["metadata"]["labels"] == {"add-secret": "true"}
+
+        env_file = os.path.join(server.cp.home, "volumes", "default",
+                                "nb-ws", "env.json")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not os.path.exists(env_file):
+            time.sleep(0.2)
+        assert os.path.exists(env_file), "notebook never wrote workspace"
+        env = json.loads(open(env_file).read())
+        assert env["MY_SECRET"] == "s3cr3t"  # PodDefault injected
+        assert env["KFX_VOLUME_VOL_0"].endswith("nb-ws")
+        assert env["KFX_VOLUME_VOL_1"].endswith("shared-data")
+        assert env["KFX_PVC_ROOT"].endswith(
+            os.path.join("volumes", "default"))
+        form = urllib.parse.urlencode({
+            "action": "delete", "name": "rich-nb", "namespace": "default"})
+        _req(f"{server.url}/ui/notebooks", form.encode())
+        # The volume is durable: deleting the notebook keeps its data.
+        assert os.path.exists(env_file)
+
 
 class TestKfam:
     def test_binding_lifecycle(self, server):
@@ -242,9 +308,10 @@ spec:
             time.sleep(0.2)
         assert [b["user"] for b in bindings] == ["alice@example.com"]
 
+        alice = {"X-Kfx-User": "alice@example.com"}
         st, _ = _req(f"{server.url}/kfam/v1/bindings", json.dumps(
             {"namespace": "team-z", "user": "bob@example.com",
-             "role": "edit"}).encode())
+             "role": "edit"}).encode(), headers=alice)
         assert st == 200
         while time.monotonic() < deadline:
             _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
@@ -255,7 +322,8 @@ spec:
         assert sorted(users) == ["alice@example.com", "bob@example.com"]
 
         st, _ = _req(f"{server.url}/kfam/v1/bindings?namespace=team-z"
-                     f"&user=bob@example.com", method="DELETE")
+                     f"&user=bob@example.com", method="DELETE",
+                     headers=alice)
         assert st == 200
         while time.monotonic() < deadline:
             _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
@@ -267,10 +335,156 @@ spec:
         # removing a non-binding 404s
         try:
             _req(f"{server.url}/kfam/v1/bindings?namespace=team-z"
-                 f"&user=ghost@example.com", method="DELETE")
+                 f"&user=ghost@example.com", method="DELETE",
+                 headers=alice)
             raise AssertionError("expected 404")
         except urllib.error.HTTPError as e:
             assert e.code == 404
+
+
+NS_JOB = """
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata:
+  name: {name}
+  namespace: team-q
+spec:
+  runPolicy:
+    suspend: true
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+          - name: main
+            command: ["true"]
+"""
+
+
+class TestAuthz:
+    """kfam bindings are ENFORCED at the apiserver (SURVEY.md §2.1
+    profile/kfam rows): in a self-hosted control plane there is no Istio
+    in front, so the apiserver is the enforcement point. Writes into a
+    profile-owned namespace need the owner, a contributor, or the
+    home's admin token; binding management needs owner/admin."""
+
+    @pytest.fixture()
+    def owned_ns(self, server):
+        profile = """
+apiVersion: kubeflow.org/v1
+kind: Profile
+metadata:
+  name: team-q
+spec:
+  owner:
+    kind: User
+    name: alice@example.com
+"""
+        _req(f"{server.url}/apis", profile.encode())
+        return "team-q"
+
+    def _apply(self, server, name, user=None, expect=200):
+        headers = {"X-Kfx-User": user} if user else {}
+        try:
+            st, _ = _req(f"{server.url}/apis",
+                         NS_JOB.format(name=name).encode(),
+                         headers=headers)
+        except urllib.error.HTTPError as e:
+            st = e.code
+            assert st == expect, e.read().decode()
+        assert st == expect
+
+    def test_write_enforcement_lifecycle(self, server, owned_ns):
+        # Anonymous and unbound users are refused; the owner passes.
+        self._apply(server, "j1", user=None, expect=403)
+        self._apply(server, "j1", user="mallory@example.com", expect=403)
+        self._apply(server, "j1", user="alice@example.com", expect=200)
+        # Unbound bob is 403 until alice binds him through kfam.
+        self._apply(server, "j2", user="bob@example.com", expect=403)
+        st, _ = _req(f"{server.url}/kfam/v1/bindings", json.dumps(
+            {"namespace": owned_ns, "user": "bob@example.com",
+             "role": "edit"}).encode(),
+            headers={"X-Kfx-User": "alice@example.com"})
+        assert st == 200
+        self._apply(server, "j2", user="bob@example.com", expect=200)
+        # Deletes are writes too.
+        try:
+            _req(f"{server.url}/apis/jaxjob/team-q/j1", method="DELETE")
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        st, _ = _req(f"{server.url}/apis/jaxjob/team-q/j1",
+                     method="DELETE",
+                     headers={"X-Kfx-User": "bob@example.com"})
+        assert st == 200
+
+    def test_binding_management_needs_admin_role(self, server, owned_ns):
+        # edit-role bob cannot grant access; admin-role carol can.
+        bind = lambda who, target, role="edit": _req(
+            f"{server.url}/kfam/v1/bindings", json.dumps(
+                {"namespace": owned_ns, "user": target,
+                 "role": role}).encode(),
+            headers={"X-Kfx-User": who})
+        assert bind("alice@example.com", "bob@example.com")[0] == 200
+        assert bind("alice@example.com", "carol@example.com",
+                    "admin")[0] == 200
+        try:
+            bind("bob@example.com", "eve@example.com")
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        assert bind("carol@example.com", "dave@example.com")[0] == 200
+        # Profile mutation/deletion is admin-surface as well.
+        try:
+            _req(f"{server.url}/apis/profile/default/team-q",
+                 method="DELETE",
+                 headers={"X-Kfx-User": "bob@example.com"})
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+
+    def test_unmanaged_namespace_stays_open(self, server):
+        _req(f"{server.url}/apis", JOB.format(py=PY).encode())
+        _req(f"{server.url}/apis/jaxjob/default/api-job", method="DELETE")
+
+    def test_profile_cannot_seize_inhabited_namespace(self, server):
+        """An anonymous caller must not claim an unmanaged namespace that
+        already holds other users' resources (it would 403 them all)."""
+        job = NS_JOB.format(name="squat").replace("team-q", "grab-me")
+        _req(f"{server.url}/apis", job.encode())
+        seize = """
+apiVersion: kubeflow.org/v1
+kind: Profile
+metadata:
+  name: grab-me
+spec:
+  owner:
+    name: mallory@example.com
+"""
+        try:
+            _req(f"{server.url}/apis", seize.encode())
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403 and "already holds" in e.read().decode()
+        # An empty namespace stays self-service.
+        fresh = seize.replace("grab-me", "fresh-ns")
+        st, _ = _req(f"{server.url}/apis", fresh.encode())
+        assert st == 200
+
+    def test_admin_token_bypasses(self, server, owned_ns):
+        tok = server.admin_token
+        st, _ = _req(f"{server.url}/apis",
+                     NS_JOB.format(name="j3").encode(),
+                     headers={"X-Kfx-Admin-Token": tok})
+        assert st == 200
+        # A wrong token is just an unauthenticated caller.
+        try:
+            _req(f"{server.url}/apis", NS_JOB.format(name="j4").encode(),
+                 headers={"X-Kfx-Admin-Token": "nope"})
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
 
 
 class TestDashboard:

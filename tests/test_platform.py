@@ -188,6 +188,96 @@ class TestProfile:
                   if e.reason == "QuotaExceeded"]
         assert events, "expected a QuotaExceeded event"
 
+    def test_notebook_quota_denies_then_admits(self, cp):
+        """The web-app's resource pickers feed requests.cpu; the profile
+        quota must hold notebooks to it just as ResourceQuota holds the
+        reference's notebook pods."""
+        cp.apply([_profile("team-n", quota={"requests.cpu": "2"})])
+        nb1 = _notebook("n1", ["sleep", "600"], ns="team-n", ports=False)
+        nb1.spec["template"]["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "1500m"}}
+        nb2 = _notebook("n2", ["sleep", "600"], ns="team-n", ports=False)
+        nb2.spec["template"]["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "1"}}
+        cp.apply([nb1])
+        _wait(lambda: cp.gangs.get("notebook/team-n/n1") is not None,
+              what="n1 started")
+        cp.apply([nb2])
+        _wait(lambda: any(
+            e.reason == "QuotaExceeded"
+            for e in cp.store.events_for("Notebook", "team-n/n2")),
+            what="n2 denied on cpu quota")
+        assert cp.gangs.get("notebook/team-n/n2") is None
+        # Freeing capacity admits the waiting notebook.
+        cp.store.delete("Notebook", "n1", "team-n")
+        _wait(lambda: cp.gangs.get("notebook/team-n/n2") is not None,
+              what="n2 admitted after n1 deleted", timeout=15)
+
+    def test_pending_notebooks_do_not_mutually_deny(self, cp):
+        """Regression: quota must charge only notebooks that hold a
+        gang — two notebooks applied together must not each count the
+        other's pending resource and deadlock over free capacity."""
+        cp.apply([_profile("team-m", quota={"requests.cpu": "2"})])
+        nbs = []
+        for n in ("m1", "m2"):
+            nb = _notebook(n, ["sleep", "600"], ns="team-m", ports=False)
+            nb.spec["template"]["spec"]["containers"][0]["resources"] = {
+                "requests": {"cpu": "1500m"}}
+            nbs.append(nb)
+        cp.apply(nbs)
+        # Exactly one must start (capacity fits one), not zero.
+        _wait(lambda: sum(
+            cp.gangs.get(f"notebook/team-m/{n}") is not None
+            for n in ("m1", "m2")) == 1, what="one of two admitted")
+        started = "m1" if cp.gangs.get("notebook/team-m/m1") else "m2"
+        other = "m2" if started == "m1" else "m1"
+        cp.store.delete("Notebook", started, "team-m")
+        _wait(lambda: cp.gangs.get(f"notebook/team-m/{other}") is not None,
+              what="second admitted after first deleted", timeout=15)
+
+    def test_unparseable_quantity_rejected_at_apply(self, cp):
+        from kubeflow_tpu.api.base import ValidationError
+
+        nb = _notebook("bad", ["sleep", "1"], ports=False)
+        nb.spec["template"]["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "two"}}
+        with pytest.raises(ValidationError):
+            cp.apply([nb])
+        nb.spec["template"]["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "-100"}}  # negative offsets the quota sum
+        with pytest.raises(ValidationError):
+            cp.apply([nb])
+
+    def test_traversal_claim_name_rejected(self, cp):
+        """A claim name becomes a host directory component; path-like
+        names must be a 400, never a directory outside the home."""
+        from kubeflow_tpu.api.base import ValidationError
+
+        for evil in ("../../etc/cron.d", "/abs/path", "a/b", ".."):
+            nb = _notebook("esc", ["sleep", "1"], ports=False)
+            nb.spec["template"]["spec"]["volumes"] = [
+                {"name": "v", "persistentVolumeClaim":
+                 {"claimName": evil}}]
+            with pytest.raises(ValidationError):
+                cp.apply([nb])
+
+    def test_malformed_profile_quota_rejected_at_apply(self, cp):
+        from kubeflow_tpu.api.base import ValidationError
+
+        with pytest.raises(ValidationError):
+            cp.apply([_profile("bad-q", quota={"requests.cpu": "2cpu"})])
+        with pytest.raises(ValidationError):
+            cp.apply([_profile("bad-q", quota={"count/notebooks": "-1"})])
+
+    def test_parse_quantity(self):
+        from kubeflow_tpu.api.platform import parse_quantity
+
+        assert parse_quantity("500m") == 0.5
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity("1Gi") == 2 ** 30
+        assert parse_quantity("500M") == 5e8
+        assert parse_quantity(3) == 3.0
+
 
 class TestPodDefault:
     def test_env_injection_into_matching_gang(self, cp):
