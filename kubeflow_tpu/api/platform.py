@@ -8,6 +8,7 @@ Reference shapes (SURVEY.md §2.1): notebook-controller's ``Notebook`` CR
 
 from __future__ import annotations
 
+import math
 import re
 
 from typing import Any, Dict, List
@@ -79,15 +80,15 @@ class Notebook(Resource):
                     f"spec...resources.requests.{key}",
                     f"must be non-negative, got {val!r}")
         # Claim names become host directory names under the home's
-        # volumes root; anything path-like would escape it.
+        # volumes root; anything path-like would escape it, and names
+        # past the k8s 253-char cap fail makedirs at reconcile time.
         for v in self.volumes():
-            claim = ((v.get("persistentVolumeClaim") or {})
-                     .get("claimName")) or v.get("name") or ""
-            if not _SAFE_NAME_RE.fullmatch(str(claim)):
+            claim = claim_name(v)
+            if len(claim) > 253 or not _SAFE_NAME_RE.fullmatch(claim):
                 raise ValidationError(
                     "spec.template.spec.volumes",
-                    f"unsafe claim name {claim!r} (expected "
-                    f"[a-z0-9]([-a-z0-9.]*[a-z0-9])?)")
+                    f"unsafe claim name {claim[:64]!r} (expected "
+                    f"[a-z0-9]([-a-z0-9.]*[a-z0-9])?, max 253 chars)")
 
 
 # DNS-1123-subdomain-ish: what k8s accepts for claim names, and safe to
@@ -106,14 +107,29 @@ _QUANTITY_SUFFIXES = (
 def parse_quantity(q) -> float:
     """k8s resource-quantity parser for the subset quotas use: plain
     numbers, milli-cpu ("500m"), and binary/decimal byte suffixes
-    ("2Gi", "500M")."""
+    ("2Gi", "500M"). Non-finite values are rejected: "nan" would make
+    every quota comparison False and silently disable enforcement."""
     s = str(q).strip()
     if s.endswith("m"):
-        return float(s[:-1]) / 1000.0
-    for suf, mult in _QUANTITY_SUFFIXES:
-        if s.endswith(suf):
-            return float(s[: -len(suf)]) * mult
-    return float(s)
+        v = float(s[:-1]) / 1000.0
+    else:
+        for suf, mult in _QUANTITY_SUFFIXES:
+            if s.endswith(suf):
+                v = float(s[: -len(suf)]) * mult
+                break
+        else:
+            v = float(s)
+    if not math.isfinite(v):
+        raise ValueError(f"non-finite quantity {q!r}")
+    return v
+
+
+def claim_name(volume: Dict[str, Any]) -> str:
+    """The persistent claim a volume entry resolves to — THE single
+    definition shared by apply-time validation and the controller's
+    directory mapping (they must agree on the path a mount lands on)."""
+    return str(((volume.get("persistentVolumeClaim") or {})
+                .get("claimName")) or volume.get("name") or "")
 
 
 @register

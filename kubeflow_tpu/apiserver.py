@@ -641,8 +641,13 @@ class _Handler(BaseHTTPRequestHandler):
                     400, f"PodDefault {ref!r} is in namespace "
                     f"{pd_ns!r}, not the notebook's {ns!r}")
             pd = self.cp.store.try_get("PodDefault", pd_name, pd_ns)
-            if pd is not None:
-                labels.update(pd.selector())
+            if pd is None:
+                # Deleted between form render and submit — spawning
+                # without the selected configuration would silently
+                # omit the credential the user asked for.
+                return self._error(
+                    400, f"PodDefault {ref!r} no longer exists")
+            labels.update(pd.selector())
         manifest = {
             "apiVersion": "kubeflow.org/v1",
             "kind": "Notebook",

@@ -499,7 +499,10 @@ def _remote_main(args, url: Optional[str] = None) -> int:
     token = read_admin_token(home)
     # served_home() reports realpath — compare like for like, or a
     # symlinked home would silently drop the owner's own credential.
-    if token and Client(url, timeout=2.0).served_home() != \
+    # Generous timeout: a busy-but-owning server answering slowly must
+    # not degrade the owner to 403s (None also covers a genuinely
+    # unreachable server, where the real request fails anyway).
+    if token and Client(url, timeout=15.0).served_home() != \
             os.path.realpath(home):
         token = None
     client = Client(url, admin_token=token)

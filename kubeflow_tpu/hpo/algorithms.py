@@ -355,9 +355,8 @@ class RegularizedEvolution(Algorithm):
     """NAS-class search: aging (regularized) evolution over the parameter
     space treated as an architecture genome (AmoebaNet-style; this is the
     algorithm class behind Katib's NAS suggestion services, SURVEY.md §2.2
-    suggestion-services row — ENAS/DARTS need a trainable supernet, which
-    is a trial-side concern; the suggestion-side contract is a discrete
-    architecture search, which aging evolution serves).
+    suggestion-services row). The one-shot weight-sharing variant
+    (ENAS/DARTS) is ``DartsOneShot`` below + ``hpo/darts.py``.
 
     Population = the `population_size` most recent completed trials (old
     architectures age out regardless of fitness — the "regularized" part).
@@ -411,9 +410,31 @@ class RegularizedEvolution(Algorithm):
         return out
 
 
+class DartsOneShot(Algorithm):
+    """One-shot differentiable NAS (SURVEY.md §2.2 ENAS/DARTS row).
+
+    The search does not live here: a SINGLE trial trains the
+    weight-sharing supernet (``runners.darts_runner`` over
+    ``hpo/darts.py``) and reports the discovered genotype + objective —
+    the suggestion service's whole job is to launch that trial exactly
+    once, with the declared parameters (search-space shape and budget)
+    as its assignment. Katib's darts suggestion service has the same
+    shape: architecture decisions are made by gradient descent on the
+    trial, not by this service.
+    """
+
+    name = "darts"
+
+    def suggest(self, trials, count):
+        if trials:
+            return []  # the one search trial exists (or finished)
+        rng = self._rng(0)
+        return [self.space.sample(rng)]
+
+
 _ALGORITHMS = {cls.name: cls for cls in
                (RandomSearch, GridSearch, TPE, BayesianOptimization, CMAES,
-                Hyperband, RegularizedEvolution)}
+                Hyperband, RegularizedEvolution, DartsOneShot)}
 # Katib aliases
 _ALGORITHMS["bayesian"] = BayesianOptimization
 _ALGORITHMS["skopt"] = BayesianOptimization

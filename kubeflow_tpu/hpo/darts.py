@@ -1,0 +1,244 @@
+"""DARTS-style one-shot differentiable architecture search (tpu-first).
+
+Reference role (SURVEY.md §2.2 suggestion-services row): Katib ships
+ENAS/DARTS NAS trial types where ONE trial trains a weight-sharing
+supernet and emits the best genotype, rather than training one
+architecture per trial. This is that trial engine, built the JAX way:
+
+* The supernet's mixed op computes EVERY candidate op and blends them
+  with softmax(alpha) — a pure tensor expression with static shapes, so
+  the whole search step is one XLA graph (no data-dependent Python
+  control flow; candidate convs tile onto the MXU and XLA fuses the
+  blend into them).
+* First-order DARTS bilevel alternation: model weights w step on a
+  train batch, architecture logits alpha step on a held-out batch, both
+  as jitted optax updates. alpha is a plain (edges, ops) array passed
+  as an input to apply(), so d(loss)/d(alpha) falls out of jax.grad
+  like any other gradient.
+* Discretization is argmax per edge; the genotype is evaluated by
+  retraining the fixed architecture from scratch (the honest DARTS
+  protocol — supernet accuracy is not comparable).
+
+The op set deliberately contains "zero" and "skip": a search that
+cannot prune is not a search, and beating a random genotype (the E2E
+acceptance test) requires real signal about which ops matter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..data.synthetic import get_dataset
+
+# Each op preserves (H, W, C) so every edge can host every op — the
+# standard DARTS normal-cell constraint.
+OPS: Tuple[str, ...] = ("conv3", "conv1", "maxpool", "skip", "zero")
+
+
+class _Op(nn.Module):
+    kind: str
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kind == "conv3":
+            y = nn.Conv(self.features, (3, 3), padding="SAME",
+                        dtype=self.dtype)(x)
+            return nn.relu(y)
+        if self.kind == "conv1":
+            y = nn.Conv(self.features, (1, 1), dtype=self.dtype)(x)
+            return nn.relu(y)
+        if self.kind == "maxpool":
+            return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        if self.kind == "skip":
+            return x
+        if self.kind == "zero":
+            return jnp.zeros_like(x)
+        raise ValueError(f"unknown op {self.kind!r}")
+
+
+class MixedOp(nn.Module):
+    """All candidates computed, blended by softmax(alpha): one fused XLA
+    graph per edge instead of a branch per op."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, alpha):
+        w = jax.nn.softmax(alpha.astype(jnp.float32))
+        outs = jnp.stack(
+            [_Op(kind, self.features, self.dtype)(x).astype(jnp.float32)
+             for kind in OPS])
+        return jnp.tensordot(w, outs, axes=1).astype(self.dtype)
+
+
+class SuperNet(nn.Module):
+    """Stem conv -> chain of mixed-op edges -> pooled linear head.
+
+    ``alphas`` (edges, |OPS|) rides in as a call argument, NOT a flax
+    param: w and alpha belong to different optimizers in the bilevel
+    scheme, and keeping alpha outside the param tree makes the split
+    explicit instead of a tree-filtering convention.
+    """
+
+    num_classes: int
+    edges: int = 3
+    features: int = 16
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, alphas):
+        x = nn.Conv(self.features, (3, 3), padding="SAME",
+                    dtype=self.dtype)(x.astype(self.dtype))
+        x = nn.relu(x)
+        for e in range(self.edges):
+            x = MixedOp(self.features, self.dtype)(x, alphas[e])
+        # Flatten head: the class signal in the synthetic prototypes is
+        # a spatial pattern; global average pooling provably erases it
+        # (a GAP head plateaus at chance on this data).
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class FixedNet(nn.Module):
+    """The discretized architecture: one op per edge (genotype)."""
+
+    num_classes: int
+    genotype: Tuple[str, ...]
+    features: int = 16
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), padding="SAME",
+                    dtype=self.dtype)(x.astype(self.dtype))
+        x = nn.relu(x)
+        for kind in self.genotype:
+            x = _Op(kind, self.features, self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def _xent(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+@dataclasses.dataclass
+class SearchResult:
+    genotype: List[str]
+    val_accuracy: float
+    alphas: np.ndarray
+    history: List[Dict[str, float]]
+
+
+def random_genotype(edges: int, seed: int) -> List[str]:
+    rng = np.random.default_rng(seed)
+    return [OPS[int(rng.integers(len(OPS)))] for _ in range(edges)]
+
+
+def search(dataset: str = "mnist", edges: int = 3, features: int = 16,
+           search_steps: int = 120, eval_steps: int = 120,
+           batch_size: int = 128, lr: float = 2e-3, alpha_lr: float = 8e-3,
+           seed: int = 0, log=None) -> SearchResult:
+    """Run first-order DARTS, then retrain + score the discretized
+    genotype. Deterministic in (all args)."""
+    train = get_dataset(dataset, "train", seed=seed)
+    val = get_dataset(dataset, "eval", seed=seed)
+    net = SuperNet(num_classes=train.num_classes, edges=edges,
+                   features=features)
+
+    key = jax.random.PRNGKey(seed)
+    x0 = jnp.zeros((1, *train.shape), jnp.float32)
+    alphas = jnp.zeros((edges, len(OPS)), jnp.float32)
+    params = net.init(key, x0, alphas)["params"]
+    w_opt, a_opt = optax.adam(lr), optax.adam(alpha_lr)
+    w_state, a_state = w_opt.init(params), a_opt.init(alphas)
+
+    @jax.jit
+    def w_step(params, w_state, alphas, xb, yb):
+        def loss_fn(p):
+            return _xent(net.apply({"params": p}, xb, alphas), yb)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, w_state = w_opt.update(g, w_state)
+        return optax.apply_updates(params, updates), w_state, loss
+
+    @jax.jit
+    def a_step(alphas, a_state, params, xb, yb):
+        def loss_fn(a):
+            return _xent(net.apply({"params": params}, xb, a), yb)
+
+        loss, g = jax.value_and_grad(loss_fn)(alphas)
+        updates, a_state = a_opt.update(g, a_state)
+        return optax.apply_updates(alphas, updates), a_state, loss
+
+    history: List[Dict[str, float]] = []
+    train_it = train.batches(batch_size)
+    val_it = val.batches(batch_size)
+    for step in range(search_steps):
+        xb, yb = next(train_it)
+        params, w_state, wl = w_step(params, w_state, alphas, xb, yb)
+        xv, yv = next(val_it)
+        alphas, a_state, al = a_step(alphas, a_state, params, xv, yv)
+        if log and (step % 20 == 0 or step == search_steps - 1):
+            log(f"step={step} supernet_train_loss={float(wl):.4f} "
+                f"supernet_val_loss={float(al):.4f}")
+        history.append({"train_loss": float(wl), "val_loss": float(al)})
+
+    genotype = [OPS[int(i)] for i in np.argmax(np.asarray(alphas), axis=1)]
+    acc = evaluate_genotype(genotype, dataset=dataset, features=features,
+                            steps=eval_steps, batch_size=batch_size,
+                            lr=lr, seed=seed)
+    return SearchResult(genotype=genotype, val_accuracy=acc,
+                        alphas=np.asarray(alphas), history=history)
+
+
+def evaluate_genotype(genotype: List[str], dataset: str = "mnist",
+                      features: int = 16, steps: int = 120,
+                      batch_size: int = 128, lr: float = 2e-3,
+                      seed: int = 0) -> float:
+    """Train the fixed architecture from scratch and return held-out
+    accuracy — the comparable number for genotypes (supernet accuracy
+    is not)."""
+    train = get_dataset(dataset, "train", seed=seed)
+    val = get_dataset(dataset, "eval", seed=seed)
+    net = FixedNet(num_classes=train.num_classes,
+                   genotype=tuple(genotype), features=features)
+    key = jax.random.PRNGKey(seed + 1)
+    params = net.init(key, jnp.zeros((1, *train.shape), jnp.float32))[
+        "params"]
+    opt = optax.adam(lr)
+    state = opt.init(params)
+
+    @jax.jit
+    def step_fn(params, state, xb, yb):
+        loss, g = jax.value_and_grad(
+            lambda p: _xent(net.apply({"params": p}, xb), yb))(params)
+        updates, state = opt.update(g, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    it = train.batches(batch_size)
+    for _ in range(steps):
+        xb, yb = next(it)
+        params, state, _ = step_fn(params, state, xb, yb)
+
+    xe, ye = val.eval_arrays(2048)
+
+    @jax.jit
+    def acc_fn(params, x, y):
+        pred = jnp.argmax(net.apply({"params": params}, x), axis=-1)
+        return jnp.mean((pred == y).astype(jnp.float32))
+
+    return float(acc_fn(params, jnp.asarray(xe), jnp.asarray(ye)))

@@ -23,17 +23,20 @@ empty, see SURVEY §0):
 from __future__ import annotations
 
 import os
+import re
 import socket
 import time
 from typing import Dict, List, Optional
 
 from ..api.platform import (
+    _SAFE_NAME_RE,
     NOTEBOOK_CULLED,
     NOTEBOOK_READY,
     PROFILE_READY,
     Notebook,
     PodDefault,
     Profile,
+    claim_name,
     parse_quantity,
 )
 from ..api.training import JOB_QUEUED, TrainingJob
@@ -97,21 +100,23 @@ class PlatformAdmission:
         return None
 
     def check_notebook(self, nb: Notebook) -> Optional[str]:
-        """Quota admission for notebooks: ``count/notebooks`` plus the
-        ``requests.cpu`` / ``requests.memory`` sums the web-app pickers
-        feed (reference: ResourceQuota rejects the StatefulSet's pod)."""
+        """Quota admission for notebooks: ``count/notebooks`` plus EVERY
+        ``requests.<resource>`` hard limit, summed generically — cpu,
+        memory, and accelerator chips alike (reference: ResourceQuota
+        rejects the StatefulSet's pod)."""
         profile = self.store.try_get("Profile", nb.namespace)
         if not isinstance(profile, Profile):
             return None
         hard = (profile.resource_quota().get("hard")) or {}
-        watched = {k: hard.get(k) for k in
-                   ("count/notebooks", "requests.cpu", "requests.memory")
-                   if hard.get(k) is not None}
-        if not watched:
+        req_limits = {k[len("requests."):]: parse_quantity(v)
+                      for k, v in hard.items()
+                      if k.startswith("requests.")}
+        max_count = hard.get("count/notebooks")
+        if max_count is None and not req_limits:
             return None
-        count, cpu, mem = 1, parse_quantity(
-            nb.resource_requests().get("cpu", 0)), parse_quantity(
-            nb.resource_requests().get("memory", 0))
+        count = 1
+        sums = {r: parse_quantity(nb.resource_requests().get(r, 0))
+                for r in req_limits}
         for other in self.store.list("Notebook", namespace=nb.namespace):
             assert isinstance(other, Notebook)
             if other.name == nb.name or other.has_condition(NOTEBOOK_CULLED):
@@ -124,20 +129,16 @@ class PlatformAdmission:
                 continue
             count += 1
             req = other.resource_requests()
-            cpu += parse_quantity(req.get("cpu", 0))
-            mem += parse_quantity(req.get("memory", 0))
-        limit = watched.get("count/notebooks")
-        if limit is not None and count > int(limit):
-            return (f"profile {profile.name}: count/notebooks={limit} "
+            for r in sums:
+                sums[r] += parse_quantity(req.get(r, 0))
+        if max_count is not None and count > int(max_count):
+            return (f"profile {profile.name}: count/notebooks={max_count} "
                     f"exhausted")
-        limit = watched.get("requests.cpu")
-        if limit is not None and cpu > parse_quantity(limit):
-            return (f"profile {profile.name}: requests.cpu={limit} "
-                    f"exhausted ({cpu:g} requested)")
-        limit = watched.get("requests.memory")
-        if limit is not None and mem > parse_quantity(limit):
-            return (f"profile {profile.name}: requests.memory={limit} "
-                    f"exhausted")
+        for r, limit in req_limits.items():
+            if sums[r] > limit:
+                return (f"profile {profile.name}: requests.{r}="
+                        f"{hard['requests.' + r]} exhausted "
+                        f"({sums[r]:g} requested)")
         return None
 
     # -- PodDefault injection (admission-webhook parity) --------------------
@@ -244,8 +245,6 @@ class NotebookController(Controller):
         culls — ``KFX_VOLUME_<NAME>`` per mount, ``KFX_WORKSPACE`` for
         the first, and ``KFX_PVC_ROOT`` so ``pvc://claim/...`` URIs in
         serving resolve to the same data)."""
-        import re as _re
-
         vols = {v.get("name"): v for v in nb.volumes()}
         root = os.path.join(os.path.dirname(self.gangs.base_workdir),
                             "volumes", nb.namespace)
@@ -254,17 +253,14 @@ class NotebookController(Controller):
             v = vols.get(m.get("name"))
             if v is None:
                 continue
-            claim = ((v.get("persistentVolumeClaim") or {})
-                     .get("claimName")) or v.get("name")
+            claim = claim_name(v)
             # Belt-and-braces with Notebook.validate(): a claim name is
             # one safe path component, never a traversal.
-            from ..api.platform import _SAFE_NAME_RE
-
-            if not _SAFE_NAME_RE.fullmatch(str(claim)):
+            if len(claim) > 253 or not _SAFE_NAME_RE.fullmatch(claim):
                 continue
             path = os.path.join(root, claim)
             os.makedirs(path, exist_ok=True)
-            key = "KFX_VOLUME_" + _re.sub(
+            key = "KFX_VOLUME_" + re.sub(
                 r"[^A-Za-z0-9]", "_", str(m.get("name", ""))).upper()
             env[key] = path
             env.setdefault("KFX_WORKSPACE", path)

@@ -1,0 +1,68 @@
+"""One-shot NAS trial entrypoint (DARTS, see hpo/darts.py).
+
+Reference role (SURVEY.md §2.2 suggestion-services row): Katib's
+ENAS/DARTS NAS runs ONE trial that trains a weight-sharing supernet and
+emits the best genotype, instead of one trial per candidate. This is
+that trial process, driven by an Experiment whose trialTemplate passes
+the search-space shape (edges, features, step budget) as trial
+parameters.
+
+Metrics contract (StdOut collector): prints ``val_acc=X`` as the
+objective and ``genotype=a|b|c`` for the discovered architecture;
+``--arch=random`` trains a randomly drawn genotype under the identical
+budget, giving experiments a same-cost baseline arm.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="kfx DARTS one-shot NAS trial")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--edges", type=int, default=3)
+    p.add_argument("--features", type=int, default=16)
+    p.add_argument("--search-steps", type=int, default=150)
+    p.add_argument("--eval-steps", type=int, default=150)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--learning-rate", type=float, default=2e-3)
+    p.add_argument("--alpha-learning-rate", type=float, default=8e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--arch", default="search", choices=["search", "random"],
+                   help="search: differentiable DARTS; random: a random "
+                        "genotype trained with the same eval budget "
+                        "(baseline arm)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from ..hpo.darts import evaluate_genotype, random_genotype, search
+
+    if args.arch == "random":
+        genotype = random_genotype(args.edges, seed=args.seed)
+        acc = evaluate_genotype(
+            genotype, dataset=args.dataset, features=args.features,
+            steps=args.eval_steps, batch_size=args.batch_size,
+            lr=args.learning_rate, seed=args.seed)
+        print(f"genotype={'|'.join(genotype)} arch_source=random",
+              flush=True)
+        print(f"step={args.eval_steps} val_acc={acc:.6f}", flush=True)
+        return 0
+
+    result = search(
+        dataset=args.dataset, edges=args.edges, features=args.features,
+        search_steps=args.search_steps, eval_steps=args.eval_steps,
+        batch_size=args.batch_size, lr=args.learning_rate,
+        alpha_lr=args.alpha_learning_rate, seed=args.seed,
+        log=lambda s: print(s, flush=True))
+    print(f"genotype={'|'.join(result.genotype)} arch_source=search",
+          flush=True)
+    print(f"step={args.search_steps} "
+          f"val_acc={result.val_accuracy:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -75,6 +75,17 @@ class TestAlgorithms:
         b = _run_algorithm("tpe", n_rounds=4)
         assert [t["assignments"] for t in a] == [t["assignments"] for t in b]
 
+    def test_darts_suggests_exactly_one_trial(self):
+        """One-shot NAS: the suggestion service launches the single
+        supernet-search trial and nothing more, regardless of count."""
+        from kubeflow_tpu.hpo.algorithms import get_algorithm
+
+        algo = get_algorithm("darts", [dict(p) for p in PARAMS], seed=7)
+        first = algo.suggest([], 5)
+        assert len(first) == 1
+        assert algo.suggest([{"assignments": first[0], "value": 0.9}],
+                            5) == []
+
     def test_grid_exhaustive_and_deduped(self):
         from kubeflow_tpu.hpo.algorithms import get_algorithm
 
@@ -344,6 +355,91 @@ spec:
             pa = {p["name"]: p["value"]
                   for p in best["parameterAssignments"]}
             assert pa["layers"] in ("2", "4", "8") and 64 <= int(pa["ffn"])
+
+    def test_darts_one_shot_nas_beats_random(self, tmp_path):
+        """One-shot differentiable NAS (SURVEY.md §2.2 ENAS/DARTS row):
+        a single trial trains the weight-sharing supernet, reports the
+        discovered genotype + val_acc, and the discovered architecture
+        must beat a random genotype trained with the same budget."""
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        text = f"""
+apiVersion: kubeflow.org/v1
+kind: Experiment
+metadata:
+  name: darts
+spec:
+  objective:
+    type: maximize
+    objectiveMetricName: val_acc
+  algorithm:
+    algorithmName: darts
+  maxTrialCount: 1
+  parallelTrialCount: 1
+  maxFailedTrialCount: 1
+  parameters:
+  - name: edges
+    parameterType: categorical
+    feasibleSpace: {{list: ["3"]}}
+  - name: searchSteps
+    parameterType: categorical
+    feasibleSpace: {{list: ["150"]}}
+  trialTemplate:
+    trialParameters:
+    - name: edges
+      reference: edges
+    - name: searchSteps
+      reference: searchSteps
+    trialSpec:
+      apiVersion: kubeflow.org/v1
+      kind: JAXJob
+      spec:
+        jaxReplicaSpecs:
+          Worker:
+            replicas: 1
+            restartPolicy: Never
+            template:
+              spec:
+                containers:
+                - name: t
+                  command: ["{PY}", "-m",
+                            "kubeflow_tpu.runners.darts_runner",
+                            "--edges=${{trialParameters.edges}}",
+                            "--search-steps=${{trialParameters.searchSteps}}",
+                            "--eval-steps=120", "--features=8",
+                            "--batch-size=64", "--learning-rate=4e-3",
+                            "--alpha-learning-rate=1e-2", "--seed=0"]
+"""
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply(load_manifests(text))
+            exp = cp.wait_for_condition("Experiment", "darts", "Succeeded",
+                                        timeout=600)
+            s = exp.status
+            assert s["trialsSucceeded"] == 1
+            best = s["currentOptimalTrial"]
+            searched_acc = float(best["observation"]["metrics"][0]["latest"])
+            # The discovered genotype is in the trial log.
+            (job,) = cp.store.list("JAXJob")
+            log = cp.job_logs("JAXJob", job.name, job.namespace)
+            assert "arch_source=search" in log
+            genotype_line = next(ln for ln in log.splitlines()
+                                 if ln.startswith("genotype="))
+            genotype = genotype_line.split()[0].split("=")[1].split("|")
+            assert len(genotype) == 3
+            # Better than random: same eval budget, random genotype.
+            from kubeflow_tpu.hpo.darts import (
+                evaluate_genotype,
+                random_genotype,
+            )
+
+            rand_acc = evaluate_genotype(random_genotype(3, seed=1),
+                                         steps=120, features=8,
+                                         batch_size=64, lr=4e-3, seed=0)
+            assert searched_acc > rand_acc + 0.1, (
+                f"search {searched_acc} vs random {rand_acc}")
+            assert searched_acc > 0.8
 
     def test_goal_stops_early(self, tmp_path):
         from kubeflow_tpu.api.manifest import load_manifests

@@ -277,6 +277,27 @@ class TestProfile:
         assert parse_quantity("1Gi") == 2 ** 30
         assert parse_quantity("500M") == 5e8
         assert parse_quantity(3) == 3.0
+        # NaN/inf would make every quota comparison False — rejected.
+        for bad in ("nan", "inf", "-inf"):
+            with pytest.raises(ValueError):
+                parse_quantity(bad)
+
+    def test_accelerator_quota_enforced(self, cp):
+        """requests.* hard limits are enforced generically — the
+        accelerator picker must be held to its quota like cpu/memory."""
+        cp.apply([_profile(
+            "team-t", quota={"requests.kubeflow.org/tpu": "8"})])
+        nb = _notebook("tpu-hog", ["sleep", "600"], ns="team-t",
+                       ports=False)
+        nb.spec["template"]["spec"]["containers"][0]["resources"] = {
+            "requests": {"kubeflow.org/tpu": "16"}}
+        cp.apply([nb])
+        _wait(lambda: any(
+            e.reason == "QuotaExceeded" and "kubeflow.org/tpu"
+            in e.message
+            for e in cp.store.events_for("Notebook", "team-t/tpu-hog")),
+            what="tpu request denied on quota")
+        assert cp.gangs.get("notebook/team-t/tpu-hog") is None
 
 
 class TestPodDefault:
