@@ -130,7 +130,7 @@ def main() -> int:
     # a driver-side timeout can only cost the newest metrics, never the
     # whole JSON line (KFX_BENCH_BUDGET_S to tune; sections check before
     # starting, not mid-flight).
-    budget = float(os.environ.get("KFX_BENCH_BUDGET_S", "1500"))
+    budget = float(os.environ.get("KFX_BENCH_BUDGET_S", "1800"))
     bench_t0 = t0  # whole-run clock: the mnist phase counts too
 
     def have_time(est_s: float) -> bool:
@@ -149,6 +149,10 @@ def main() -> int:
     if have_time(420):
         lm.update(_bench_baseline_configs(
             deadline=bench_t0 + budget))
+    # resnet50 is BASELINE contract #3a (the ResNet-50 number, measured
+    # where the chip is) — contract metrics outrank the decode extra.
+    if have_time(180):
+        lm.update(_bench_resnet50())
     if have_time(300):
         lm.update(_bench_lm_decode())
     lm["bench_wall_s"] = round(time.time() - bench_t0, 1)
@@ -156,7 +160,15 @@ def main() -> int:
         "metric": "mnist_jaxjob_wall_clock_s",
         "value": round(wall, 2),
         "unit": "s",
+        # vs_baseline honesty: the reference publishes no numbers
+        # (BASELINE.json "published": {}), so the denominator is the
+        # builder-chosen 60s parity budget. The credible absolute perf
+        # signals are lm_mfu / lm_long_mfu / resnet50_images_per_s.
         "vs_baseline": round(PARITY_BUDGET_S / wall, 3),
+        "vs_baseline_definition": (
+            f"builder-chosen parity budget {PARITY_BUDGET_S:.0f}s / "
+            f"measured; reference publishes no numbers — see lm_mfu for "
+            f"the absolute perf signal"),
         "steps": args.steps,
         "batch_size": args.batch_size,
         "final_accuracy": acc,
@@ -186,21 +198,26 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
         from kubeflow_tpu.utils.flops import (
             mfu, peak_flops_per_chip, transformer_train_flops_per_token)
 
+        from kubeflow_tpu.data.lm import LMDataset
+
         cfg = preset_config(preset, max_seq_len=seq_len, remat=True)
         mesh, plan = make_mesh(1)
         loop = LMTrainLoop(cfg, mesh, plan,
                            LMHyperParams(total_steps=1000, warmup_steps=10))
         state = loop.init_state()
-        rng = np.random.default_rng(0)
-        toks = rng.integers(0, cfg.vocab_size, (batch, seq_len + 1),
-                            dtype=np.int32)
+        # Distinct Markov-chain batches per step: loss_after is then a
+        # (short) learning signal toward the dataset's entropy floor,
+        # not memorization of one repeated batch.
+        ds = LMDataset(vocab_size=cfg.vocab_size, seq_len=seq_len)
+        it = ds.batches(batch)
         import jax
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(state.params))
         # Warmup (compile + first step), synced.
-        state, _, _ = loop.train_many(state, [toks])
+        state, _, _ = loop.train_many(state, [next(it)])
+        steps = [next(it) for _ in range(n_steps)]
         t0 = time.perf_counter()
-        state, loss, _ = loop.train_many(state, [toks] * n_steps)
+        state, loss, _ = loop.train_many(state, steps)
         dt = (time.perf_counter() - t0) / n_steps
         fpt = transformer_train_flops_per_token(cfg, seq_len)
         tok_s = batch * seq_len / dt
@@ -215,6 +232,7 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
             "mfu": round(mfu(tok_s, fpt), 4),
             "peak_flops": peak_flops_per_chip(),
             "loss_after": round(float(loss), 3),
+            "loss_floor": round(ds.entropy_floor(), 3),
         }
         return {prefix + k: v for k, v in out.items()}
     except Exception as e:  # secondary metric must not sink the bench
@@ -320,6 +338,38 @@ def _bench_lm_decode(preset: str = "small", batch: int = 4,
         return {"lm_decode_error": str(e)[:200]}
 
 
+def _bench_resnet50(steps: int = 60, batch: int = 256) -> dict:
+    """ResNet-50 single-chip training throughput on the real TPU
+    (BASELINE config #3 names ResNet-50; the MPIJob example runs
+    resnet18 on CPU ranks for budget — see BASELINE.md note — so the
+    resnet50 number is measured here where the chip actually is).
+    Device-generated batches, scan-fused dispatch: compute-bound."""
+    try:
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import TrainLoop
+
+        ds = get_dataset("cifar10")
+        loop = TrainLoop(get_model("resnet50", num_classes=ds.num_classes))
+        state = loop.init_state(ds.shape)
+        batch_fn = ds.device_batch_fn()
+        # Warmup dispatch (compile), then the measured one.
+        state, _, _ = loop.train_steps_device(state, batch_fn, batch, 0,
+                                              steps)
+        t0 = time.perf_counter()
+        state, loss, acc = loop.train_steps_device(state, batch_fn, batch,
+                                                   steps, steps)
+        dt = time.perf_counter() - t0
+        return {
+            "resnet50_batch": batch,
+            "resnet50_step_time_ms": round(dt / steps * 1000, 2),
+            "resnet50_images_per_s": round(steps * batch / dt, 0),
+            "resnet50_train_acc": round(float(acc), 3),
+        }
+    except Exception as e:  # secondary metric must not sink the bench
+        return {"resnet50_error": str(e)[:200]}
+
+
 def _bench_serving_p50(n_requests: int = 200) -> dict:
     """Secondary metric (BASELINE config #5): InferenceService p50 latency
     for single-instance predicts against the in-process model server."""
@@ -371,6 +421,11 @@ def _bench_serving_p50(n_requests: int = 200) -> dict:
         return {
             "serving_p50_ms": round(lat[len(lat) // 2], 2),
             "serving_p99_ms": round(lat[int(len(lat) * 0.99)], 2),
+            # The headline p50 is a batch-1 predict: name the device the
+            # measured placement probe chose for it, so a CPU number is
+            # never mistaken for an accelerator number.
+            "serving_p50_placement": predictor.placement.get(
+                1, "accelerator"),
             "serving_model": "resnet18-cifar10",
             "serving_placement": {str(k): v
                                   for k, v in predictor.placement.items()},

@@ -9,6 +9,7 @@ from .cnn import CNN  # noqa: F401
 from .mlp import MLP  # noqa: F401
 from .registry import get_model, model_names, register_model  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet50  # noqa: F401
+from .vit import ViT  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig,
     TransformerLM,

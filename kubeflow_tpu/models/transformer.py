@@ -67,6 +67,16 @@ class TransformerConfig:
     # dense path; "flash"/"xla" force one. cp>1 always rides ring
     # attention (its own seq-sharded kernel).
     attn_impl: str = "auto"
+    # The seq-len window where "auto" picks flash. The defaults are a
+    # MEASUREMENT, not a law: on this environment's emulated v5e (base
+    # preset, 8-step train) XLA's fused dense attention wins up to
+    # S=1024 (kernel-launch overhead dominates), flash wins 1.24x at
+    # S=2048 (the O(S^2) score matrix stops touching HBM), and above
+    # 4096 the emulator's compiler rejects scan+remat+kernel. On other
+    # hardware re-measure and set these (or force attn_impl="flash");
+    # flash_max_seq=0 means no upper bound.
+    flash_min_seq: int = 2048
+    flash_max_seq: int = 4096
     # Autoregressive decoding: every attention layer keeps a KV cache
     # ("cache" collection) of max_seq_len slots and calls attend the new
     # tokens against it. Position ids must be passed explicitly (pads are
@@ -103,6 +113,14 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
+def flash_window_ok(cfg: "TransformerConfig", seq_len: int) -> bool:
+    """Whether ``seq_len`` falls in the configured attn_impl="auto"
+    flash window (flash_max_seq <= 0 means unbounded above)."""
+    if seq_len < cfg.flash_min_seq:
+        return False
+    return cfg.flash_max_seq <= 0 or seq_len < cfg.flash_max_seq
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -125,19 +143,14 @@ class Attention(nn.Module):
             # Sub-block traces (e.g. the 8-token init sample) ride the
             # dense path; real sequences use the kernel.
             return ok
-        # auto: flash where it measurably wins on this hardware. Measured
-        # on the v5e (8-step LM train, base preset): XLA's fused dense
-        # attention is faster up to S=1024 (kernel launch overhead
-        # dominates); at S=2048 flash is 1.24x faster end-to-end (MFU
-        # 0.247 -> 0.305) because the O(S^2) score matrix stops touching
-        # HBM. Above 4096 the emulator's compiler rejects the
-        # scan+remat+kernel combination, so auto stays on XLA there
-        # (force attn_impl="flash" to override). tp composes (heads
-        # shard over "model"); sp composes (attention input is full-S).
+        # auto: flash inside the configured window (see
+        # flash_min_seq/flash_max_seq — measured defaults, overridable
+        # per hardware). tp composes (heads shard over "model"); sp
+        # composes (attention input is full-S).
         import jax
 
         return (ok and jax.default_backend() == "tpu"
-                and 2048 <= seq_len < 4096)
+                and flash_window_ok(cfg, seq_len))
 
     @nn.compact
     def __call__(self, x, positions):

@@ -1,0 +1,89 @@
+"""Vision Transformer classifier (ViT-S/Ti class).
+
+Rounds out the model-family inventory next to the CNN/ResNet examples
+(the reference's training operators are model-agnostic; its example zoo
+spans conv nets and transformer models — SURVEY.md §2.2 L7 examples
+row). TPU-first construction:
+
+  * patch embedding is a single strided Conv — one big matmul per image
+    onto the MXU, no unfold/gather;
+  * encoder blocks are pre-LN MHSA + MLP in bfloat16 with float32
+    LayerNorm statistics and logits;
+  * classification uses mean pooling over patch tokens (no CLS token:
+    one less concat, identical accuracy class at this scale), so every
+    tensor keeps static [B, N, D] shape straight through jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import register_model
+
+
+class ViTBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads, dtype=self.dtype,
+            deterministic=True)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.Dense(self.d_ff, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model, dtype=self.dtype)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 4
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    n_layers: int = 6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, H, W, _ = x.shape
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(
+                f"input {H}x{W} not divisible by patch_size {p}")
+        # Patch embed: strided conv == per-patch linear projection.
+        x = nn.Conv(self.d_model, (p, p), strides=(p, p),
+                    dtype=self.dtype, name="patch_embed")(
+            x.astype(self.dtype))
+        x = x.reshape((B, -1, self.d_model))  # [B, N, D]
+        n_patches = x.shape[1]
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(0.02),
+                         (1, n_patches, self.d_model), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.n_layers):
+            x = ViTBlock(self.d_model, self.n_heads, self.d_ff,
+                         self.dtype)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = jnp.mean(x, axis=1)  # mean-pool patch tokens
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register_model("vit")
+def _vit(num_classes: int = 10, **_):
+    return ViT(num_classes=num_classes)
+
+
+@register_model("vit-s")
+def _vit_s(num_classes: int = 10, **_):
+    return ViT(num_classes=num_classes, d_model=384, n_heads=6,
+               d_ff=1536, n_layers=12, patch_size=8)

@@ -36,6 +36,22 @@ def parse_args(argv=None):
                    help="context parallel ways (ring attention over 'ctx')")
     p.add_argument("--experts", type=int, default=0, help="MoE experts (ep)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--attn-impl", default="auto",
+                   choices=["auto", "flash", "xla"],
+                   help="attention path; 'auto' picks the pallas flash "
+                        "kernel inside --flash-window")
+    def flash_window(value: str):
+        lo, _, hi = value.partition(":")
+        try:
+            return (int(lo), int(hi) if hi else None)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected MIN[:MAX] integers, got {value!r}") from None
+
+    p.add_argument("--flash-window", default=None, type=flash_window,
+                   help="MIN[:MAX] seq-len window where 'auto' uses "
+                        "flash (default: the v5e-measured 2048:4096; "
+                        "MAX 0 = unbounded). Re-measure per hardware.")
     p.add_argument("--microbatches", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
@@ -86,6 +102,12 @@ def main(argv=None) -> int:
         return 2
     ds = get_lm_dataset(args.dataset, seed=args.seed,
                         seq_len=args.seq_len or None)
+    flash_overrides = {}
+    if args.flash_window is not None:
+        lo, hi = args.flash_window
+        flash_overrides["flash_min_seq"] = lo
+        if hi is not None:
+            flash_overrides["flash_max_seq"] = hi
     cfg = preset_config(
         args.preset,
         vocab_size=ds.vocab_size,
@@ -94,6 +116,8 @@ def main(argv=None) -> int:
         sp=args.sp,
         cp=args.cp,
         remat=args.remat,
+        attn_impl=args.attn_impl,
+        **flash_overrides,
     )
     mesh, plan = make_mesh(tp=args.tp or None, pp=args.pp, cp=args.cp,
                            fsdp=args.fsdp)

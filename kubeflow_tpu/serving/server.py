@@ -55,9 +55,15 @@ class JaxPredictor(Predictor):
     faster (host compute extrapolated linearly in batch). On a directly
     attached TPU the accelerator wins every bucket (sub-ms dispatch); when
     the accelerator sits behind a high-latency transport — like this
-    environment's tunneled emulator, ~100ms per round trip — small
-    latency-critical buckets land on the host while large batches still
-    ride the MXU.
+    environment's tunneled emulator — small latency-critical buckets land
+    on the host while large batches still ride the MXU.
+
+    The tunneled-transport floor is measured and irreducible at this
+    layer (docs/serving-latency.md): ~65-100ms per host<->device
+    completion sync, independent of payload and of h2d/d2h direction —
+    fused dispatch, donation, and committed-output AOT all still end in
+    one completion wait. Amortization (micro-batcher, multi-step
+    dispatch) is the lever, not dispatch surgery.
     """
 
     def __init__(self, model_dir: str, name: str = "",
@@ -315,9 +321,13 @@ class ModelServer:
                 pass
 
             def _send(self, code: int, payload: Dict[str, Any]) -> None:
-                body = json.dumps(payload).encode()
+                self._send_text(code, json.dumps(payload),
+                                "application/json")
+
+            def _send_text(self, code: int, text: str, ctype: str) -> None:
+                body = text.encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -348,9 +358,32 @@ class ModelServer:
         path = h.path
         if path == "/healthz" or path == "/":
             h._send(200, {"status": "alive"})
-        elif path == "/metrics":
-            h._send(200, {"request_count": self.request_count,
-                          "models": sorted(self.predictors)})
+        elif path == "/metrics" or path.startswith("/metrics?"):
+            # Prometheus exposition by default (the reference model
+            # servers are Prometheus-scrapable); JSON via ?format=json.
+            from urllib.parse import parse_qs, urlsplit
+
+            q = parse_qs(urlsplit(path).query)
+            if (q.get("format") or [""])[0] == "json":
+                h._send(200, {"request_count": self.request_count,
+                              "models": sorted(self.predictors)})
+            else:
+                ready = sum(1 for p in self.predictors.values() if p.ready)
+                lines = [
+                    "# HELP kfx_serving_requests_total Predict requests "
+                    "served since startup.",
+                    "# TYPE kfx_serving_requests_total counter",
+                    f"kfx_serving_requests_total {self.request_count}",
+                    "# HELP kfx_serving_models Registered models.",
+                    "# TYPE kfx_serving_models gauge",
+                    f"kfx_serving_models {len(self.predictors)}",
+                    "# HELP kfx_serving_models_ready Models ready to "
+                    "serve.",
+                    "# TYPE kfx_serving_models_ready gauge",
+                    f"kfx_serving_models_ready {ready}",
+                ]
+                h._send_text(200, "\n".join(lines) + "\n",
+                             "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/v1/models":
             h._send(200, {"models": sorted(self.predictors)})
         elif path.startswith("/v1/models/"):

@@ -108,6 +108,24 @@ class TestModelAttnImpl:
         with pytest.raises(ValueError, match="attn_impl='flash'"):
             TransformerLM(cfg).init(jax.random.PRNGKey(0), tokens)
 
+    def test_flash_auto_window_is_configurable(self):
+        """The 'auto' window is a measured default, not a hardcoded law
+        (round-2 review): flash_min_seq/flash_max_seq move it, and
+        max<=0 removes the upper bound."""
+        import dataclasses
+
+        from kubeflow_tpu.models.transformer import flash_window_ok
+
+        cfg = self._cfg("auto", 2048)
+        assert not flash_window_ok(cfg, 1024)
+        assert flash_window_ok(cfg, 2048)
+        assert not flash_window_ok(cfg, 4096)
+        wide = dataclasses.replace(cfg, flash_min_seq=512,
+                                   flash_max_seq=0)
+        assert flash_window_ok(wide, 512)
+        assert flash_window_ok(wide, 1 << 20)
+        assert not flash_window_ok(wide, 256)
+
     def test_flash_falls_back_for_sub_block_seq(self):
         """The 8-token init sample (and any seq%128!=0 trace) rides the
         dense path even under attn_impl='flash'."""

@@ -163,6 +163,19 @@ class TestModelServer:
             _post(f"{base}/v1/models/mnist:predict", {"wrong": 1})
         assert e.value.code == 400
 
+    def test_metrics_prometheus_and_json(self, server):
+        import urllib.request
+
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/plain")
+        assert "# TYPE kfx_serving_requests_total counter" in text
+        assert "kfx_serving_models 1" in text
+        assert "kfx_serving_models_ready 1" in text
+        status, body = _get(f"{base}/metrics?format=json")
+        assert status == 200 and body["models"] == ["mnist"]
+
 
 class TestMicroBatcher:
     def test_concurrent_requests_batched(self, export_dir):

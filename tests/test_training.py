@@ -98,6 +98,37 @@ class TestModels:
             losses.append(loss)
         assert losses[-1] < losses[0]
 
+    def test_vit_forward_and_trains(self):
+        """Vision-transformer family: patch-embed shapes, forward dtype
+        contract, and a few train steps reduce the loss."""
+        import jax
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import TrainLoop
+
+        m = get_model("vit", num_classes=10)
+        x = np.zeros((2, 28, 28, 1), np.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(v, x)
+        assert out.shape == (2, 10) and out.dtype == np.float32
+
+        ds = get_dataset("mnist")
+        loop = TrainLoop(get_model("vit"), learning_rate=1e-3)
+        state = loop.init_state(ds.shape)
+        losses = []
+        for images, labels in ds.batches(64, steps=8):
+            state, loss, _ = loop.train_step(state, images, labels)
+            losses.append(loss)
+        assert losses[-1] < losses[0]
+
+    def test_vit_rejects_indivisible_patches(self):
+        import jax
+        from kubeflow_tpu.models import get_model
+
+        m = get_model("vit", num_classes=10)
+        with pytest.raises(ValueError, match="patch_size"):
+            m.init(jax.random.PRNGKey(0),
+                   np.zeros((1, 30, 30, 1), np.float32))
+
     def test_registry_unknown(self):
         from kubeflow_tpu.models import get_model
 
