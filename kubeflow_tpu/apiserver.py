@@ -110,32 +110,27 @@ def read_admin_token(home: str) -> Optional[str]:
 
 
 def prometheus_text(m: dict) -> str:
-    """Encode the metrics snapshot in Prometheus exposition format 0.0.4
+    """Encode the metrics snapshot in Prometheus exposition format
     (SURVEY.md §5.5: the reference's operators expose Prometheus-scrapable
     text; JSON stays available via /metrics?format=json)."""
-    lines = [
-        "# HELP kfx_resources Number of stored resources by kind.",
-        "# TYPE kfx_resources gauge",
+    from .utils.prom import prom_text
+
+    metrics = [
+        ("kfx_resources", "gauge", "Number of stored resources by kind.",
+         [({"kind": k}, n) for k, n in sorted(m["resources"].items())]),
     ]
-    for kind, n in sorted(m["resources"].items()):
-        lines.append(f'kfx_resources{{kind="{kind}"}} {n}')
     for stat in ("depth", "delayed", "processing", "retrying"):
-        lines.append(
-            f"# HELP kfx_workqueue_{stat} Workqueue {stat} by controller.")
-        lines.append(f"# TYPE kfx_workqueue_{stat} gauge")
-        for kind, stats in sorted(m["controllers"].items()):
-            lines.append(
-                f'kfx_workqueue_{stat}{{controller="{kind}"}} '
-                f'{stats.get(stat, 0)}')
-    lines += [
-        "# HELP kfx_gangs Live process gangs.",
-        "# TYPE kfx_gangs gauge",
-        f"kfx_gangs {m['gangs']}",
-        "# HELP kfx_events_total Events recorded since startup.",
-        "# TYPE kfx_events_total counter",
-        f"kfx_events_total {m['events']}",
+        metrics.append(
+            (f"kfx_workqueue_{stat}", "gauge",
+             f"Workqueue {stat} by controller.",
+             [({"controller": k}, stats.get(stat, 0))
+              for k, stats in sorted(m["controllers"].items())]))
+    metrics += [
+        ("kfx_gangs", "gauge", "Live process gangs.", m["gangs"]),
+        ("kfx_events_total", "counter",
+         "Events recorded since startup.", m["events"]),
     ]
-    return "\n".join(lines) + "\n"
+    return prom_text(metrics)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -207,11 +202,13 @@ class _Handler(BaseHTTPRequestHandler):
 
                 return self._json(200, {"version": __version__})
             if url.path == "/metrics":
+                from .utils.prom import PROM_CTYPE
+
                 if (q.get("format") or [""])[0] == "json":
                     return self._json(200, self._metrics())
                 return self._send(
                     200, prometheus_text(self._metrics()).encode(),
-                    "text/plain; version=0.0.4; charset=utf-8")
+                    PROM_CTYPE)
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
             if parts == ["ui", "notebooks"]:

@@ -368,22 +368,18 @@ class ModelServer:
                 h._send(200, {"request_count": self.request_count,
                               "models": sorted(self.predictors)})
             else:
+                from ..utils.prom import PROM_CTYPE, prom_text
+
                 ready = sum(1 for p in self.predictors.values() if p.ready)
-                lines = [
-                    "# HELP kfx_serving_requests_total Predict requests "
-                    "served since startup.",
-                    "# TYPE kfx_serving_requests_total counter",
-                    f"kfx_serving_requests_total {self.request_count}",
-                    "# HELP kfx_serving_models Registered models.",
-                    "# TYPE kfx_serving_models gauge",
-                    f"kfx_serving_models {len(self.predictors)}",
-                    "# HELP kfx_serving_models_ready Models ready to "
-                    "serve.",
-                    "# TYPE kfx_serving_models_ready gauge",
-                    f"kfx_serving_models_ready {ready}",
-                ]
-                h._send_text(200, "\n".join(lines) + "\n",
-                             "text/plain; version=0.0.4; charset=utf-8")
+                h._send_text(200, prom_text([
+                    ("kfx_serving_requests_total", "counter",
+                     "Predict requests served since startup.",
+                     self.request_count),
+                    ("kfx_serving_models", "gauge",
+                     "Registered models.", len(self.predictors)),
+                    ("kfx_serving_models_ready", "gauge",
+                     "Models ready to serve.", ready),
+                ]), PROM_CTYPE)
         elif path == "/v1/models":
             h._send(200, {"models": sorted(self.predictors)})
         elif path.startswith("/v1/models/"):

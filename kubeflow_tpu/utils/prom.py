@@ -1,0 +1,33 @@
+"""Prometheus exposition-format 0.0.4 emission, shared by every
+/metrics endpoint (apiserver, model server) so the format conventions
+live in exactly one place (SURVEY.md §5.5: the reference's operators
+and model servers are Prometheus-scrapable)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple, Union
+
+PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# value: a bare number, or {label-dict-as-tuple...} — see prom_text.
+Value = Union[int, float, List[Tuple[Dict[str, str], Union[int, float]]]]
+
+
+def prom_text(metrics: List[Tuple[str, str, str, Value]]) -> str:
+    """Render [(name, type, help, value)] to exposition text.
+
+    ``value`` is either a scalar or a list of (labels, scalar) pairs:
+        ("kfx_resources", "gauge", "Stored resources by kind.",
+         [({"kind": "JAXJob"}, 3)])
+    """
+    lines: List[str] = []
+    for name, mtype, help_, value in metrics:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        if isinstance(value, list):
+            for labels, v in value:
+                lab = ",".join(f'{k}="{v_}"' for k, v_ in labels.items())
+                lines.append(f"{name}{{{lab}}} {v}")
+        else:
+            lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

@@ -457,6 +457,42 @@ spec:
             # goal 0.0 is reached by the very first successful trial
             assert exp.status["trialsSucceeded"] < 4
 
+    def test_experiment_survives_controlplane_restart(self, tmp_path):
+        """Checkpoint/resume at the control-plane tier (SURVEY.md §5.4):
+        a journaled control plane stopped mid-sweep must, on restart,
+        replay the experiment/suggestion/trials from sqlite, give
+        unfinished trial jobs fresh gangs, and run the sweep to
+        Succeeded with the full trial count."""
+        import time as _time
+
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        home = str(tmp_path / "kfx")
+        # Slow trials guarantee the stop lands mid-sweep.
+        text = EXPERIMENT.format(name="resume", python=PY).replace(
+            "print(", "import time; time.sleep(3); print(")
+        with ControlPlane(home=home, journal=True,
+                          worker_platform="cpu") as cp:
+            cp.apply(load_manifests(text))
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                if cp.store.list("Trial"):
+                    break
+                _time.sleep(0.1)
+            assert cp.store.list("Trial"), "no trials before restart"
+            exp = cp.store.get("Experiment", "resume")
+            assert not exp.has_condition("Succeeded"), \
+                "sweep finished before the restart could interrupt it"
+            # Context exit = stop: reconcile loops halt, gangs are
+            # killed, the flock releases — the crash-ish shutdown.
+        with ControlPlane(home=home, journal=True,
+                          worker_platform="cpu") as cp:
+            exp = cp.wait_for_condition("Experiment", "resume",
+                                        "Succeeded", timeout=120)
+            assert exp.status["trialsSucceeded"] == 4
+            assert cp.store.get("Suggestion", "resume").spec["requests"] == 4
+
     def test_experiment_delete_cascades(self, tmp_path):
         from kubeflow_tpu.api.manifest import load_manifests
         from kubeflow_tpu.controlplane import ControlPlane

@@ -99,6 +99,37 @@ class TestExecution:
         log = cp.job_logs("JAXJob", "par-s")
         assert "val=42" in log
 
+    def test_pipeline_survives_controlplane_restart(self, tmp_path):
+        """A journaled control plane stopped mid-DAG must resume the
+        pipeline on restart: completed steps stay Succeeded, the
+        interrupted/pending steps run, and the DAG finishes."""
+        home = str(tmp_path / "kfx")
+        slow = "import time; time.sleep(3)"
+        p = _pipeline("resume", [
+            _cmd_step("first", "pass"),
+            _cmd_step("slow", slow, depends=["first"]),
+            _cmd_step("last", "pass", depends=["slow"]),
+        ])
+        with ControlPlane(home=home, journal=True,
+                          worker_platform="cpu") as cp:
+            cp.apply([p])
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                obj = cp.store.get("Pipeline", "resume")
+                if obj.status.get("steps", {}).get("first") == "Succeeded":
+                    break
+                time.sleep(0.1)
+            assert obj.status["steps"]["first"] == "Succeeded"
+            assert not obj.has_condition("Succeeded"), \
+                "pipeline finished before the restart could interrupt it"
+        with ControlPlane(home=home, journal=True,
+                          worker_platform="cpu") as cp:
+            final = cp.wait_for_condition("Pipeline", "resume",
+                                          "Succeeded", timeout=120)
+            assert final.status["steps"] == {
+                "first": "Succeeded", "slow": "Succeeded",
+                "last": "Succeeded"}
+
     def test_failure_skips_downstream(self, cp):
         cp.apply([_pipeline("fail", [
             _cmd_step("bad", "raise SystemExit(3)"),
