@@ -139,7 +139,11 @@ def main() -> int:
     serving = _bench_serving_p50()
     lm: dict = {}
     if have_time(240):
-        lm.update(_bench_lm())
+        # save_dense selective remat: keep the fat matmul outputs,
+        # recompute only elementwise + the S^2 block — measured 4.8%
+        # faster than full remat at this shape (ABAB, idle box); the
+        # linear-in-S saves fit HBM at S=512 but not at S=2048.
+        lm.update(_bench_lm(remat_policy="save_dense"))
     if have_time(300):
         # Long-context config: S=2048 rides the pallas flash-attention
         # kernel (attn_impl="auto" switches at S>=2048; measured 1.24x
@@ -181,7 +185,8 @@ def main() -> int:
 
 
 def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
-              n_steps: int = 12, prefix: str = "lm_") -> dict:
+              n_steps: int = 12, prefix: str = "lm_",
+              remat_policy: str = "nothing") -> dict:
     """Flagship LM measurement on the real TPU: step time, tokens/s, MFU.
 
     The base preset (d=1024, 24 layers, d_ff=4096 — MXU-shaped dims,
@@ -200,7 +205,8 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
 
         from kubeflow_tpu.data.lm import LMDataset
 
-        cfg = preset_config(preset, max_seq_len=seq_len, remat=True)
+        cfg = preset_config(preset, max_seq_len=seq_len, remat=True,
+                            remat_policy=remat_policy)
         mesh, plan = make_mesh(1)
         loop = LMTrainLoop(cfg, mesh, plan,
                            LMHyperParams(total_steps=1000, warmup_steps=10))

@@ -28,7 +28,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-
+from jax.ad_checkpoint import checkpoint_name
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +53,12 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # With remat on: what the checkpoint may KEEP instead of recompute.
+    # "nothing" = classic full remat (lowest HBM, ~full fwd recompute in
+    # bwd); "dots" = jax.checkpoint_policies.dots_saveable keeps matmul
+    # outputs (incl. the S^2 scores — only fits smaller B*S); measure
+    # per shape. Ignored when remat=False.
+    remat_policy: str = "nothing"
     # Megatron-style sequence parallelism: between matmul regions the
     # residual stream is sharded over the "model" axis on the seq dim
     # (annotation only — XLA inserts the all-gather/reduce-scatter pairs).
@@ -147,8 +153,6 @@ class Attention(nn.Module):
         # flash_min_seq/flash_max_seq — measured defaults, overridable
         # per hardware). tp composes (heads shard over "model"); sp
         # composes (attention input is full-S).
-        import jax
-
         return (ok and jax.default_backend() == "tpu"
                 and flash_window_ok(cfg, seq_len))
 
@@ -159,9 +163,16 @@ class Attention(nn.Module):
         proj = lambda name, feats: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name=name)
-        q = proj("query", (cfg.n_heads, cfg.head_dim))(x)
-        k = proj("key", (cfg.n_heads, cfg.head_dim))(x)
-        v = proj("value", (cfg.n_heads, cfg.head_dim))(x)
+        # checkpoint_name tags mark the fat matmul outputs for the
+        # "save_dense" remat policy: keep these, recompute only the
+        # cheap elementwise chain and the O(S^2) score block (whose
+        # buffers are what make full activations not fit).
+        q = checkpoint_name(
+            proj("query", (cfg.n_heads, cfg.head_dim))(x), "attn_q")
+        k = checkpoint_name(
+            proj("key", (cfg.n_heads, cfg.head_dim))(x), "attn_k")
+        v = checkpoint_name(
+            proj("value", (cfg.n_heads, cfg.head_dim))(x), "attn_v")
         # RoPE with absolute positions (pads carry -1; their rows are
         # masked out of every decode-mode attention, so the garbage
         # rotation never contributes).
@@ -216,9 +227,10 @@ class Attention(nn.Module):
             scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
             probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
-        return nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
-                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                               name="out")(out)
+        return checkpoint_name(
+            nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
+                            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            name="out")(out), "attn_out")
 
     def _decode_attend(self, q, k, v, positions):
         """KV-cache attention: write the S new (already-roped) K/V rows
@@ -261,12 +273,14 @@ class DenseFFN(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        wi = nn.Dense(2 * cfg.d_ff, use_bias=False, dtype=cfg.dtype,
-                      param_dtype=cfg.param_dtype, name="wi")(x)
+        wi = checkpoint_name(
+            nn.Dense(2 * cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="wi")(x), "mlp_wi")
         gate, up = jnp.split(wi, 2, axis=-1)
         h = nn.silu(gate) * up  # SwiGLU
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype, name="wo")(h)
+        return checkpoint_name(
+            nn.Dense(x.shape[-1], use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="wo")(h), "mlp_wo")
 
 
 class MoEFFN(nn.Module):
@@ -306,10 +320,13 @@ class MoEFFN(nn.Module):
 
         def expert_ffn(xe):
             """xe: [E, ..., D] per-expert token buffers."""
-            h = jnp.einsum("e...d,edf->e...f", xe, wi.astype(cfg.dtype))
+            h = checkpoint_name(
+                jnp.einsum("e...d,edf->e...f", xe, wi.astype(cfg.dtype)),
+                "moe_wi")
             gate_h, up = jnp.split(h, 2, axis=-1)
-            return jnp.einsum("e...f,efd->e...d", nn.silu(gate_h) * up,
-                              wo.astype(cfg.dtype))
+            return checkpoint_name(
+                jnp.einsum("e...f,efd->e...d", nn.silu(gate_h) * up,
+                           wo.astype(cfg.dtype)), "moe_wo")
 
         if cfg.moe_dispatch == "capacity":
             cap = int(np.ceil(cfg.capacity_factor * K * S / E))
@@ -424,7 +441,27 @@ class TransformerLM(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            policies = {
+                "nothing": None,
+                "dots": jax.checkpoint_policies.dots_saveable,
+                "dots_no_batch":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                # Keep every fat matmul output, recompute the cheap
+                # elementwise chain and the O(S^2) score block — the
+                # sweet spot when full activations don't fit but the
+                # linear-in-S tensors do.
+                "save_dense": jax.checkpoint_policies.save_only_these_names(
+                    "attn_q", "attn_k", "attn_v", "attn_out",
+                    "mlp_wi", "mlp_wo", "moe_wi", "moe_wo"),
+            }
+            try:
+                policy = policies[cfg.remat_policy]
+            except KeyError:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r} "
+                    f"(have {sorted(policies)})") from None
+            kw = {"policy": policy} if policy is not None else {}
+            block = nn.remat(Block, prevent_cse=False, **kw)
         ScanBlock = nn.scan(
             block,
             variable_axes={"params": 0, "aux_loss": 0, "cache": 0},

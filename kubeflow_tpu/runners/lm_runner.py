@@ -36,6 +36,13 @@ def parse_args(argv=None):
                    help="context parallel ways (ring attention over 'ctx')")
     p.add_argument("--experts", type=int, default=0, help="MoE experts (ep)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="nothing",
+                   choices=["nothing", "dots", "dots_no_batch",
+                            "save_dense"],
+                   help="what remat may KEEP (save_dense: fat matmul "
+                        "outputs stay, only elementwise + the S^2 "
+                        "block recompute; needs the linear-in-S saves "
+                        "to fit HBM)")
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "flash", "xla"],
                    help="attention path; 'auto' picks the pallas flash "
@@ -116,6 +123,7 @@ def main(argv=None) -> int:
         sp=args.sp,
         cp=args.cp,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         attn_impl=args.attn_impl,
         **flash_overrides,
     )

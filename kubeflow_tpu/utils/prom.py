@@ -9,6 +9,18 @@ from typing import Dict, List, Tuple, Union
 
 PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+
+def _esc_label(v: str) -> str:
+    """Exposition-format label-value escaping: backslash, quote,
+    newline. A raw quote or newline in a label (e.g. a model name from
+    user manifest metadata) would fail the whole scrape."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _esc_help(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
 # value: a bare number, or {label-dict-as-tuple...} — see prom_text.
 Value = Union[int, float, List[Tuple[Dict[str, str], Union[int, float]]]]
 
@@ -22,11 +34,12 @@ def prom_text(metrics: List[Tuple[str, str, str, Value]]) -> str:
     """
     lines: List[str] = []
     for name, mtype, help_, value in metrics:
-        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# HELP {name} {_esc_help(help_)}")
         lines.append(f"# TYPE {name} {mtype}")
         if isinstance(value, list):
             for labels, v in value:
-                lab = ",".join(f'{k}="{v_}"' for k, v_ in labels.items())
+                lab = ",".join(f'{k}="{_esc_label(v_)}"'
+                               for k, v_ in labels.items())
                 lines.append(f"{name}{{{lab}}} {v}")
         else:
             lines.append(f"{name} {value}")

@@ -129,6 +129,51 @@ class TestShardedTraining:
             s2, l2, _ = loop2.train_step(s2, toks)
             assert abs(l1 - l2) < 5e-2, (step, l1, l2)
 
+    @pytest.mark.parametrize("n_experts", [0, 4])
+    def test_remat_policy_is_numerically_free(self, tiny_cfg, n_experts):
+        """Selective remat (save_dense: keep fat matmul outputs,
+        recompute the elementwise chain + S^2 block) is a memory/speed
+        layout choice — losses must track full remat exactly, for the
+        dense FFN and the MoE FFN (both carry checkpoint tags)."""
+        import dataclasses
+
+        from kubeflow_tpu.data.lm import LMDataset
+        from kubeflow_tpu.parallel.lm_train import LMHyperParams, LMTrainLoop
+        from kubeflow_tpu.parallel.mesh import make_mesh
+
+        hp = LMHyperParams(total_steps=10, warmup_steps=2, seed=0)
+        losses = {}
+        for policy in ("nothing", "save_dense"):
+            cfg = dataclasses.replace(tiny_cfg, remat=True,
+                                      n_experts=n_experts,
+                                      remat_policy=policy)
+            mesh, plan = make_mesh(8, tp=2)
+            loop = LMTrainLoop(cfg, mesh, plan, hp)
+            state = loop.init_state()
+            ds = LMDataset(vocab_size=cfg.vocab_size, seq_len=32)
+            it = ds.batches(16)
+            ls = []
+            for _ in range(4):
+                state, loss, _ = loop.train_step(state, next(it))
+                ls.append(loss)
+            losses[policy] = ls
+        assert np.allclose(losses["nothing"], losses["save_dense"],
+                           atol=1e-4), losses
+
+    def test_remat_policy_unknown_rejected(self, tiny_cfg):
+        import dataclasses
+
+        import jax
+
+        from kubeflow_tpu.models.transformer import TransformerLM
+
+        cfg = dataclasses.replace(tiny_cfg, remat=True,
+                                  remat_policy="bogus")
+        with pytest.raises(ValueError, match="remat_policy"):
+            TransformerLM(cfg).init(
+                jax.random.PRNGKey(0),
+                np.zeros((1, 8), np.int32))
+
     def test_cp_matches_no_cp(self, tiny_cfg):
         """Context parallelism (ring attention over "ctx") is numerically
         a layout choice: training with cp=2 must track the cp=1 loop."""
