@@ -123,6 +123,18 @@ class Experiment(Resource):
         tmpl = self.trial_template()
         if not tmpl.get("trialSpec"):
             raise ValidationError("spec.trialTemplate.trialSpec", "required")
+        mc = self.metrics_collector_spec()
+        ckind = (mc.get("collector") or {}).get("kind", "StdOut")
+        if ckind not in ("StdOut", "File"):
+            raise ValidationError(
+                "spec.metricsCollectorSpec.collector.kind",
+                f"{ckind!r} not one of StdOut/File")
+        if ckind == "File" and not (((mc.get("source") or {})
+                                     .get("fileSystemPath") or {})
+                                    .get("path")):
+            raise ValidationError(
+                "spec.metricsCollectorSpec.source.fileSystemPath.path",
+                "required for a File collector")
 
     # -- status helpers ----------------------------------------------------
     def trials_summary(self) -> Dict[str, int]:

@@ -114,6 +114,10 @@ class InferenceService(Resource):
                 f"one of {PREDICTOR_FRAMEWORKS} (or containers) required")
         if fw != "custom" and not self.storage_uri():
             raise ValidationError(f"spec.predictor.{fw}.storageUri", "required")
+        if fw == "custom" and not self.predictor_config().get("command"):
+            raise ValidationError(
+                "spec.predictor.containers[0].command",
+                "required for a custom predictor")
         pct = self.canary_traffic_percent()
         if not 0 <= pct <= 100:
             raise ValidationError("spec.predictor.canaryTrafficPercent",

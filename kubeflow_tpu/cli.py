@@ -187,6 +187,21 @@ class KfxCLI:
         print(f"{cls.KIND.lower()}/{name} deleted")
         return 0
 
+    def delete_files(self, paths: List[str]) -> int:
+        """kfctl-delete model: tear down everything the manifests (or a
+        KfDef) render, in REVERSE apply order so dependents go before
+        the profiles/defaults they hang off."""
+        from .core.store import NotFound
+
+        def delete(kind: str, name: str, ns: str) -> bool:
+            try:
+                self.cp.store.delete(kind, name, ns)
+                return True
+            except (NotFound, KeyError):
+                return False
+
+        return _delete_rendered(paths, delete)
+
     def logs(self, kind: str, name: str, namespace: str, replica: str) -> int:
         cls = resource_class(kind)
         print(self.cp.job_logs(cls.KIND, name, namespace, replica), end="")
@@ -280,9 +295,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("kind")
     sp.add_argument("name")
 
-    sp = sub.add_parser("delete", help="delete a resource")
-    sp.add_argument("kind")
-    sp.add_argument("name")
+    sp = sub.add_parser("delete", help="delete a resource (or every "
+                                       "resource in manifest files)")
+    sp.add_argument("kind", nargs="?")
+    sp.add_argument("name", nargs="?")
+    sp.add_argument("-f", "--filename", action="append", default=[],
+                    help="delete everything a manifest (or KfDef) "
+                         "renders, in reverse apply order — the kfctl "
+                         "delete model")
 
     sp = sub.add_parser("logs", help="print replica logs")
     sp.add_argument("kind")
@@ -453,6 +473,12 @@ def _main(argv: Optional[List[str]] = None) -> int:
         if args.cmd == "describe":
             return cli.describe(args.kind, args.name, args.namespace)
         if args.cmd == "delete":
+            if args.filename:
+                return cli.delete_files(args.filename)
+            if not (args.kind and args.name):
+                print("error: delete needs KIND NAME or -f FILE",
+                      file=sys.stderr)
+                return 2
             return cli.delete(args.kind, args.name, args.namespace)
         if args.cmd == "logs":
             return cli.logs(args.kind, args.name, args.namespace, args.replica)
@@ -464,6 +490,35 @@ def _main(argv: Optional[List[str]] = None) -> int:
         if args.cmd == "profile":
             return cli.profile(args.kind, args.name, args.namespace,
                                args.replica, args.duration_ms, args.logdir)
+    return 0
+
+
+def _delete_rendered(paths: List[str], delete) -> int:
+    """Shared `delete -f` engine (local store and remote client modes):
+    expand each manifest/KfDef, normalize kinds through the registry —
+    the apply path accepts lowercase/plural spellings, so delete must
+    too, or a `kind: jaxjob` manifest would "delete" nothing while
+    reporting success — and remove in reverse apply order.
+    ``delete(kind, name, ns) -> bool`` returns False for already-gone."""
+    from .kfctl import expand_manifest_file
+
+    docs: List[dict] = []
+    for path in paths:
+        docs.extend(expand_manifest_file(path))
+    for doc in reversed(docs):
+        raw_kind = str(doc.get("kind", ""))
+        try:
+            kind = resource_class(raw_kind).KIND
+        except KeyError:
+            print(f"{raw_kind.lower()}: unknown kind, skipped")
+            continue
+        meta = doc.get("metadata") or {}
+        name = str(meta.get("name", ""))
+        ns = str(meta.get("namespace", "default"))
+        if delete(kind, name, ns):
+            print(f"{kind.lower()}/{name} deleted")
+        else:
+            print(f"{kind.lower()}/{name} not found (already gone)")
     return 0
 
 
@@ -573,6 +628,23 @@ def _remote_dispatch(client, args) -> int:
                       f"{e['message']}")
         return 0
     if args.cmd == "delete":
+        if getattr(args, "filename", None):
+            from .apiserver import ApiError
+
+            def delete(kind: str, name: str, ns: str) -> bool:
+                try:
+                    client.delete(kind, ns, name)
+                    return True
+                except ApiError as e:
+                    if e.status != 404:
+                        raise
+                    return False
+
+            return _delete_rendered(args.filename, delete)
+        if not (args.kind and args.name):
+            print("error: delete needs KIND NAME or -f FILE",
+                  file=sys.stderr)
+            return 2
         client.delete(args.kind, args.namespace, args.name)
         print(f"{args.kind.lower()}/{args.name} deleted")
         return 0

@@ -106,10 +106,43 @@ class TrialController(Controller):
         gang = self.gangs.get(gkey)
         path = gang.log_path(rid) if gang is not None else os.path.join(
             self.gangs.workdir_for(gkey), "logs", f"{rid}.log")
-        if not os.path.exists(path):
+        return self._read_text(path)
+
+    @staticmethod
+    def _read_text(path: str) -> str:
+        if not path or not os.path.isfile(path):
             return ""
         with open(path, "r", errors="replace") as f:
             return f.read()
+
+    def _collector_file_path(self, trial: K.Trial, gkey: str
+                             ) -> Optional[str]:
+        """For a File collector: the resolved metrics-file path
+        (relative paths live under the trial job's workdir — the
+        reference mounts an emptyDir at /var/log/katib; here the gang
+        workdir is the scratch the runner sees as cwd). None for
+        StdOut/other collectors."""
+        spec = trial.spec.get("metricsCollectorSpec") or {}
+        kind = ((spec.get("collector") or {}).get("kind")) or "StdOut"
+        if kind != "File":
+            return None
+        path = (((spec.get("source") or {})
+                 .get("fileSystemPath") or {}).get("path")) or ""
+        if not path:
+            return ""  # validated at apply; belt for direct store writes
+        if not os.path.isabs(path):
+            path = os.path.join(self.gangs.workdir_for(gkey), path)
+        return path
+
+    def _metrics_text(self, trial: K.Trial, job) -> str:
+        """The metrics source per the collector spec (Katib collector
+        kinds, SURVEY.md §2.2 metrics-collector row): StdOut (default)
+        tails the chief log; File reads source.fileSystemPath.path."""
+        gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
+        file_path = self._collector_file_path(trial, gkey)
+        if file_path is not None:
+            return self._read_text(file_path)
+        return self._chief_log(job)
 
     def on_delete(self, obj: Resource) -> None:
         assert isinstance(obj, K.Trial)
@@ -171,7 +204,7 @@ class TrialController(Controller):
             (trial.spec.get("objective") or {}).get(
                 "additionalMetricNames") or [])
         metric_names = [m for m in metric_names if m]
-        text = self._chief_log(job)
+        text = self._metrics_text(trial, job)
         observations = parse_metrics_text(text, metric_names)
         self.observations.report(trial.key, observations)
         summary = summarize(observations)
@@ -220,12 +253,15 @@ class TrialController(Controller):
         if job is None:
             return None
         gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
-        rid = f"{job.chief_replica_type().lower()}-0"
-        gang = self.gangs.get(gkey)
-        path = gang.log_path(rid) if gang is not None else os.path.join(
-            self.gangs.workdir_for(gkey), "logs", f"{rid}.log")
+        # Early stopping watches the same source the collector reads.
+        path = self._collector_file_path(trial, gkey)
+        if path is None:
+            rid = f"{job.chief_replica_type().lower()}-0"
+            gang = self.gangs.get(gkey)
+            path = gang.log_path(rid) if gang is not None else os.path.join(
+                self.gangs.workdir_for(gkey), "logs", f"{rid}.log")
         offset, last = self._live_tail.get(trial.key, (0, None))
-        if not os.path.exists(path):
+        if not path or not os.path.isfile(path):
             return last
         with open(path, "rb") as f:
             f.seek(offset)
@@ -430,6 +466,7 @@ class ExperimentController(Controller):
                     {"name": k, "value": v} for k, v in a.items()],
                 "runSpec": run_spec,
                 "objective": exp.objective(),
+                "metricsCollectorSpec": exp.metrics_collector_spec(),
             })
             trial.metadata.name = name
             trial.metadata.namespace = exp.namespace

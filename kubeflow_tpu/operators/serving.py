@@ -26,6 +26,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -57,7 +58,8 @@ class _Revision:
     def __init__(self, name: str, model_name: str, model_dir: str,
                  workdir: str, batcher: Optional[dict],
                  device: str = "auto", role: str = "predictor",
-                 graph: Optional[dict] = None):
+                 graph: Optional[dict] = None,
+                 container: Optional[dict] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -66,13 +68,46 @@ class _Revision:
         self.device = device
         self.role = role
         self.graph = graph or {}
+        # KFServing custom-predictor parity: a user-provided container
+        # command serves the port instead of a framework server. The
+        # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
+        # references expand, k8s container semantics).
+        self.container = container
         self.replicas: List[_Replica] = []
         self.restarts = 0
+        self.spawn_error = ""  # last custom-container launch failure
         # (timestamp, desired) samples for the autoscaler's damping window.
         self.scale_window: "collections.deque" = collections.deque()
 
     def spawn(self) -> None:
         port = free_port()
+        if self.container is not None:
+            from ..runtime.gang import expand_k8s_refs
+
+            env = inject_pythonpath(dict(os.environ))
+            for e in self.container.get("env") or []:
+                env[str(e.get("name"))] = str(e.get("value"))
+            env["KFX_PORT"] = env["PORT"] = str(port)
+            env["KFX_MODEL_NAME"] = self.model_name
+            argv = [expand_k8s_refs(a, env)
+                    for a in (list(self.container.get("command") or [])
+                              + list(self.container.get("args") or []))]
+            os.makedirs(self.workdir, exist_ok=True)
+            log_path = os.path.join(
+                self.workdir, f"{self.name}-{len(self.replicas)}.log")
+            with open(log_path, "ab") as logf:
+                try:
+                    proc = subprocess.Popen(argv, env=env, stdout=logf,
+                                            stderr=subprocess.STDOUT)
+                except OSError as e:
+                    # A typo'd binary must surface as a status/event,
+                    # not a reconcile crash-retry loop.
+                    logf.write(f"spawn failed: {e}\n".encode())
+                    self.spawn_error = f"{argv[:1]}: {e}"
+                    return
+            self.spawn_error = ""
+            self.replicas.append(_Replica(proc=proc, port=port))
+            return
         if self.role == "predictor":
             argv = [sys.executable, "-m", "kubeflow_tpu.serving.server",
                     f"--model-dir={self.model_dir}",
@@ -116,7 +151,10 @@ class _Revision:
                 self.restarts += 1
         self.replicas = alive
         while len(self.replicas) < want:
+            before = len(self.replicas)
             self.spawn()
+            if len(self.replicas) == before:
+                break  # launch failed (spawn_error set); retry next pass
         while len(self.replicas) > want:
             r = self.replicas.pop()
             r.proc.terminate()
@@ -131,7 +169,12 @@ class _Revision:
                             f"http://127.0.0.1:{r.port}/v1/models/"
                             f"{self.model_name}", timeout=1.0) as resp:
                         r.ready = json.load(resp).get("ready", False)
-                except OSError:
+                except urllib.error.HTTPError:
+                    # A custom server answered HTTP but doesn't speak
+                    # the V1 readiness route: it is up — its protocol
+                    # is its own business (KFServing probes the port).
+                    r.ready = self.container is not None
+                except (OSError, ValueError):
                     r.ready = False
             if r.ready:
                 n += 1
@@ -161,6 +204,8 @@ class _IsvcRuntime:
         # per-revision flag at the next reconcile.
         self.cold_pending = False
         self.cold_hit: Dict[str, bool] = {}
+        # Last spawn failure surfaced per revision (event dedup).
+        self.reported_spawn_error: Dict[str, str] = {}
 
 
 class InferenceServiceController(Controller):
@@ -246,13 +291,20 @@ class InferenceServiceController(Controller):
                     rev.teardown()
                     del rt.revisions[rev_name]
                 continue
-            model_dir = _resolve_storage_uri(
-                spec_storage_uri(spec),
-                os.path.join(self.home, "storage-cache"))
+            container = (spec.get("containers") or [None])[0]
+            if container is not None:
+                # Custom predictor: the user command owns model loading;
+                # there is no storage URI to initialize.
+                model_dir = ""
+            else:
+                model_dir = _resolve_storage_uri(
+                    spec_storage_uri(spec),
+                    os.path.join(self.home, "storage-cache"))
             batcher = spec.get("batcher")
             device = str(spec.get("device", "auto"))
             if rev is None or rev.model_dir != model_dir \
-                    or rev.device != device or rev.batcher != batcher:
+                    or rev.device != device or rev.batcher != batcher \
+                    or rev.container != container:
                 if rev is not None:
                     rev.teardown()
                 rev = _Revision(
@@ -263,10 +315,12 @@ class InferenceServiceController(Controller):
                                          key.replace("/", "_")),
                     batcher=batcher,
                     device=device,
+                    container=container,
                 )
                 rt.revisions[rev_name] = rev
                 self.record_event(isvc, "Normal", "RevisionCreated",
-                                  f"{rev_name} -> {model_dir}")
+                                  f"{rev_name} -> "
+                                  f"{model_dir or 'custom container'}")
             want = int(spec.get("minReplicas", 1))
             if want == 0 and rt.cold_hit.get(rev_name):
                 # Activator: scale from zero on traffic — and back to zero
@@ -325,6 +379,15 @@ class InferenceServiceController(Controller):
                     [f"127.0.0.1:{r.port}"
                      for r in rev.replicas[:want] if r.ready])
             rev.reap_and_respawn(want)
+            if rev.spawn_error:
+                # Launch failure (e.g. typo'd custom command): surface
+                # once per distinct error; the respawn loop keeps
+                # retrying (CrashLoopBackOff-style) without crashing
+                # the reconcile.
+                if rt.reported_spawn_error.get(rev_name) != rev.spawn_error:
+                    rt.reported_spawn_error[rev_name] = rev.spawn_error
+                    self.record_event(isvc, "Warning", "SpawnFailed",
+                                      f"{rev_name}: {rev.spawn_error}")
             ready = rev.probe()
             # Readiness is judged against the spec's guarantee (base
             # replicas), not the autoscaler's transient target — a burst

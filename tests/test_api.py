@@ -242,6 +242,20 @@ spec:
         with pytest.raises(ValidationError, match="min > max"):
             load_manifests(bad)
 
+    def test_file_collector_requires_path(self):
+        """A pathless File collector would resolve to the workdir itself
+        at reconcile time; reject at apply."""
+        bad = self.EXPERIMENT_YAML.replace(
+            "spec:\n", "spec:\n  metricsCollectorSpec:\n"
+                       "    collector: {kind: File}\n", 1)
+        with pytest.raises(ValidationError, match="fileSystemPath"):
+            load_manifests(bad)
+        worse = self.EXPERIMENT_YAML.replace(
+            "spec:\n", "spec:\n  metricsCollectorSpec:\n"
+                       "    collector: {kind: TensorFlowEvent}\n", 1)
+        with pytest.raises(ValidationError, match="StdOut/File"):
+            load_manifests(worse)
+
 
 class TestInferenceService:
     ISVC_YAML = """
@@ -273,6 +287,29 @@ spec:
         bad = self.ISVC_YAML.replace("80", "180")
         with pytest.raises(ValidationError, match="canaryTrafficPercent"):
             load_manifests(bad)
+
+    def test_custom_predictor_requires_command(self):
+        """A command-less custom container would crash the operator's
+        spawn loop; it must be a 400 at apply time."""
+        with pytest.raises(ValidationError, match="command"):
+            load_manifests("""
+kind: InferenceService
+metadata: {name: c}
+spec:
+  predictor:
+    containers:
+    - name: server
+""")
+        (ok,) = load_manifests("""
+kind: InferenceService
+metadata: {name: c}
+spec:
+  predictor:
+    containers:
+    - name: server
+      command: ["python", "serve.py"]
+""")
+        assert ok.predictor_framework() == "custom"
 
 
 class TestPodDefault:

@@ -441,6 +441,33 @@ spec:
                 f"search {searched_acc} vs random {rand_acc}")
             assert searched_acc > 0.8
 
+    def test_file_metrics_collector(self, tmp_path):
+        """Katib collector-kind parity: kind=File reads the objective
+        from source.fileSystemPath.path (relative to the trial job's
+        workdir) instead of the chief stdout log."""
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        text = EXPERIMENT.format(name="filecol", python=PY).replace(
+            "maxTrialCount: 4", "maxTrialCount: 2").replace(
+            "parallelTrialCount: 2", "parallelTrialCount: 1").replace(
+            "print('score=${trialParameters.x}')",
+            "open('metrics.out','w').write("
+            "'score=${trialParameters.x}')").replace(
+            "spec:\n  objective:",
+            "spec:\n  metricsCollectorSpec:\n"
+            "    collector: {kind: File}\n"
+            "    source: {fileSystemPath: {path: metrics.out}}\n"
+            "  objective:")
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply(load_manifests(text))
+            exp = cp.wait_for_condition("Experiment", "filecol",
+                                        "Succeeded", timeout=120)
+            assert exp.status["trialsSucceeded"] == 2
+            best = exp.status["currentOptimalTrial"]
+            assert best["observation"]["metrics"][0]["name"] == "score"
+
     def test_goal_stops_early(self, tmp_path):
         from kubeflow_tpu.api.manifest import load_manifests
         from kubeflow_tpu.controlplane import ControlPlane

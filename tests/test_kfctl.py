@@ -166,3 +166,38 @@ class TestKfxVerbs:
         assert "steps=3" in out
         assert "team_env=a" in out  # PodDefault env reached the worker
         assert "jaxjob/platform-job succeeded" in out
+
+    def test_delete_kfdef_tears_down_platform(self, kfdef_dir, capsys):
+        """`kfx delete -f kfdef.yaml` (kfctl delete parity): everything
+        the KfDef rendered is removed in reverse apply order; a second
+        delete reports already-gone instead of failing."""
+        from kubeflow_tpu.cli import main as kfx_main
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        home = str(kfdef_dir / "home")
+        rc = kfx_main(["--home", home, "run", "-f",
+                       str(kfdef_dir / "kfdef.yaml")])
+        assert rc == 0
+        capsys.readouterr()
+        rc = kfx_main(["--home", home, "delete", "-f",
+                       str(kfdef_dir / "kfdef.yaml")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        # reverse apply order: the job goes before the profile it's in
+        assert out.index("jaxjob/platform-job deleted") < \
+            out.index("profile/team-a deleted")
+        with ControlPlane(home=home, journal=True, passive=True) as cp:
+            assert not cp.store.list("Profile")
+            assert not cp.store.list("JAXJob")
+            assert not cp.store.list("PodDefault")
+        rc = kfx_main(["--home", home, "delete", "-f",
+                       str(kfdef_dir / "kfdef.yaml")])
+        out = capsys.readouterr().out
+        assert rc == 0 and "already gone" in out
+
+    def test_delete_without_target_is_usage_error(self, tmp_path, capsys):
+        from kubeflow_tpu.cli import main as kfx_main
+
+        rc = kfx_main(["--home", str(tmp_path / "h"), "delete"])
+        assert rc == 2
+        assert "KIND NAME or -f FILE" in capsys.readouterr().err

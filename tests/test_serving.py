@@ -379,6 +379,102 @@ spec:
                               {"instances": x.tolist()}, timeout=60)
             assert status == 200
 
+    def test_custom_predictor_container(self, tmp_path):
+        """KFServing custom-predictor parity (SURVEY.md §2.1 KFServing
+        row): spec.predictor.containers[0] runs a user command that owns
+        the port; the operator supervises it, probes readiness, and the
+        router serves its traffic like any framework server."""
+        import textwrap
+        import time
+
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        script = textwrap.dedent("""
+            import json, os
+            from http.server import BaseHTTPRequestHandler, HTTPServer
+
+            name = os.environ["KFX_MODEL_NAME"]
+
+            class H(BaseHTTPRequestHandler):
+                def log_message(self, *a):
+                    pass
+                def _send(self, obj):
+                    body = json.dumps(obj).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                def do_GET(self):
+                    self._send({"ready": True, "name": name})
+                def do_POST(self):
+                    n = int(self.headers.get("Content-Length") or 0)
+                    req = json.loads(self.rfile.read(n))
+                    self._send({"predictions": [
+                        sum(row) for row in req["instances"]]})
+
+            HTTPServer(("127.0.0.1", int(os.environ["KFX_PORT"])),
+                       H).serve_forever()
+        """)
+        path = tmp_path / "custom_server.py"
+        path.write_text(script)
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: custom-echo
+spec:
+  predictor:
+    minReplicas: 1
+    containers:
+    - name: server
+      command: ["{sys.executable}", "{path}"]
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "custom-echo",
+                                         "Ready", timeout=60)
+            url = isvc.status["url"]
+            status, body = _post(f"{url}/v1/models/custom-echo:predict",
+                                 {"instances": [[1, 2], [3, 4]]},
+                                 timeout=30)
+            assert status == 200 and body["predictions"] == [3, 7]
+
+    def test_custom_predictor_spawn_failure_surfaces(self, tmp_path):
+        """A typo'd custom command must become a SpawnFailed event and a
+        NotReady service, never a reconcile crash loop."""
+        import time
+
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = """
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: typo
+spec:
+  predictor:
+    minReplicas: 1
+    containers:
+    - name: server
+      command: ["/no/such/binary-kfx-test"]
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                evs = [e for e in cp.store.events_for(
+                    "InferenceService", "default/typo")
+                    if e.reason == "SpawnFailed"]
+                if evs:
+                    break
+                time.sleep(0.2)
+            assert evs, "no SpawnFailed event"
+            assert "binary-kfx-test" in evs[0].message
+            cur = cp.store.get("InferenceService", "typo")
+            assert not cur.has_condition("Ready")
+
     def test_inferenceservice_survives_controlplane_restart(
             self, export_dir, tmp_path):
         """A journaled control plane restart must bring an
