@@ -125,16 +125,16 @@ class Experiment(Resource):
             raise ValidationError("spec.trialTemplate.trialSpec", "required")
         mc = self.metrics_collector_spec()
         ckind = (mc.get("collector") or {}).get("kind", "StdOut")
-        if ckind not in ("StdOut", "File"):
+        if ckind not in ("StdOut", "File", "TensorFlowEvent"):
             raise ValidationError(
                 "spec.metricsCollectorSpec.collector.kind",
-                f"{ckind!r} not one of StdOut/File")
-        if ckind == "File" and not (((mc.get("source") or {})
-                                     .get("fileSystemPath") or {})
-                                    .get("path")):
+                f"{ckind!r} not one of StdOut/File/TensorFlowEvent")
+        if ckind in ("File", "TensorFlowEvent") and not (
+                ((mc.get("source") or {}).get("fileSystemPath") or {})
+                .get("path")):
             raise ValidationError(
                 "spec.metricsCollectorSpec.source.fileSystemPath.path",
-                "required for a File collector")
+                f"required for a {ckind} collector")
 
     # -- status helpers ----------------------------------------------------
     def trials_summary(self) -> Dict[str, int]:

@@ -41,6 +41,55 @@ def parse_metrics_text(text: str, wanted: List[str]) -> List[Dict]:
     return out
 
 
+def parse_tfevents(dir_path: str, wanted: List[str]) -> List[Dict]:
+    """TensorFlowEvent collector kind (Katib's third collector,
+    SURVEY.md §2.2 metrics-collector row): scan a directory of
+    ``events.out.tfevents.*`` files for scalar summaries whose tag is a
+    wanted metric name. Handles both TF1-style simple_value scalars and
+    TF2 ``tf.summary.scalar`` tensor encodings. Returns the same
+    [{name, value, step}] shape as the stdout parser."""
+    import glob
+    import os
+
+    if not dir_path or not os.path.isdir(dir_path):
+        return []
+    files = sorted(glob.glob(os.path.join(dir_path, "**",
+                                          "events.out.tfevents.*"),
+                             recursive=True))
+    if not files:
+        return []
+    try:
+        import tensorflow as tf  # heavy: only on the TensorFlowEvent path
+    except ImportError:
+        # No TF on this control plane: no observations. The trial then
+        # finishes MetricsUnavailable/Failed — a clear outcome instead
+        # of an ImportError retry loop in the reconciler.
+        return []
+
+    out: List[Dict] = []
+    for path in files:
+        try:
+            for event in tf.compat.v1.train.summary_iterator(path):
+                for v in getattr(event.summary, "value", []):
+                    if v.tag not in wanted:
+                        continue
+                    if v.HasField("simple_value"):
+                        val = float(v.simple_value)
+                    elif v.HasField("tensor"):
+                        try:
+                            val = float(tf.make_ndarray(v.tensor))
+                        except Exception:
+                            continue
+                    else:
+                        continue
+                    out.append({"name": v.tag, "value": val,
+                                "step": int(event.step)})
+        except Exception:
+            continue  # truncated in-progress file: keep what parsed
+    out.sort(key=lambda ob: ob["step"])
+    return out
+
+
 def summarize(observations: List[Dict]) -> Dict[str, Dict[str, float]]:
     """Per-metric {latest, min, max} — the shape Katib reports in
     trial.status.observation."""

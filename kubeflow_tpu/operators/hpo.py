@@ -26,7 +26,12 @@ from ..api import katib as K
 from ..api.base import Resource, from_manifest, utcnow
 from ..core.controller import Controller, Result
 from ..core.store import AlreadyExists, Conflict, NotFound, ResourceStore
-from ..hpo.collector import ObservationStore, parse_metrics_text, summarize
+from ..hpo.collector import (
+    ObservationStore,
+    parse_metrics_text,
+    parse_tfevents,
+    summarize,
+)
 from ..hpo.service import SuggestionClient, shared_suggestion_address
 from ..runtime.gang import GangManager
 
@@ -81,6 +86,8 @@ class TrialController(Controller):
         # trial key -> (log byte offset, last objective value) for the
         # incremental early-stopping tail.
         self._live_tail: Dict[str, Any] = {}
+        # TensorFlowEvent live-objective cache: (dir snapshot, value).
+        self._tfev_cache: Dict[str, Any] = {}
 
     # -- helpers ------------------------------------------------------------
     @staticmethod
@@ -115,34 +122,35 @@ class TrialController(Controller):
         with open(path, "r", errors="replace") as f:
             return f.read()
 
-    def _collector_file_path(self, trial: K.Trial, gkey: str
-                             ) -> Optional[str]:
-        """For a File collector: the resolved metrics-file path
-        (relative paths live under the trial job's workdir — the
-        reference mounts an emptyDir at /var/log/katib; here the gang
-        workdir is the scratch the runner sees as cwd). None for
-        StdOut/other collectors."""
+    def _collector_kind_path(self, trial: K.Trial, gkey: str
+                             ) -> "tuple[str, str]":
+        """(collector kind, resolved source path). Relative paths live
+        under the trial job's workdir — the reference mounts an
+        emptyDir at /var/log/katib; here the gang workdir is the
+        scratch the runner sees as cwd. Path is "" for StdOut."""
         spec = trial.spec.get("metricsCollectorSpec") or {}
         kind = ((spec.get("collector") or {}).get("kind")) or "StdOut"
-        if kind != "File":
-            return None
+        if kind == "StdOut":
+            return kind, ""
         path = (((spec.get("source") or {})
                  .get("fileSystemPath") or {}).get("path")) or ""
-        if not path:
-            return ""  # validated at apply; belt for direct store writes
-        if not os.path.isabs(path):
+        if path and not os.path.isabs(path):
             path = os.path.join(self.gangs.workdir_for(gkey), path)
-        return path
+        return kind, path
 
-    def _metrics_text(self, trial: K.Trial, job) -> str:
-        """The metrics source per the collector spec (Katib collector
-        kinds, SURVEY.md §2.2 metrics-collector row): StdOut (default)
-        tails the chief log; File reads source.fileSystemPath.path."""
+    def _collect_observations(self, trial: K.Trial, job,
+                              metric_names: List[str]) -> List[dict]:
+        """Observations per the collector spec (Katib collector kinds,
+        SURVEY.md §2.2 metrics-collector row): StdOut (default) parses
+        the chief log, File parses source.fileSystemPath.path,
+        TensorFlowEvent scans an event-file directory for scalar tags."""
         gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
-        file_path = self._collector_file_path(trial, gkey)
-        if file_path is not None:
-            return self._read_text(file_path)
-        return self._chief_log(job)
+        kind, path = self._collector_kind_path(trial, gkey)
+        if kind == "File":
+            return parse_metrics_text(self._read_text(path), metric_names)
+        if kind == "TensorFlowEvent":
+            return parse_tfevents(path, metric_names)
+        return parse_metrics_text(self._chief_log(job), metric_names)
 
     def on_delete(self, obj: Resource) -> None:
         assert isinstance(obj, K.Trial)
@@ -204,8 +212,7 @@ class TrialController(Controller):
             (trial.spec.get("objective") or {}).get(
                 "additionalMetricNames") or [])
         metric_names = [m for m in metric_names if m]
-        text = self._metrics_text(trial, job)
-        observations = parse_metrics_text(text, metric_names)
+        observations = self._collect_observations(trial, job, metric_names)
         self.observations.report(trial.key, observations)
         summary = summarize(observations)
         observation = {"metrics": [
@@ -254,8 +261,28 @@ class TrialController(Controller):
             return None
         gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
         # Early stopping watches the same source the collector reads.
-        path = self._collector_file_path(trial, gkey)
-        if path is None:
+        kind, path = self._collector_kind_path(trial, gkey)
+        if kind == "TensorFlowEvent":
+            # Full-dir re-decode only when the event files changed:
+            # early stopping polls every reconcile tick, and protobuf-
+            # decoding a growing directory each time would turn the
+            # control loop into continuous rescan work (the tfevent
+            # analogue of the byte-offset tail below).
+            import glob as _glob
+
+            snapshot = tuple(sorted(
+                (p, os.path.getsize(p))
+                for p in _glob.glob(os.path.join(
+                    path, "**", "events.out.tfevents.*"), recursive=True)
+                if os.path.isfile(p)))
+            cached = self._tfev_cache.get(trial.key)
+            if cached is not None and cached[0] == snapshot:
+                return cached[1]
+            obs = parse_tfevents(path, [metric])
+            value = obs[-1]["value"] if obs else None
+            self._tfev_cache[trial.key] = (snapshot, value)
+            return value
+        if kind == "StdOut":
             rid = f"{job.chief_replica_type().lower()}-0"
             gang = self.gangs.get(gkey)
             path = gang.log_path(rid) if gang is not None else os.path.join(

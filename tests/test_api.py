@@ -243,17 +243,19 @@ spec:
             load_manifests(bad)
 
     def test_file_collector_requires_path(self):
-        """A pathless File collector would resolve to the workdir itself
-        at reconcile time; reject at apply."""
-        bad = self.EXPERIMENT_YAML.replace(
-            "spec:\n", "spec:\n  metricsCollectorSpec:\n"
-                       "    collector: {kind: File}\n", 1)
-        with pytest.raises(ValidationError, match="fileSystemPath"):
-            load_manifests(bad)
+        """A pathless File/TensorFlowEvent collector would resolve to
+        the workdir itself at reconcile time; reject at apply."""
+        for kind in ("File", "TensorFlowEvent"):
+            bad = self.EXPERIMENT_YAML.replace(
+                "spec:\n", "spec:\n  metricsCollectorSpec:\n"
+                           f"    collector: {{kind: {kind}}}\n", 1)
+            with pytest.raises(ValidationError, match="fileSystemPath"):
+                load_manifests(bad)
         worse = self.EXPERIMENT_YAML.replace(
             "spec:\n", "spec:\n  metricsCollectorSpec:\n"
-                       "    collector: {kind: TensorFlowEvent}\n", 1)
-        with pytest.raises(ValidationError, match="StdOut/File"):
+                       "    collector: {kind: Bogus}\n", 1)
+        with pytest.raises(ValidationError,
+                           match="StdOut/File/TensorFlowEvent"):
             load_manifests(worse)
 
 

@@ -468,6 +468,57 @@ spec:
             best = exp.status["currentOptimalTrial"]
             assert best["observation"]["metrics"][0]["name"] == "score"
 
+    def test_parse_tfevents_unit(self, tmp_path):
+        """TF2 tf.summary scalars round-trip through the event parser."""
+        import tensorflow as tf
+
+        from kubeflow_tpu.hpo.collector import parse_tfevents
+
+        d = str(tmp_path / "ev")
+        w = tf.summary.create_file_writer(d)
+        with w.as_default():
+            for step, v in ((1, 0.5), (2, 0.75), (3, 0.9)):
+                tf.summary.scalar("score", v, step=step)
+                tf.summary.scalar("ignored", 0.0, step=step)
+        w.close()
+        obs = parse_tfevents(d, ["score"])
+        assert [(o["step"], round(o["value"], 2)) for o in obs] == \
+            [(1, 0.5), (2, 0.75), (3, 0.9)]
+        assert parse_tfevents(str(tmp_path / "nope"), ["score"]) == []
+
+    def test_tfevent_metrics_collector(self, tmp_path):
+        """Katib TensorFlowEvent collector parity: the trial writes
+        tf.summary scalars into an event dir; the collector reads the
+        objective from there, no stdout involvement."""
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        code = ("import tensorflow as tf; "
+                "w = tf.summary.create_file_writer('tfev'); "
+                "ctx = w.as_default(); ctx.__enter__(); "
+                "tf.summary.scalar('score', "
+                "float('${trialParameters.x}'), step=1); "
+                "ctx.__exit__(None, None, None); w.close()")
+        text = EXPERIMENT.format(name="tfev", python=PY).replace(
+            "maxTrialCount: 4", "maxTrialCount: 1").replace(
+            "parallelTrialCount: 2", "parallelTrialCount: 1").replace(
+            "print('score=${trialParameters.x}')", code).replace(
+            "spec:\n  objective:",
+            "spec:\n  metricsCollectorSpec:\n"
+            "    collector: {kind: TensorFlowEvent}\n"
+            "    source: {fileSystemPath: {path: tfev}}\n"
+            "  objective:")
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply(load_manifests(text))
+            exp = cp.wait_for_condition("Experiment", "tfev",
+                                        "Succeeded", timeout=180)
+            assert exp.status["trialsSucceeded"] == 1
+            best = exp.status["currentOptimalTrial"]
+            metric = best["observation"]["metrics"][0]
+            assert metric["name"] == "score"
+            assert 0.0 <= float(metric["latest"]) <= 1.0
+
     def test_goal_stops_early(self, tmp_path):
         from kubeflow_tpu.api.manifest import load_manifests
         from kubeflow_tpu.controlplane import ControlPlane
@@ -603,7 +654,7 @@ spec:
                           worker_platform="cpu") as cp:
             cp.apply(load_manifests(job_yaml))
             cp.apply(load_manifests(text))
-            deadline = time.monotonic() + 60
+            deadline = time.monotonic() + 120
             conflicted = None
             while time.monotonic() < deadline:
                 for t in cp.store.list("Trial"):
@@ -614,6 +665,22 @@ spec:
                 if conflicted:
                     break
                 time.sleep(0.2)
-            assert conflicted is not None
+            # Rich context on failure: this has flaked under full-suite
+            # load and the bare assert never said why.
+            state = {
+                "trials": [(t.name,
+                            [f"{c.type}={c.status}:{c.reason}"
+                             for c in t.conditions])
+                           for t in cp.store.list("Trial")],
+                "experiment": [f"{c.type}={c.status}:{c.reason}"
+                               for c in cp.store.get(
+                                   "Experiment", "adopt").conditions],
+                "jobs": [j.name for j in cp.store.list("JAXJob")],
+                "events": [(e.reason, e.message) for e in
+                           cp.store.events_for("Experiment",
+                                               "default/adopt")],
+            }
+            assert conflicted is not None, state
             # the unrelated job survives
-            assert cp.store.try_get("JAXJob", "adopt-0000") is not None
+            assert cp.store.try_get("JAXJob", "adopt-0000") is not None, \
+                state
