@@ -159,6 +159,12 @@ def main() -> int:
         lm.update(_bench_resnet50())
     if have_time(300):
         lm.update(_bench_lm_decode())
+    if have_time(300):
+        # Batched decode: the amortization story (docs/serving-latency
+        # .md) in one number — 4x the batch shares the same per-step
+        # dispatch. Estimate matches the base decode section: a new
+        # shape pays the same one-time compile.
+        lm.update(_bench_lm_decode(batch=16, prefix="lm_decode_b16_"))
     lm["bench_wall_s"] = round(time.time() - bench_t0, 1)
     out = {
         "metric": "mnist_jaxjob_wall_clock_s",
@@ -304,7 +310,8 @@ def _bench_baseline_configs(deadline: float) -> dict:
 
 
 def _bench_lm_decode(preset: str = "small", batch: int = 4,
-                     prompt_len: int = 64, max_new: int = 64) -> dict:
+                     prompt_len: int = 64, max_new: int = 64,
+                     prefix: str = "lm_decode_") -> dict:
     """Generation throughput: jitted KV-cache prefill + scan decode
     (models/generate.py) on the real TPU — decoded tokens per second
     across the batch, measured after the one-time compile."""
@@ -333,15 +340,15 @@ def _bench_lm_decode(preset: str = "small", batch: int = 4,
                          temperature=0.7, seed=i)
         dt = (time.perf_counter() - t0) / reps
         return {
-            "lm_decode_model": preset,
-            "lm_decode_batch": batch,
-            "lm_decode_prompt_len": prompt_len,
-            "lm_decode_new_tokens": max_new,
-            "lm_decode_tokens_per_s": round(batch * max_new / dt, 1),
-            "lm_decode_ms_per_token": round(dt / max_new * 1000, 2),
+            prefix + "model": preset,
+            prefix + "batch": batch,
+            prefix + "prompt_len": prompt_len,
+            prefix + "new_tokens": max_new,
+            prefix + "tokens_per_s": round(batch * max_new / dt, 1),
+            prefix + "ms_per_token": round(dt / max_new * 1000, 2),
         }
     except Exception as e:  # secondary metric must not sink the bench
-        return {"lm_decode_error": str(e)[:200]}
+        return {prefix + "error": str(e)[:200]}
 
 
 def _bench_resnet50(steps: int = 60, batch: int = 256) -> dict:
