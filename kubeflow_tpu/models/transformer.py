@@ -450,6 +450,12 @@ class TransformerLM(nn.Module):
                 # elementwise chain and the O(S^2) score block — the
                 # sweet spot when full activations don't fit but the
                 # linear-in-S tensors do.
+                # Measured dead end, recorded to save the next tuner the
+                # experiment: a narrower tag set (projections + FFN
+                # outputs, skipping the fat mlp_wi) FITS at S=2048 but
+                # measured ~1% SLOWER than full remat there — the flash
+                # backward recomputes its own block regardless, so the
+                # partial saves only add HBM traffic.
                 "save_dense": jax.checkpoint_policies.save_only_these_names(
                     "attn_q", "attn_k", "attn_v", "attn_out",
                     "mlp_wi", "mlp_wo", "moe_wi", "moe_wo"),

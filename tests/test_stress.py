@@ -141,3 +141,162 @@ class TestControlPlaneStress:
                     break
                 time.sleep(0.2)
             assert cp.store.list("JAXJob") == []
+
+    def test_mixed_workload_storm_converges(self, tmp_path):
+        """Every controller family at once on one plane — jobs, an HPO
+        sweep, a pipeline, notebooks under a quota'd profile — applied
+        concurrently from threads: all workloads reach their terminal/
+        ready states and teardown leaves an empty store (no controller
+        starves another, no cross-kind deadlock)."""
+        from kubeflow_tpu.api.manifest import load_manifests
+
+        profile = """
+apiVersion: kubeflow.org/v1
+kind: Profile
+metadata: {name: storm}
+spec:
+  owner: {name: storm@example.com}
+  resourceQuotaSpec:
+    hard: {count/notebooks: 2}
+"""
+        experiment = f"""
+apiVersion: kubeflow.org/v1
+kind: Experiment
+metadata: {{name: storm-exp}}
+spec:
+  objective: {{type: maximize, objectiveMetricName: score}}
+  algorithm: {{algorithmName: random}}
+  maxTrialCount: 3
+  parallelTrialCount: 2
+  maxFailedTrialCount: 1
+  parameters:
+  - name: x
+    parameterType: double
+    feasibleSpace: {{min: "0.0", max: "1.0"}}
+  trialTemplate:
+    trialParameters: [{{name: x, reference: x}}]
+    trialSpec:
+      apiVersion: kubeflow.org/v1
+      kind: JAXJob
+      spec:
+        jaxReplicaSpecs:
+          Worker:
+            replicas: 1
+            restartPolicy: Never
+            template:
+              spec:
+                containers:
+                - name: t
+                  command: ["{PY}", "-c",
+                            "print('score=${{trialParameters.x}}')"]
+"""
+        pipeline = f"""
+apiVersion: kubeflow.org/v1
+kind: Pipeline
+metadata: {{name: storm-pipe}}
+spec:
+  steps:
+  - name: a
+    template:
+      spec:
+        containers:
+        - name: m
+          command: ["{PY}", "-c", "print('a')"]
+  - name: b
+    dependsOn: [a]
+    template:
+      spec:
+        containers:
+        - name: m
+          command: ["{PY}", "-c", "print('b')"]
+"""
+
+        def notebook(name):
+            return f"""
+apiVersion: kubeflow.org/v1
+kind: Notebook
+metadata: {{name: {name}, namespace: storm}}
+spec:
+  template:
+    spec:
+      containers:
+      - name: notebook
+        command: ["{PY}", "-c", "import time; time.sleep(600)"]
+"""
+
+        def jobs(prefix, n):
+            return "\n---\n".join(f"""
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata: {{name: {prefix}-{i}}}
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+          - name: m
+            command: ["{PY}", "-c", "print('ok')"]
+""" for i in range(n))
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply(load_manifests(profile))
+            manifests = [experiment, pipeline, jobs("storm-job", 6),
+                         notebook("storm-nb-0"), notebook("storm-nb-1")]
+            errors = []
+
+            def applier(text):
+                try:
+                    cp.apply(load_manifests(text))
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=applier, args=(m,))
+                       for m in manifests]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads), \
+                "an apply thread hung"
+            assert not errors, errors
+
+            # NotFound-safe waits with condition dumps on timeout.
+            cp.wait_for_condition("Experiment", "storm-exp", "Succeeded",
+                                  timeout=180)
+            cp.wait_for_condition("Pipeline", "storm-pipe", "Succeeded",
+                                  timeout=180)
+            for i in range(6):
+                cp.wait_for_condition("JAXJob", f"storm-job-{i}",
+                                      "Succeeded", timeout=180)
+            for i in range(2):
+                cp.wait_for_condition("Notebook", f"storm-nb-{i}",
+                                      "Ready", namespace="storm",
+                                      timeout=180)
+            deadline = time.monotonic() + 60
+
+            def wait(pred, what):
+                while time.monotonic() < deadline:
+                    if pred():
+                        return
+                    time.sleep(0.3)
+                raise AssertionError(f"storm did not converge: {what}")
+
+            # Teardown everything; the store must drain (cascades
+            # included: experiment -> trials -> trial jobs).
+            cp.store.delete("Experiment", "storm-exp")
+            cp.store.delete("Pipeline", "storm-pipe")
+            for i in range(6):
+                cp.store.delete("JAXJob", f"storm-job-{i}")
+            for i in range(2):
+                cp.store.delete("Notebook", f"storm-nb-{i}", "storm")
+            cp.store.delete("Profile", "storm")
+
+            def drained():
+                return all(not cp.store.list(k) for k in
+                           ("Experiment", "Trial", "Pipeline", "JAXJob",
+                            "Notebook", "Profile"))
+            wait(drained, "teardown drain")
