@@ -163,6 +163,38 @@ class TestModelServer:
             _post(f"{base}/v1/models/mnist:predict", {"wrong": 1})
         assert e.value.code == 400
 
+    @pytest.mark.slow
+    def test_vit_exports_and_serves(self, tmp_path):
+        """Every registry classifier rides the same export -> predictor
+        contract; prove it for the transformer family (ViT), not just
+        conv nets."""
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.serving.export import export_params
+        from kubeflow_tpu.serving.server import JaxPredictor
+        from kubeflow_tpu.training import TrainLoop
+
+        ds = get_dataset("mnist")
+        loop = TrainLoop(get_model("vit", num_classes=ds.num_classes))
+        state = loop.init_state(ds.shape)
+        for images, labels in ds.batches(128, steps=2):
+            state, *_ = loop.train_step(state, images, labels)
+        out = str(tmp_path / "vit-export")
+        export_params(out, "vit", ds.shape, ds.num_classes, state)
+        p = JaxPredictor(out, name="vit", max_batch_size=4)
+        p.load()
+        xe, _ = get_dataset("mnist", split="eval").eval_arrays(64)
+        preds = np.asarray(p.predict(xe)["predictions"])
+        assert preds.shape == (64,)
+        # Served predictions must match the in-process forward exactly
+        # (serving correctness, independent of how trained the model is).
+        import jax.numpy as jnp
+
+        model = get_model("vit", num_classes=ds.num_classes)
+        direct = np.asarray(jnp.argmax(model.apply(
+            {"params": state.params}, jnp.asarray(xe)), -1))
+        assert (preds == direct).mean() > 0.95  # bf16 ties may flip
+
     def test_metrics_prometheus_and_json(self, server):
         import urllib.request
 

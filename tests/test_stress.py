@@ -297,6 +297,6 @@ spec:
 
             def drained():
                 return all(not cp.store.list(k) for k in
-                           ("Experiment", "Trial", "Pipeline", "JAXJob",
-                            "Notebook", "Profile"))
+                           ("Experiment", "Suggestion", "Trial",
+                            "Pipeline", "JAXJob", "Notebook", "Profile"))
             wait(drained, "teardown drain")
