@@ -1,0 +1,248 @@
+"""Cross-process SPMD correctness check.
+
+On a real TPU pod the device mesh always spans processes (one per host);
+the reference frameworks prove their multi-host story with NCCL/MPI
+integration runs (SURVEY.md §2.3, §5.8). The TPU-native equivalent: the
+SAME `LMTrainLoop` jitted step, with the SAME NamedShardings, run
+
+  (a) in one process owning all devices of the mesh, and
+  (b) as a JAXJob-style gang of N processes, each owning a slice of the
+      mesh, rendezvoused through ``jax.distributed.initialize`` with gloo
+      CPU collectives (the DCN stand-in on this host),
+
+must produce per-step losses that agree to collective-reduction-order
+tolerance. GSPMD guarantees the per-device program is identical; the only
+legitimate difference is the order of cross-process reductions.
+
+Variants (2 processes x 4 devices):
+  * ``tp_fsdp`` — mesh (dp=4, tp=2): each process owns two dp rows, so
+    the fsdp all-gathers/reduce-scatters and the loss psum cross the
+    process boundary.
+  * ``cp`` — mesh (dp=1, cp=2, tp=4): the "ctx" axis is the OUTER
+    nontrivial axis, so ctx block 0 lives wholly in process 0 and block 1
+    in process 1 — the ring-attention ppermutes themselves cross the
+    process boundary (dp=2,cp=2 would keep the ring intra-process).
+
+The check is wired two ways:
+  * ``__graft_entry__.dryrun_multichip`` runs it as its cross-process tier
+    (2 processes x n/2 virtual CPU devices);
+  * ``tests/test_spmd_multiprocess.py`` runs both variants as tests.
+
+Data contract: the global batch is the concatenation of ``plan.dp``
+deterministic disjoint shards (``LMDataset.batches(shard_index=d,
+num_shards=dp)``). Each process feeds exactly the rows owned by its
+devices along the "data" axis (read off the mesh, not assumed from rank)
+through ``jax.make_array_from_process_local_data``; the single-process
+reference concatenates all rows. Both modes therefore consume the
+identical global batch — including the dp=1 case, where every process
+feeds the full (replicated) batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List
+
+CHECK_STEPS = 4
+GLOBAL_BATCH = 16
+VOCAB = 128
+SEQ = 32
+# Per-step loss agreement bound. f32 loss/grad accumulation; the only
+# divergence source is reduction order in the cross-process collectives.
+RTOL = 2e-3
+
+VARIANTS = ("tp_fsdp", "cp")
+
+
+def _build_loop(variant: str, n_devices: int):
+    from ..models.transformer import TransformerConfig
+    from .lm_train import LMHyperParams, LMTrainLoop
+    from .mesh import make_mesh
+
+    kw = dict(vocab_size=VOCAB, d_model=32, n_heads=4, head_dim=8,
+              n_layers=2, d_ff=64, max_seq_len=SEQ)
+    if variant == "cp":
+        # cp outermost-nontrivial (dp=1): the ring crosses processes.
+        tp = n_devices // 2
+        mesh, plan = make_mesh(n_devices, tp=tp, cp=2, fsdp=True)
+        cfg = TransformerConfig(cp=plan.cp, **kw)
+    elif variant == "tp_fsdp":
+        tp = 2 if n_devices % 2 == 0 else 1
+        mesh, plan = make_mesh(n_devices, tp=tp, fsdp=True)
+        cfg = TransformerConfig(**kw)
+    else:
+        raise ValueError(f"unknown variant {variant!r}; have {VARIANTS}")
+    hp = LMHyperParams(total_steps=CHECK_STEPS, warmup_steps=1)
+    return LMTrainLoop(cfg, mesh, plan, hp)
+
+
+def _owned_dp_rows(mesh, plan) -> List[int]:
+    """dp rows of the global batch this process must feed: every row whose
+    mesh block contains at least one of this process's devices (a fully
+    replicated row — dp=1 — is owned, and fed, by every process)."""
+    import jax
+
+    pid = jax.process_index()
+    arr = mesh.devices  # (pp, dp, cp, tp)
+    return [d for d in range(plan.dp)
+            if any(dev.process_index == pid for dev in arr[:, d].flat)]
+
+
+def run_losses(variant: str) -> List[float]:
+    """Train CHECK_STEPS steps; return the per-step losses.
+
+    Single- or multi-process; the global batch consumed per step is
+    identical in both modes (see module docstring)."""
+    import jax
+    import numpy as np
+
+    from ..data.lm import LMDataset
+
+    loop = _build_loop(variant, len(jax.devices()))
+    dp = loop.plan.dp
+    rows = (_owned_dp_rows(loop.mesh, loop.plan)
+            if jax.process_count() > 1 else list(range(dp)))
+    ds = LMDataset(vocab_size=VOCAB, seq_len=SEQ)
+    # Generate every shard stream everywhere (they are seeded per
+    # (step, shard), so this is cheap and keeps streams aligned); feed
+    # only the owned rows.
+    its = {d: ds.batches(GLOBAL_BATCH, shard_index=d, num_shards=dp)
+           for d in range(dp)}
+    state = loop.init_state()
+    losses = []
+    for _ in range(CHECK_STEPS):
+        shards = {d: next(it) for d, it in its.items()}
+        batch = np.concatenate([shards[d] for d in rows], axis=0)
+        state, loss, _ = loop.train_step(state, batch)
+        losses.append(float(loss))
+    return losses
+
+
+def assert_close(single: List[float], multi: List[float],
+                 rtol: float = RTOL) -> None:
+    if len(single) != len(multi):
+        raise AssertionError(f"step counts differ: {single} vs {multi}")
+    for i, (a, b) in enumerate(zip(single, multi)):
+        if abs(a - b) > rtol * max(1.0, abs(a)):
+            raise AssertionError(
+                f"step {i}: single-process loss {a} vs cross-process {b} "
+                f"(|delta|={abs(a - b):.3e} > rtol={rtol}); "
+                f"full: {single} vs {multi}")
+
+
+def cross_process_losses(variant: str, workdir: str, *, n_processes: int = 2,
+                         devices_per_proc: int = 4,
+                         timeout: float = 600.0) -> List[float]:
+    """Run ``run_losses(variant)`` as an n-process JAXJob-style gang on the
+    real gang runtime; returns rank 0's per-step losses."""
+    from ..api import training as T
+    from ..runtime import Gang, ProcessSpec, flatten_replicas, jax_env
+    from ..utils.net import free_port
+    from ..utils.proc import inject_pythonpath
+    from ..vmeshenv import virtual_mesh_env
+
+    out = os.path.join(workdir, "losses.json")
+    specs = []
+    for rtype, idx, rank in flatten_replicas([("Worker", n_processes)]):
+        # The rendezvous address is supplied by fresh_coordinator below on
+        # EVERY attempt (the gang runs the hook on attempt 0 too), so the
+        # spec-level value is a placeholder that is always overridden.
+        env = dict(virtual_mesh_env(devices_per_proc))
+        env.update(jax_env("spmd-check", "default", "coordinator-from-hook",
+                           n_processes, rank, rtype, idx, workdir,
+                           platform="cpu"))
+        inject_pythonpath(env)
+        specs.append(ProcessSpec(
+            replica_type=rtype, index=idx,
+            argv=[sys.executable, "-m", "kubeflow_tpu.parallel.spmd_check",
+                  "--variant", variant, "--out", out],
+            env=env))
+
+    def fresh_coordinator(attempt: int):
+        # Every attempt — first launch and whole-gang restarts (e.g. a
+        # rendezvous-port collision crash) — gets a freshly probed
+        # coordinator port: the self-healing contract the training
+        # operators use.
+        return {"*": {"KFX_COORDINATOR_ADDRESS": f"127.0.0.1:{free_port()}"}}
+
+    gang = Gang("spmd-check", specs, workdir, chief_replica_type="Worker",
+                restart_policy=T.RESTART_ON_FAILURE, backoff_limit=2,
+                restart_env_hook=fresh_coordinator)
+
+    # The gang's preexec_fn (PDEATHSIG) forces subprocess down the
+    # fork+exec path, which Python 3.12 warns about in multithreaded
+    # processes (jax is). The child exec's immediately, so the warning is
+    # noise — and it would dirty the driver's dryrun tail. Scoped: the
+    # monitor thread launches (and restarts) workers only while we block
+    # inside this context.
+    import warnings
+
+    try:
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=r"os\.fork\(\) was called",
+                category=RuntimeWarning)
+            gang.start()
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                st = gang.status()
+                if st.phase in ("Succeeded", "Failed", "Killed"):
+                    break
+                time.sleep(0.2)
+            else:
+                raise TimeoutError(
+                    f"spmd-check gang did not finish in {timeout}s")
+    finally:
+        gang.delete()
+    if st.phase != "Succeeded":
+        logs = "".join(
+            open(gang.log_path(s.id)).read() for s in specs
+            if os.path.exists(gang.log_path(s.id)))
+        raise RuntimeError(
+            f"spmd-check gang {st.phase}: {st.reason} {st.message}\n{logs}")
+    with open(out) as f:
+        return json.load(f)["losses"]
+
+
+def check(variant: str, workdir: str, *, n_processes: int = 2,
+          devices_per_proc: int = 4) -> List[float]:
+    """Cross-process vs single-process loss comparison (the full check).
+
+    Caller must already own ``n_processes * devices_per_proc`` devices
+    (the single-process reference runs in-process)."""
+    multi = cross_process_losses(variant, workdir, n_processes=n_processes,
+                                 devices_per_proc=devices_per_proc)
+    single = run_losses(variant)
+    assert_close(single, multi)
+    return multi
+
+
+def _worker_main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="spmd cross-process check worker")
+    p.add_argument("--variant", choices=VARIANTS, required=True)
+    p.add_argument("--out", required=True)
+    args = p.parse_args(argv)
+
+    from ..runners.jax_runner import initialize_distributed
+
+    initialize_distributed()
+
+    import jax
+
+    losses = run_losses(args.variant)
+    print(f"spmd_check_done rank={jax.process_index()} "
+          f"world={jax.process_count()} losses={losses}", flush=True)
+    if jax.process_index() == 0:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"variant": args.variant, "losses": losses}, f)
+        os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_worker_main())

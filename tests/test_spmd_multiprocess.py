@@ -1,0 +1,22 @@
+"""Cross-process SPMD: the sharded LM train step over a mesh that spans
+OS process boundaries (2 processes x 4 virtual CPU devices, gloo
+collectives via jax.distributed) must match the single-process 8-device
+run per-step (SURVEY.md §2.3/§5.8 — the multi-host training claim)."""
+
+import pytest
+
+from kubeflow_tpu.parallel import spmd_check
+
+
+@pytest.mark.slow
+class TestCrossProcessSPMD:
+    def test_tp_fsdp_matches_single_process(self, tmp_path):
+        """dp+tp+fsdp (dp=4, tp=2): each process owns two dp rows, so the
+        fsdp gather/scatter and loss psum collectives cross processes."""
+        spmd_check.check("tp_fsdp", str(tmp_path))
+
+    def test_cp_matches_single_process(self, tmp_path):
+        """Ring-attention context parallelism on a (dp=1, cp=2, tp=4) mesh:
+        ctx block 0 lives in process 0 and block 1 in process 1, so the
+        ring ppermutes themselves cross the process boundary."""
+        spmd_check.check("cp", str(tmp_path))
