@@ -91,8 +91,15 @@ class KfxCLI:
             print("nothing to wait for (no training jobs, experiments or "
                   "pipelines in manifests)")
             return 0
+        return self.wait_and_report(waitable, timeout, follow=follow)
+
+    def wait_and_report(self, objs: List[Resource], timeout: float,
+                        follow: bool = False) -> int:
+        """Wait for each object to finish and print its terminal state
+        (plus the best-trial summary for Experiments). Shared by `kfx
+        run` and the serverless `kfx apply` wait."""
         rc = 0
-        for obj in waitable:
+        for obj in objs:
             final = self._wait_streaming(
                 obj, timeout, follow and isinstance(obj, TrainingJob))
             state = _job_state(final)
@@ -450,14 +457,16 @@ def _main(argv: Optional[List[str]] = None) -> int:
                 return cli.run(args.filename, args.timeout, follow=False)
             applied = cli.apply(args.filename)
             # Without a persistent server, fire-and-forget gangs would die
-            # with this process; wait for the jobs applied HERE (not
+            # with this process; wait for the work applied HERE (not
             # suspended ones, not leftovers from prior invocations).
+            # Experiments count: exiting mid-sweep would strand trials
+            # Pending with no control plane to reconcile them.
             jobs = []
             for o in applied:
                 if isinstance(o, TrainingJob):
                     if o.is_finished() or o.run_policy().suspend:
                         continue
-                elif o.KIND != "Pipeline":
+                elif o.KIND not in ("Experiment", "Pipeline"):
                     continue
                 jobs.append(o)
             if jobs:
@@ -700,13 +709,8 @@ def _remote_wait(client, applied: List[dict], timeout: float,
     return rc
 
 
-def _wait_jobs(cli: KfxCLI, jobs: List[TrainingJob], timeout: float) -> int:
-    rc = 0
-    for job in jobs:
-        final = cli._wait_streaming(job, timeout, follow=False)
-        if _job_state(final) != "Succeeded":
-            rc = 1
-    return rc
+def _wait_jobs(cli: KfxCLI, jobs: List[Resource], timeout: float) -> int:
+    return cli.wait_and_report(jobs, timeout)
 
 
 if __name__ == "__main__":

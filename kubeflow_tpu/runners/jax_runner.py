@@ -133,7 +133,9 @@ def main(argv=None) -> int:
                             keep=args.keep_checkpoints)
         restored = ckpt.restore_latest(state)
         if restored is not None:
-            state = restored
+            # CLI hyperparams override the checkpointed ones (the
+            # checkpoint carries lr in opt_state via inject_hyperparams).
+            state = loop.reapply_hyperparams(restored)
             start_step = int(jax.device_get(state.step))
             log(f"resumed_from_checkpoint step={start_step}")
 

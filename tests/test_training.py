@@ -195,6 +195,45 @@ class TestCheckpoint:
         assert all(np.allclose(x, y) for x, y in zip(a, b))
         ckpt.close()
 
+    def test_resume_reapplies_cli_hyperparams(self, tmp_path):
+        """lr lives in opt_state (inject_hyperparams — one compiled step
+        for every HPO trial), so a resume must re-assert the CLI's lr
+        over the checkpointed one: restarting with a new --learning-rate
+        has to take effect, as it did when lr was a trace constant."""
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import Checkpointer, TrainLoop
+
+        ds = get_dataset("mnist")
+        loop1 = TrainLoop(get_model("mlp"), learning_rate=1e-3)
+        state = loop1.init_state(ds.shape)
+        ckpt = Checkpointer(str(tmp_path / "ck"), save_every=1)
+        ckpt.maybe_save(1, state, force=True)
+        ckpt.wait()
+
+        loop2 = TrainLoop(get_model("mlp"), learning_rate=5e-4)
+        restored = ckpt.restore_latest(loop2.init_state(ds.shape))
+        assert float(restored.opt_state.hyperparams[
+            "learning_rate"]) == pytest.approx(1e-3)  # checkpointed value
+        resumed = loop2.reapply_hyperparams(restored)
+        assert float(resumed.opt_state.hyperparams[
+            "learning_rate"]) == pytest.approx(5e-4)  # CLI wins
+        ckpt.close()
+
+    def test_incompatible_structure_falls_back_to_fresh(self, tmp_path, capfd):
+        """A checkpoint whose tree no longer matches the target (e.g.
+        written before an optimizer-state layout change) must degrade to
+        a fresh start, not crash the resuming job."""
+        import jax.numpy as jnp
+        from kubeflow_tpu.training import Checkpointer
+
+        ckpt = Checkpointer(str(tmp_path / "ck"), save_every=1)
+        ckpt.maybe_save(1, {"old_layout": jnp.zeros((2,))}, force=True)
+        ckpt.wait()
+        out = ckpt.restore_latest({"new_layout": {"nested": jnp.zeros((3,))}})
+        assert out is None
+        assert "checkpoint_restore_incompatible" in capfd.readouterr().out
+        ckpt.close()
+
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
