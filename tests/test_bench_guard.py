@@ -1,0 +1,97 @@
+"""Bench contamination guard: a framework worker process running during
+a bench section must be flagged in the JSON (round-3 postmortem: a
+concurrent session inflated the mnist number 13s→44s mid-run with the
+start-only guard blind to it), while the bench's own worker tree —
+children AND grandchildren like mpi-launcher ranks — must not be."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import bench
+
+# A root pid that exists in no process's ancestry: with this root, every
+# planted process looks foreign (tests can't create true foreign
+# processes — everything they spawn descends from pytest).
+FOREIGN_ROOT = 2 ** 22 + 12345
+
+
+def _spawn_marker_grandchild():
+    """helper (our child) -> marker (our grandchild); the helper stays
+    alive so the sandbox doesn't reap the marker as an orphan."""
+    helper = subprocess.Popen(
+        [sys.executable, "-c",
+         "import subprocess, sys, time\n"
+         "p = subprocess.Popen([sys.executable, '-c',"
+         " 'import sys, time; time.sleep(30)',"
+         " 'kubeflow_tpu.runners.fake_marker'])\n"
+         "print(p.pid, flush=True)\n"
+         "time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True)
+    pid = int(helper.stdout.readline().strip())
+    return helper, pid
+
+
+class TestBoxGuard:
+    def test_planted_stray_trips_the_flag(self):
+        helper, pid = _spawn_marker_grandchild()
+        try:
+            time.sleep(0.3)
+            guard = bench._BoxGuard(root=FOREIGN_ROOT)
+            guard.section("lm")
+            report = guard.finish()
+            assert "lm" in report["contaminated_sections"], report
+            assert report["box_sections"]["lm"]["strays"] >= 1
+            assert any("fake_marker" in s["cmd"]
+                       for s in report["stray_workers"])
+        finally:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+            helper.kill()
+
+    def test_background_thread_catches_midsection_stray(self):
+        """The round-3 failure mode: the stray appears AFTER the section
+        starts. The periodic sampler must still see it."""
+        guard = bench._BoxGuard(root=FOREIGN_ROOT)
+        guard.PERIOD_S = 0.2
+        guard.start()
+        guard.section("baseline_configs")
+        helper, pid = _spawn_marker_grandchild()  # appears mid-section
+        try:
+            time.sleep(1.0)  # several sampler periods
+        finally:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+            helper.kill()
+        report = guard.finish()
+        assert "baseline_configs" in report["contaminated_sections"], report
+        assert report["box_sections"]["baseline_configs"]["samples"] >= 3
+
+    def test_clean_run_flags_nothing(self):
+        guard = bench._BoxGuard()
+        guard.section("serving")
+        report = guard.finish()
+        assert report["contaminated_sections"] == []
+        assert report["load_avg_max"] >= 0
+        assert {"serving", "end"} <= set(report["box_sections"])
+
+    def test_own_descendants_are_not_strays(self):
+        # A gang worker tree spawned by THIS process is measurement, not
+        # contamination — at any depth (mpi ranks are grandchildren).
+        helper, pid = _spawn_marker_grandchild()
+        try:
+            time.sleep(0.3)
+            strays = bench._find_strays()  # default root = this process
+            assert not any(s["pid"] in (pid, helper.pid) for s in strays)
+        finally:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+            helper.kill()
