@@ -16,15 +16,21 @@ Server mode (`kfx server`) hosts a persistent ControlPlane behind:
   the profile controller folds them into status.bindings.
 
 Authorization (SURVEY.md §2.1 profile/kfam rows): the reference trusts
-Istio to inject `kubeflow-userid` and RBAC to enforce it; self-hosted,
-the apiserver is the enforcement point. Callers identify via
-`X-Kfx-User`. Writes into a profile-owned namespace (profile name ==
-namespace) require the profile owner or a contributor; binding and
-profile management require the owner or an admin-role contributor;
-namespaces without a Profile are unmanaged and open. Possession of the
-home's 0600 `admin.token` (sent as `X-Kfx-Admin-Token`) is
-cluster-admin — the kubectl-kubeconfig analogue used by local kfx
-invocations on the server's own box.
+Istio to AUTHENTICATE callers and inject `kubeflow-userid`; self-hosted
+there is no Istio, so the apiserver is both the authentication and the
+enforcement point. `X-Kfx-User` alone is an unauthenticated,
+client-asserted claim good only for read-side attribution. Writes into
+a profile-owned namespace (profile name == namespace) require the
+identity to be AUTHENTICATED with the per-user bearer token
+(`X-Kfx-User-Token`) — issued only on admin-authenticated requests (an
+admin-applied Profile returns the owner's token once; POST
+/kfam/v1/tokens issues/rotates any user's), stored sha256-hashed in the
+home's 0600 `user.tokens` — and to be the owner or a contributor;
+binding and profile management additionally require owner or an
+admin-role contributor. Namespaces without a Profile are unmanaged and
+open. Possession of the home's 0600 `admin.token` (sent as
+`X-Kfx-Admin-Token`) is cluster-admin — the kubectl-kubeconfig analogue
+used by local kfx invocations on the server's own box.
 
 Routes:
   GET    /healthz                                 liveness
@@ -67,12 +73,25 @@ from .core.store import AlreadyExists, Conflict, NotFound
 
 
 # Caller identity header — the kubeflow-userid analogue. The reference
-# trusts Istio to inject it and RBAC/kfam to enforce it (SURVEY.md §2.1
-# profile/kfam rows); in a self-hosted control plane the apiserver is
-# both the injection boundary and the enforcement point.
+# trusts Istio to AUTHENTICATE the user and inject the header; this
+# self-hosted control plane has no Istio in front, so the header alone
+# is an unauthenticated assertion any client can forge. Trust model:
+#   * X-Kfx-User alone        -> read-only attribution (display, events);
+#   * X-Kfx-User + X-Kfx-User-Token (verified against the hash stored at
+#     bind time) -> authenticated identity; required for writes into
+#     profile-owned namespaces;
+#   * X-Kfx-Admin-Token (the home's 0600 admin.token) -> cluster admin.
+# Tokens are minted only on ADMIN-authenticated requests (admin-applied
+# Profile -> owner token in that response; POST /kfam/v1/tokens for
+# everyone else), returned in plaintext exactly once, and stored hashed
+# (sha256) in the home's 0600 user.tokens file. First-touch minting by
+# arbitrary callers would let anyone harvest a not-yet-tokened user's
+# credential by naming them as profile owner or binding them.
 USER_HEADER = "X-Kfx-User"
+USER_TOKEN_HEADER = "X-Kfx-User-Token"
 ADMIN_HEADER = "X-Kfx-Admin-Token"
 ADMIN_TOKEN_FILE = "admin.token"
+USER_TOKENS_FILE = "user.tokens"
 
 
 class Forbidden(Exception):
@@ -107,6 +126,67 @@ def read_admin_token(home: str) -> Optional[str]:
             return f.read().strip() or None
     except OSError:
         return None
+
+
+class UserTokens:
+    """Per-user bearer tokens, hashed at rest (sha256) in the home's
+    0600 ``user.tokens`` JSON file. Plaintext exists only in the
+    issuing HTTP response; possession of the file grants nothing but
+    the ability to VERIFY (and whoever reads the home owns the cluster
+    anyway — same argument as admin.token)."""
+
+    def __init__(self, home: str):
+        import threading
+
+        self.path = os.path.join(home, USER_TOKENS_FILE)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _hash(token: str) -> str:
+        import hashlib
+
+        return hashlib.sha256(token.encode()).hexdigest()
+
+    def _load(self) -> dict:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _save(self, data: dict) -> None:
+        tmp = self.path + ".tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.path)
+
+    def has(self, user: str) -> bool:
+        with self._lock:
+            return user in self._load()
+
+    def issue(self, user: str, rotate: bool = False) -> Optional[str]:
+        """Mint a token for ``user`` and store its hash; returns the
+        plaintext ONCE. None if the user already has one (unless
+        ``rotate``, which invalidates the old token)."""
+        import secrets
+
+        with self._lock:
+            data = self._load()
+            if user in data and not rotate:
+                return None
+            tok = secrets.token_hex(16)
+            data[user] = self._hash(tok)
+            self._save(data)
+            return tok
+
+    def verify(self, user: str, token: str) -> bool:
+        import hmac
+
+        with self._lock:
+            ref = self._load().get(user, "")
+        return bool(user and token and ref and
+                    hmac.compare_digest(self._hash(token), ref))
 
 
 def prometheus_text(m: dict) -> str:
@@ -275,10 +355,32 @@ class _Handler(BaseHTTPRequestHandler):
                 resources = load_manifests(text)
                 self._authorize_apply(resources)
                 applied = self.cp.apply(resources)
-                return self._json(200, {"applied": [
+                out = {"applied": [
                     {"kind": o.KIND, "name": o.name,
                      "namespace": o.namespace, "verb": verb}
-                    for o, verb in applied]})
+                    for o, verb in applied]}
+                # A Profile applied BY THE CLUSTER ADMIN mints its
+                # owner's bearer token (plaintext returned exactly once,
+                # here). Anonymous self-service profile creation must
+                # NOT mint: X-Kfx-User is forgeable, so first-touch
+                # minting would let anyone harvest any not-yet-tokened
+                # user's credential by naming them as owner.
+                tokens = getattr(self.server, "user_tokens", None)
+                issued = {}
+                if tokens is not None and self._is_admin():
+                    for o, _verb in applied:
+                        if o.KIND != "Profile":
+                            continue
+                        owner = o.owner().get("name", "")
+                        minted = tokens.issue(owner) if owner else None
+                        if minted:
+                            issued[owner] = minted
+                if issued:
+                    out["issuedTokens"] = issued
+                    out["tokenNote"] = (
+                        f"send as {USER_TOKEN_HEADER} with {USER_HEADER};"
+                        f" shown only once")
+                return self._json(200, out)
             if url.path == "/ui/notebooks":
                 form = parse_qs(text)
                 self._authorize_write(
@@ -290,6 +392,20 @@ class _Handler(BaseHTTPRequestHandler):
                 if ns:
                     self._authorize_admin(ns)
                 return self._kfam_post(body)
+            if url.path == "/kfam/v1/tokens":
+                # Rotation/recovery is cluster-admin surface: a lost or
+                # leaked user token is replaced here, invalidating the
+                # old one.
+                if not self._is_admin():
+                    raise Forbidden("token rotation requires the admin "
+                                    "token")
+                body = json.loads(text)
+                user = body.get("user", "")
+                if not user:
+                    return self._error(400, "user is required")
+                tok = getattr(self.server, "user_tokens", None)
+                minted = tok.issue(user, rotate=True)
+                return self._json(200, {"user": user, "token": minted})
             return self._error(404, f"no route {url.path}")
         except Forbidden as e:
             return self._error(403, str(e))
@@ -349,6 +465,19 @@ class _Handler(BaseHTTPRequestHandler):
     def _caller(self) -> str:
         return self.headers.get(USER_HEADER, "")
 
+    def _identity(self):
+        """(user, authenticated). A present-but-wrong token is a hard
+        403 — silently downgrading a failed authentication to an
+        anonymous caller would mask credential problems."""
+        user = self._caller()
+        tok = self.headers.get(USER_TOKEN_HEADER, "")
+        if not tok:
+            return user, False
+        tokens = getattr(self.server, "user_tokens", None)
+        if tokens is None or not tokens.verify(user, tok):
+            raise Forbidden(f"invalid token for user {user!r}")
+        return user, True
+
     def _is_admin(self) -> bool:
         import hmac
 
@@ -365,21 +494,30 @@ class _Handler(BaseHTTPRequestHandler):
         """Gate a write into ``namespace``. Unmanaged namespaces (no
         Profile; reference parity: no Istio AuthorizationPolicy was
         stamped) and admin-token callers pass. Otherwise the caller
-        must be the profile owner, or a contributor — any role for
-        plain writes, the ``admin`` role for access management
-        (``admin=True``): edit-role contributors run workloads, they
-        do not grant access."""
+        must present an AUTHENTICATED identity (X-Kfx-User-Token issued
+        at bind time — the bare X-Kfx-User header is client-asserted
+        and grants nothing for writes) that is the profile owner or a
+        contributor — any role for plain writes, the ``admin`` role for
+        access management (``admin=True``): edit-role contributors run
+        workloads, they do not grant access."""
         prof = self._profile_for(namespace)
         if prof is None or self._is_admin():
             return
-        user = self._caller()
-        if prof.owner().get("name") == user:
-            return
-        if user and any(c.get("name") == user and
-                        (not admin or c.get("role") == "admin")
-                        for c in prof.contributors()):
+        user, authed = self._identity()
+        is_member = (prof.owner().get("name") == user
+                     or (user and any(
+                         c.get("name") == user and
+                         (not admin or c.get("role") == "admin")
+                         for c in prof.contributors())))
+        if is_member and authed:
             return
         who = f"user {user!r}" if user else "anonymous caller"
+        if is_member:
+            raise Forbidden(
+                f"{who} matches a binding but is unauthenticated: writes "
+                f"require the {USER_TOKEN_HEADER} header (issued when the "
+                f"profile/binding was created; admins can rotate via "
+                f"POST /kfam/v1/tokens)")
         if admin:
             raise Forbidden(f"{who} is not the owner or an admin of "
                             f"profile {namespace!r}")
@@ -456,8 +594,28 @@ class _Handler(BaseHTTPRequestHandler):
             prof.spec["contributors"] = contribs
 
         self._update_profile(ns, mutate)
-        return self._json(200, {"bound": {"user": user, "role": role,
-                                          "referredNamespace": ns}})
+        out = {"bound": {"user": user, "role": role,
+                         "referredNamespace": ns}}
+        # Mint the new contributor's bearer token ONLY when the granter
+        # is the cluster admin. Tokens are per-user across ALL profiles,
+        # so returning a fresh user's plaintext to a mere profile
+        # owner/admin-contributor would let any profile owner harvest a
+        # credential that impersonates the victim everywhere (bind the
+        # victim into a namespace you own, read the token). Profile
+        # owners can still bind anyone; the bound user's token comes
+        # from an admin (POST /kfam/v1/tokens) out-of-band.
+        tok = getattr(self.server, "user_tokens", None)
+        if tok is not None and self._is_admin():
+            minted = tok.issue(user)
+            if minted:
+                out["token"] = minted
+                out["tokenNote"] = (
+                    f"send as {USER_TOKEN_HEADER} with {USER_HEADER}; "
+                    f"shown only once")
+        elif tok is not None and not tok.has(user):
+            out["tokenNote"] = (f"user has no bearer token yet; an admin "
+                                f"must issue one via POST /kfam/v1/tokens")
+        return self._json(200, out)
 
     def _kfam_delete(self, ns: str, user: str) -> None:
         if not ns or not user:
@@ -751,6 +909,7 @@ class ApiServer:
         # plain HTTP callers are subject to them.
         self.admin_token = write_admin_token(cp.home)
         self.httpd.admin_token = self.admin_token  # type: ignore
+        self.httpd.user_tokens = UserTokens(cp.home)  # type: ignore
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -796,12 +955,17 @@ class Client:
 
     def __init__(self, base_url: str, timeout: float = 30.0,
                  user: Optional[str] = None,
-                 admin_token: Optional[str] = None):
+                 admin_token: Optional[str] = None,
+                 user_token: Optional[str] = None):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         # Caller identity for profile-owned namespaces (KFX_USER is the
-        # kubeflow-userid analogue the reference gets from Istio).
+        # kubeflow-userid analogue the reference gets from Istio);
+        # KFX_USER_TOKEN is the bearer token issued at profile/binding
+        # creation — without it the identity is read-only attribution.
         self.user = user if user is not None else os.environ.get("KFX_USER")
+        self.user_token = (user_token if user_token is not None
+                           else os.environ.get("KFX_USER_TOKEN"))
         self.admin_token = admin_token
 
     def _call(self, path: str, data: Optional[bytes] = None,
@@ -813,6 +977,8 @@ class Client:
                                      method=method)
         if self.user:
             req.add_header(USER_HEADER, self.user)
+        if self.user_token:
+            req.add_header(USER_TOKEN_HEADER, self.user_token)
         if self.admin_token:
             req.add_header(ADMIN_HEADER, self.admin_token)
         try:

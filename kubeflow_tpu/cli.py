@@ -537,6 +537,26 @@ def _dict_state(obj: dict) -> str:
     return display_state(obj.get("status", {}).get("conditions", []))
 
 
+def _same_server(a: str, b: str) -> bool:
+    """URL equivalence for the admin-token gate: canonical scheme/host
+    (lowercased, default ports filled) and path. No DNS — `localhost`
+    vs `127.0.0.1` intentionally does NOT match (fail closed; the
+    withheld-token note tells the user which spelling the marker has).
+    """
+    from urllib.parse import urlsplit
+
+    def canon(u):
+        s = urlsplit(u if "//" in u else f"//{u}", scheme="http")
+        port = s.port or {"http": 80, "https": 443}.get(s.scheme, 0)
+        return (s.scheme.lower(), (s.hostname or "").lower(), port,
+                s.path.rstrip("/"))
+
+    try:
+        return canon(a) == canon(b)
+    except ValueError:
+        return False
+
+
 def _detect_server(home: Optional[str]) -> Optional[str]:
     """URL of a live `kfx server` owning this home, else None."""
     try:
@@ -552,23 +572,36 @@ def _remote_main(args, url: Optional[str] = None) -> int:
     (the kubectl model — see apiserver)."""
     import urllib.error
 
-    from .apiserver import ApiError, Client, read_admin_token
+    from .apiserver import SERVER_MARKER, ApiError, Client, read_admin_token
 
     url = url or os.environ["KFX_SERVER"]
     # Local possession of the home's 0600 token file == cluster-admin —
     # but only toward the server that OWNS this home. Sending it to an
     # arbitrary KFX_SERVER would hand the credential to whoever runs
-    # that endpoint (cleartext HTTP), so verify ownership first.
+    # that endpoint (cleartext HTTP). Trust derives from the FILESYSTEM,
+    # never from the endpoint's own responses (a malicious server could
+    # simply echo the guessable home path): the flock-holding owner
+    # writes its URL into the home's server.json marker, and the token
+    # rides along only when KFX_SERVER matches that marker. Mismatch
+    # (incl. no marker) fails closed — requests still go out, just
+    # unprivileged.
     home = resolve_home(getattr(args, "home", None))
     token = read_admin_token(home)
-    # served_home() reports realpath — compare like for like, or a
-    # symlinked home would silently drop the owner's own credential.
-    # Generous timeout: a busy-but-owning server answering slowly must
-    # not degrade the owner to 403s (None also covers a genuinely
-    # unreachable server, where the real request fails anyway).
-    if token and Client(url, timeout=15.0).served_home() != \
-            os.path.realpath(home):
-        token = None
+    if token:
+        marker_url = None
+        try:
+            with open(os.path.join(home, SERVER_MARKER)) as f:
+                marker_url = json.load(f).get("url")
+        except (OSError, ValueError):
+            pass
+        if not marker_url or not _same_server(marker_url, url):
+            # Visible, because the symptom downstream is otherwise an
+            # unexplained 403 on admin surfaces.
+            print(f"note: admin token withheld — KFX_SERVER {url!r} does "
+                  f"not match this home's server marker "
+                  f"({marker_url!r}); requests proceed unprivileged",
+                  file=sys.stderr)
+            token = None
     client = Client(url, admin_token=token)
     try:
         return _remote_dispatch(client, args)

@@ -178,6 +178,44 @@ class TestClientMode:
         assert rc == 1
         assert "error:" in capsys.readouterr().err
 
+    def test_admin_token_rides_only_to_the_marker_url(self, server,
+                                                      monkeypatch):
+        """The 0600 admin token must never be decided by the endpoint's
+        own responses (an attacker's server can echo the guessable home
+        path): it is sent iff KFX_SERVER matches the URL the flock-
+        holding owner wrote into the home's server.json marker."""
+        import kubeflow_tpu.cli as cli_mod
+        from kubeflow_tpu import apiserver as api_mod
+        from kubeflow_tpu.apiserver import SERVER_MARKER, write_server_marker
+
+        home = server.cp.home
+        captured = {}
+
+        class SpyClient(api_mod.Client):
+            def __init__(self, url, **kw):
+                captured["admin_token"] = kw.get("admin_token")
+                super().__init__(url, **kw)
+
+        monkeypatch.setattr(api_mod, "Client", SpyClient)
+        monkeypatch.setenv("KFX_HOME", home)
+
+        # No marker yet: fail closed, no token even to the real server.
+        monkeypatch.setenv("KFX_SERVER", server.url)
+        cli_mod.main(["get", "jaxjobs"])
+        assert captured["admin_token"] is None
+
+        # Owner-written marker matching KFX_SERVER: token rides.
+        write_server_marker(home, server.url)
+        cli_mod.main(["get", "jaxjobs"])
+        assert captured["admin_token"]
+
+        # KFX_SERVER pointed elsewhere (attacker endpoint): marker
+        # mismatch drops the token BEFORE any request is made.
+        monkeypatch.setenv("KFX_SERVER", "http://127.0.0.1:1/")
+        cli_mod.main(["get", "jaxjobs"])
+        assert captured["admin_token"] is None
+        os.unlink(os.path.join(home, SERVER_MARKER))
+
 
 class TestNotebookSpawner:
     def test_spawn_and_delete_via_form(self, server):
@@ -298,7 +336,12 @@ spec:
     kind: User
     name: alice@example.com
 """
-        _req(f"{server.url}/apis", profile.encode())
+        admin = {"X-Kfx-Admin-Token":
+                 open(os.path.join(server.cp.home, "admin.token")).read()}
+        st, body = _req(f"{server.url}/apis", profile.encode(),
+                        headers=admin)
+        # An admin-applied Profile mints the owner's bearer token, once.
+        alice_tok = json.loads(body)["issuedTokens"]["alice@example.com"]
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
@@ -308,11 +351,17 @@ spec:
             time.sleep(0.2)
         assert [b["user"] for b in bindings] == ["alice@example.com"]
 
-        alice = {"X-Kfx-User": "alice@example.com"}
-        st, _ = _req(f"{server.url}/kfam/v1/bindings", json.dumps(
+        alice = {"X-Kfx-User": "alice@example.com",
+                 "X-Kfx-User-Token": alice_tok}
+        st, body = _req(f"{server.url}/kfam/v1/bindings", json.dumps(
             {"namespace": "team-z", "user": "bob@example.com",
              "role": "edit"}).encode(), headers=alice)
         assert st == 200
+        # An owner-granted bind must NOT hand bob's credential to alice
+        # (she could impersonate him in every profile he belongs to) —
+        # it points at the admin issuance path instead.
+        assert "token" not in json.loads(body)
+        assert "admin" in json.loads(body)["tokenNote"]
         while time.monotonic() < deadline:
             _, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-z")
             users = [b["user"] for b in json.loads(body)["bindings"]]
@@ -366,8 +415,11 @@ class TestAuthz:
     """kfam bindings are ENFORCED at the apiserver (SURVEY.md §2.1
     profile/kfam rows): in a self-hosted control plane there is no Istio
     in front, so the apiserver is the enforcement point. Writes into a
-    profile-owned namespace need the owner, a contributor, or the
-    home's admin token; binding management needs owner/admin."""
+    profile-owned namespace need an AUTHENTICATED owner/contributor
+    identity (X-Kfx-User + the bearer token issued at profile/binding
+    creation) or the home's admin token; the bare X-Kfx-User header is
+    client-asserted and grants nothing for writes. Binding management
+    additionally needs owner/admin role."""
 
     @pytest.fixture()
     def owned_ns(self, server):
@@ -381,33 +433,78 @@ spec:
     kind: User
     name: alice@example.com
 """
-        _req(f"{server.url}/apis", profile.encode())
-        return "team-q"
+        _, body = _req(f"{server.url}/apis", profile.encode(),
+                       headers=self._admin(server))
+        tokens = {"alice@example.com":
+                  json.loads(body)["issuedTokens"]["alice@example.com"]}
+        return "team-q", tokens
 
-    def _apply(self, server, name, user=None, expect=200):
-        headers = {"X-Kfx-User": user} if user else {}
+    @staticmethod
+    def _admin(server):
+        return {"X-Kfx-Admin-Token":
+                open(os.path.join(server.cp.home, "admin.token")).read()}
+
+    def _issue(self, server, tokens, user):
+        """Admin issues/rotates a user token (the only plaintext path)."""
+        _, body = _req(f"{server.url}/kfam/v1/tokens", json.dumps(
+            {"user": user}).encode(), headers=self._admin(server))
+        tokens[user] = json.loads(body)["token"]
+        return tokens[user]
+
+    @staticmethod
+    def _hdrs(tokens, user, token=True):
+        if not user:
+            return {}
+        h = {"X-Kfx-User": user}
+        if token is True and user in tokens:
+            h["X-Kfx-User-Token"] = tokens[user]
+        elif isinstance(token, str):
+            h["X-Kfx-User-Token"] = token
+        return h
+
+    def _apply(self, server, tokens, name, user=None, token=True,
+               expect=200):
         try:
             st, _ = _req(f"{server.url}/apis",
                          NS_JOB.format(name=name).encode(),
-                         headers=headers)
+                         headers=self._hdrs(tokens, user, token))
         except urllib.error.HTTPError as e:
             st = e.code
             assert st == expect, e.read().decode()
         assert st == expect
 
+    def _bind(self, server, tokens, who, target, role="edit"):
+        st, _ = _req(
+            f"{server.url}/kfam/v1/bindings", json.dumps(
+                {"namespace": "team-q", "user": target,
+                 "role": role}).encode(),
+            headers=self._hdrs(tokens, who))
+        return st
+
     def test_write_enforcement_lifecycle(self, server, owned_ns):
-        # Anonymous and unbound users are refused; the owner passes.
-        self._apply(server, "j1", user=None, expect=403)
-        self._apply(server, "j1", user="mallory@example.com", expect=403)
-        self._apply(server, "j1", user="alice@example.com", expect=200)
-        # Unbound bob is 403 until alice binds him through kfam.
-        self._apply(server, "j2", user="bob@example.com", expect=403)
-        st, _ = _req(f"{server.url}/kfam/v1/bindings", json.dumps(
-            {"namespace": owned_ns, "user": "bob@example.com",
-             "role": "edit"}).encode(),
-            headers={"X-Kfx-User": "alice@example.com"})
-        assert st == 200
-        self._apply(server, "j2", user="bob@example.com", expect=200)
+        ns, tokens = owned_ns
+        # Anonymous and unbound users are refused; the owner passes
+        # only WITH their token.
+        self._apply(server, tokens, "j1", user=None, expect=403)
+        self._apply(server, tokens, "j1", user="mallory@example.com",
+                    expect=403)
+        self._apply(server, tokens, "j1", user="alice@example.com",
+                    token=False, expect=403)  # spoofed bare header
+        self._apply(server, tokens, "j1", user="alice@example.com",
+                    token="0" * 32, expect=403)  # right user, wrong token
+        self._apply(server, tokens, "j1", user="alice@example.com",
+                    expect=200)
+        # Unbound bob is 403 until alice binds him through kfam AND an
+        # admin issues his token.
+        self._apply(server, tokens, "j2", user="bob@example.com",
+                    expect=403)
+        assert self._bind(server, tokens, "alice@example.com",
+                          "bob@example.com") == 200
+        self._apply(server, tokens, "j2", user="bob@example.com",
+                    token=False, expect=403)  # binding alone: no write
+        self._issue(server, tokens, "bob@example.com")
+        self._apply(server, tokens, "j2", user="bob@example.com",
+                    expect=200)
         # Deletes are writes too.
         try:
             _req(f"{server.url}/apis/jaxjob/team-q/j1", method="DELETE")
@@ -416,33 +513,77 @@ spec:
             assert e.code == 403
         st, _ = _req(f"{server.url}/apis/jaxjob/team-q/j1",
                      method="DELETE",
-                     headers={"X-Kfx-User": "bob@example.com"})
+                     headers=self._hdrs(tokens, "bob@example.com"))
         assert st == 200
 
-    def test_binding_management_needs_admin_role(self, server, owned_ns):
-        # edit-role bob cannot grant access; admin-role carol can.
-        bind = lambda who, target, role="edit": _req(
-            f"{server.url}/kfam/v1/bindings", json.dumps(
-                {"namespace": owned_ns, "user": target,
-                 "role": role}).encode(),
-            headers={"X-Kfx-User": who})
-        assert bind("alice@example.com", "bob@example.com")[0] == 200
-        assert bind("alice@example.com", "carol@example.com",
-                    "admin")[0] == 200
+    def test_admin_can_rotate_a_lost_token(self, server, owned_ns):
+        ns, tokens = owned_ns
+        admin = {"X-Kfx-Admin-Token":
+                 open(os.path.join(server.cp.home, "admin.token")).read()}
+        st, body = _req(f"{server.url}/kfam/v1/tokens", json.dumps(
+            {"user": "alice@example.com"}).encode(), headers=admin)
+        assert st == 200
+        new_tok = json.loads(body)["token"]
+        # Old token is dead, the rotated one works.
+        self._apply(server, tokens, "jr", user="alice@example.com",
+                    token=tokens["alice@example.com"], expect=403)
+        self._apply(server, tokens, "jr", user="alice@example.com",
+                    token=new_tok, expect=200)
+        # Rotation itself is admin-only.
         try:
-            bind("bob@example.com", "eve@example.com")
+            _req(f"{server.url}/kfam/v1/tokens", json.dumps(
+                {"user": "alice@example.com"}).encode(),
+                headers=self._hdrs({**tokens,
+                                    "alice@example.com": new_tok},
+                                   "alice@example.com"))
             raise AssertionError("expected 403")
         except urllib.error.HTTPError as e:
             assert e.code == 403
-        assert bind("carol@example.com", "dave@example.com")[0] == 200
+
+    def test_binding_management_needs_admin_role(self, server, owned_ns):
+        ns, tokens = owned_ns
+        # edit-role bob cannot grant access; admin-role carol can —
+        # both fully authenticated, so what's tested is the ROLE.
+        assert self._bind(server, tokens, "alice@example.com",
+                          "bob@example.com") == 200
+        assert self._bind(server, tokens, "alice@example.com",
+                          "carol@example.com", "admin") == 200
+        self._issue(server, tokens, "bob@example.com")
+        self._issue(server, tokens, "carol@example.com")
+        try:
+            self._bind(server, tokens, "bob@example.com",
+                       "eve@example.com")
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        assert self._bind(server, tokens, "carol@example.com",
+                          "dave@example.com") == 200
         # Profile mutation/deletion is admin-surface as well.
         try:
             _req(f"{server.url}/apis/profile/default/team-q",
                  method="DELETE",
-                 headers={"X-Kfx-User": "bob@example.com"})
+                 headers=self._hdrs(tokens, "bob@example.com"))
             raise AssertionError("expected 403")
         except urllib.error.HTTPError as e:
             assert e.code == 403
+
+    def test_anonymous_profile_apply_mints_no_tokens(self, server):
+        """First-touch capture prevention: X-Kfx-User is forgeable, so
+        anonymous self-service profile creation naming a victim as owner
+        must NOT return the victim's bearer token."""
+        profile = """
+apiVersion: kubeflow.org/v1
+kind: Profile
+metadata:
+  name: team-grab
+spec:
+  owner:
+    kind: User
+    name: victim@example.com
+"""
+        st, body = _req(f"{server.url}/apis", profile.encode())
+        assert st == 200
+        assert "issuedTokens" not in json.loads(body)
 
     def test_unmanaged_namespace_stays_open(self, server):
         _req(f"{server.url}/apis", JOB.format(py=PY).encode())
