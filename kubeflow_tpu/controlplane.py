@@ -86,10 +86,18 @@ class ControlPlane:
             self.manager.register(ctrl)
         # Serving / HPO / platform controllers register here as they land.
         from .hpo.collector import ObservationStore
+        from .hpo.dbmanager import ObservationClient, make_db_server
         from .operators.hpo import hpo_controllers
 
-        self.observations = ObservationStore(
+        # Observations cross the db-manager gRPC boundary (Katib parity,
+        # SURVEY.md §3 CS2 step 4): the sqlite store sits behind a real
+        # gRPC service; the controllers hold only the client, so every
+        # report/read goes over the wire even in the embedded plane.
+        self._obs_store = ObservationStore(
             os.path.join(self.home, "observations.db"))
+        self._obs_server = make_db_server(self._obs_store).start()
+        self.observations = ObservationClient(
+            f"127.0.0.1:{self._obs_server.port}")
         for ctrl in hpo_controllers(self.store, self.gangs,
                                     self.observations):
             self.manager.register(ctrl)
@@ -133,7 +141,9 @@ class ControlPlane:
             if callable(shutdown):
                 shutdown()
         self.gangs.shutdown()
-        self.observations.close()
+        self.observations.close()   # client channel
+        self._obs_server.stop()     # gRPC boundary
+        self._obs_store.close()     # sqlite behind it
         self.store.close()
         if self._lock is not None:
             self._lock.close()

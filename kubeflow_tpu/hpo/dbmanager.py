@@ -1,0 +1,120 @@
+"""Observation-log db-manager: the gRPC boundary in Katib's metrics
+flow.
+
+In the reference, trial metrics cross a gRPC boundary twice: the
+metrics-collector sidecar pushes ``ReportObservationLog`` to the
+db-manager service, and controllers/UIs read ``GetObservationLog`` back
+(SURVEY.md §3 CS2 step 4, §2.1 db-manager row). This module keeps that
+architecture — a network-addressable gRPC service in front of the
+sqlite ``ObservationStore`` — with the JSON-message convention shared
+with the suggestion seam (``hpo/jsonrpc.py``).
+
+Service:  kfx.DbManager
+  ReportObservationLog  {"trial": key, "observations": [{name, value,
+                         step}]}               -> {"ok": true}
+  GetObservationLog     {"trial": key, "name": optional metric filter}
+                                               -> {"observations": [...]}
+
+``ObservationClient`` presents the exact surface of ``ObservationStore``
+(report/get/latest/close), so the control plane and the HPO controllers
+swap between the in-process store and the wire without caring which
+they hold — the embedded control plane runs the server in-process but
+every observation still crosses a real gRPC channel, exactly like the
+suggestion side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import grpc
+
+from .collector import ObservationStore
+from .jsonrpc import JsonRpcServer, json_method, make_json_server
+
+SERVICE = "kfx.DbManager"
+
+
+class _DbServicer:
+    def __init__(self, store: ObservationStore):
+        self.store = store
+
+    def report(self, request, context):
+        try:
+            self.store.report(request["trial"],
+                              request.get("observations") or [])
+            return {"ok": True}
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(str(e))
+            return {"error": str(e)}
+
+    def get(self, request, context):
+        try:
+            obs = self.store.get(request["trial"], request.get("name"))
+            return {"observations": obs}
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(str(e))
+            return {"error": str(e)}
+
+
+def make_db_server(store: ObservationStore, port: int = 0,
+                   host: str = "127.0.0.1") -> JsonRpcServer:
+    servicer = _DbServicer(store)
+    return make_json_server(SERVICE, {
+        "ReportObservationLog": servicer.report,
+        "GetObservationLog": servicer.get,
+    }, port=port, host=host)
+
+
+class ObservationClient:
+    """ObservationStore surface over the wire (drop-in: report/get/
+    latest/close)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(address)
+        self._report = json_method(self._channel, SERVICE,
+                                   "ReportObservationLog")
+        self._get = json_method(self._channel, SERVICE,
+                                "GetObservationLog")
+
+    def report(self, trial: str, observations: List[Dict]) -> None:
+        self._report({"trial": trial, "observations": observations},
+                     timeout=self.timeout)
+
+    def get(self, trial: str, name: Optional[str] = None) -> List[Dict]:
+        resp = self._get({"trial": trial, "name": name},
+                         timeout=self.timeout)
+        return resp["observations"]
+
+    def latest(self, trial: str, name: str) -> Optional[float]:
+        obs = self.get(trial, name)
+        return obs[-1]["value"] if obs else None
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+if __name__ == "__main__":
+    # Standalone deployment (the reference's db-manager pod): serve a
+    # sqlite file on a fixed port; --host 0.0.0.0 admits remote
+    # collector sidecars.
+    import argparse
+    import time as _time
+
+    p = argparse.ArgumentParser(description="kfx db-manager service")
+    p.add_argument("--db", default=":memory:")
+    p.add_argument("--port", type=int, default=6789)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 for remote collectors)")
+    args = p.parse_args()
+    srv = make_db_server(ObservationStore(args.db), port=args.port,
+                         host=args.host)
+    srv.start()
+    print(f"db-manager serving on {args.host}:{srv.port} (db={args.db})",
+          flush=True)
+    while True:
+        _time.sleep(3600)

@@ -1,0 +1,66 @@
+"""Shared JSON-gRPC plumbing for the HPO seams.
+
+Katib's architecture puts two gRPC boundaries in the HPO flow — the
+suggestion service and the observation db-manager (SURVEY.md §3 CS2).
+Both kfx seams speak JSON message bodies over grpc (grpcio is
+installed, grpcio-tools is not, and the wire contract is ours on both
+ends); this module is the one copy of the serializers and server
+lifecycle they share.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Callable, Dict
+
+import grpc
+
+
+def json_serializer(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def json_deserializer(data: bytes):
+    return json.loads(data.decode())
+
+
+class JsonRpcServer:
+    """A started-on-demand grpc.Server bound to a port."""
+
+    def __init__(self, server: grpc.Server, port: int):
+        self._server = server
+        self.port = port
+
+    def start(self) -> "JsonRpcServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        # stop() returns an Event without blocking; wait for in-flight
+        # handlers so callers may safely tear down backing state (e.g.
+        # the sqlite store behind the db-manager) right after.
+        self._server.stop(grace).wait()
+
+
+def make_json_server(service: str, methods: Dict[str, Callable],
+                     port: int = 0, host: str = "127.0.0.1",
+                     max_workers: int = 8) -> JsonRpcServer:
+    """Serve ``methods`` (name -> fn(request, context)) as unary-unary
+    JSON RPCs under ``/{service}/{name}``."""
+    handlers = grpc.method_handlers_generic_handler(service, {
+        name: grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=json_deserializer,
+            response_serializer=json_serializer)
+        for name, fn in methods.items()})
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((handlers,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    return JsonRpcServer(server, bound)
+
+
+def json_method(channel: grpc.Channel, service: str, name: str):
+    """Client-side unary-unary callable for ``/{service}/{name}``."""
+    return channel.unary_unary(
+        f"/{service}/{name}", request_serializer=json_serializer,
+        response_deserializer=json_deserializer)

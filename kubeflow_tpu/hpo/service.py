@@ -16,24 +16,15 @@ Response: {"assignments": [{name: value}, ...]} | {"error": ...}
 
 from __future__ import annotations
 
-import json
 import threading
-from concurrent import futures
 from typing import List, Optional
 
 import grpc
 
 from .algorithms import algorithm_names, get_algorithm
+from .jsonrpc import JsonRpcServer, json_method, make_json_server
 
 SERVICE = "kfx.Suggestion"
-
-
-def _json_serializer(obj) -> bytes:
-    return json.dumps(obj).encode()
-
-
-def _json_deserializer(data: bytes):
-    return json.loads(data.decode())
 
 
 class SuggestionServicer:
@@ -67,35 +58,16 @@ class SuggestionServicer:
         return {"ok": True}
 
 
-def make_server(port: int = 0, host: str = "127.0.0.1") -> "SuggestionServer":
+def make_server(port: int = 0, host: str = "127.0.0.1") -> JsonRpcServer:
     servicer = SuggestionServicer()
-    handlers = grpc.method_handlers_generic_handler(SERVICE, {
-        "GetSuggestions": grpc.unary_unary_rpc_method_handler(
-            servicer.get_suggestions,
-            request_deserializer=_json_deserializer,
-            response_serializer=_json_serializer),
-        "ValidateAlgorithmSettings": grpc.unary_unary_rpc_method_handler(
-            servicer.validate,
-            request_deserializer=_json_deserializer,
-            response_serializer=_json_serializer),
-    })
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
-    server.add_generic_rpc_handlers((handlers,))
-    bound = server.add_insecure_port(f"{host}:{port}")
-    return SuggestionServer(server, bound)
+    return make_json_server(SERVICE, {
+        "GetSuggestions": servicer.get_suggestions,
+        "ValidateAlgorithmSettings": servicer.validate,
+    }, port=port, host=host)
 
 
-class SuggestionServer:
-    def __init__(self, server: grpc.Server, port: int):
-        self._server = server
-        self.port = port
-
-    def start(self) -> "SuggestionServer":
-        self._server.start()
-        return self
-
-    def stop(self, grace: float = 1.0) -> None:
-        self._server.stop(grace)
+# Back-compat alias (pre-jsonrpc name).
+SuggestionServer = JsonRpcServer
 
 
 class SuggestionClient:
@@ -104,14 +76,9 @@ class SuggestionClient:
     def __init__(self, address: str):
         self.address = address
         self._channel = grpc.insecure_channel(address)
-        self._get = self._channel.unary_unary(
-            f"/{SERVICE}/GetSuggestions",
-            request_serializer=_json_serializer,
-            response_deserializer=_json_deserializer)
-        self._validate = self._channel.unary_unary(
-            f"/{SERVICE}/ValidateAlgorithmSettings",
-            request_serializer=_json_serializer,
-            response_deserializer=_json_deserializer)
+        self._get = json_method(self._channel, SERVICE, "GetSuggestions")
+        self._validate = json_method(self._channel, SERVICE,
+                                     "ValidateAlgorithmSettings")
 
     def get_suggestions(self, algorithm: str, parameters: list,
                         trials: list, count: int,
@@ -137,7 +104,7 @@ class SuggestionClient:
 # Shared in-process server for embedded control planes (one per process,
 # started lazily): the gRPC boundary is kept, the deployment is local.
 _shared_lock = threading.Lock()
-_shared: Optional[SuggestionServer] = None
+_shared: Optional[JsonRpcServer] = None
 
 
 def shared_suggestion_address() -> str:

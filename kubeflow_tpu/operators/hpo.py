@@ -79,7 +79,11 @@ class TrialController(Controller):
     RESYNC_PERIOD = 2.0
 
     def __init__(self, store: ResourceStore, gangs: GangManager,
-                 observations: ObservationStore):
+                 observations):
+        # ``observations`` is the ObservationStore surface — in the full
+        # control plane it is the db-manager gRPC client
+        # (hpo.dbmanager.ObservationClient), so reports/reads cross the
+        # wire; tests may pass the bare store.
         super().__init__(store)
         self.gangs = gangs
         self.observations = observations
@@ -214,7 +218,16 @@ class TrialController(Controller):
         metric_names = [m for m in metric_names if m]
         observations = self._collect_observations(trial, job, metric_names)
         self.observations.report(trial.key, observations)
-        summary = summarize(observations)
+        # Read BACK through the db-manager boundary (GetObservationLog):
+        # the trial's recorded observation is what the store serves, not
+        # the collector's local list — both legs of the reference's
+        # metrics flow cross the wire (SURVEY.md §3 CS2 step 4). The
+        # local list is the fallback iff the read comes back empty
+        # (report is replace-all, so a concurrent foreign writer racing
+        # this window could otherwise blank a successful trial's
+        # metrics; Katib shares the same last-writer-wins semantics).
+        stored = self.observations.get(trial.key)
+        summary = summarize(stored if stored else observations)
         observation = {"metrics": [
             {"name": name, **vals} for name, vals in summary.items()]}
 
@@ -623,7 +636,7 @@ def _reaches_goal(exp: K.Experiment, value: float, goal: float) -> bool:
 
 
 def hpo_controllers(store: ResourceStore, gangs: GangManager = None,
-                    observations: Optional[ObservationStore] = None):
+                    observations=None):
     if gangs is None:
         raise TypeError("hpo_controllers requires the gang manager")
     obs = observations or ObservationStore()

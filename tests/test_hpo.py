@@ -199,6 +199,64 @@ class TestSuggestionService:
             server.stop()
 
 
+class TestDbManagerBoundary:
+    """Observation logs cross the db-manager gRPC boundary twice, like
+    the reference's metrics flow (SURVEY.md §3 CS2 step 4): the
+    collector pushes ReportObservationLog, controllers read
+    GetObservationLog — ObservationClient is a drop-in for the store."""
+
+    def test_report_and_read_cross_the_wire(self):
+        from kubeflow_tpu.hpo.collector import ObservationStore
+        from kubeflow_tpu.hpo.dbmanager import (
+            ObservationClient, make_db_server)
+
+        store = ObservationStore()
+        server = make_db_server(store).start()
+        try:
+            client = ObservationClient(f"127.0.0.1:{server.port}")
+            obs = [{"name": "accuracy", "value": 0.5, "step": 1},
+                   {"name": "accuracy", "value": 0.9, "step": 2},
+                   {"name": "loss", "value": 0.3, "step": 2}]
+            client.report("ns/t1", obs)
+            assert client.get("ns/t1") == obs
+            assert client.get("ns/t1", "loss") == [obs[2]]
+            assert client.latest("ns/t1", "accuracy") == 0.9
+            # Writes went THROUGH the service into the backing store.
+            assert store.get("ns/t1") == obs
+            # Idempotent re-report replaces (restart-safe collection).
+            client.report("ns/t1", obs[:1])
+            assert client.get("ns/t1") == obs[:1]
+            client.close()
+        finally:
+            server.stop()
+
+    def test_collector_pushes_from_another_process(self):
+        """The sidecar shape: a separate OS process holds only the
+        client address and pushes observations over the wire."""
+        import os
+        import subprocess
+
+        from kubeflow_tpu.hpo.collector import ObservationStore
+        from kubeflow_tpu.hpo.dbmanager import make_db_server
+
+        store = ObservationStore()
+        server = make_db_server(store).start()
+        try:
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            code = (
+                "import sys; sys.path.insert(0, %r)\n"
+                "from kubeflow_tpu.hpo.dbmanager import ObservationClient\n"
+                "c = ObservationClient('127.0.0.1:%d')\n"
+                "c.report('ns/t2', [{'name': 'loss', 'value': 1.25,"
+                " 'step': 7}])\n"
+                "c.close()\n" % (repo, server.port))
+            subprocess.run([PY, "-c", code], check=True, timeout=60)
+            assert store.latest("ns/t2", "loss") == 1.25
+        finally:
+            server.stop()
+
+
 class TestTrialRendering:
     def test_substitution(self):
         from kubeflow_tpu.operators.hpo import render_trial_spec
