@@ -8,8 +8,9 @@ empty, see SURVEY §0):
     notebooks. Here the template's command runs as a supervised local
     process (single-member gang: same restart/backoff/logging machinery
     as training jobs) with a routed local URL in ``status.url``; culling
-    watches the process's output activity against the reference culler's
-    idle-seconds annotation.
+    measures activity like the reference culler does — the Jupyter
+    kernels API when the server speaks it, the process tree's CPU-time
+    delta otherwise — against the idle-seconds annotation.
   * profile-controller (~3k) + kfam (~2k): ``Profile`` CR -> per-user
     namespace + RBAC bindings + ResourceQuota. Here a Profile owns the
     namespace bearing its name: contributor bindings are normalised into
@@ -168,18 +169,23 @@ class NotebookController(Controller):
         super().__init__(store)
         self.gangs = gangs
         self.admission: Optional[PlatformAdmission] = None
+        # Per-gang culling state: {"started", "last_active", "cpu"} —
+        # the CPU sample baseline for the /proc activity fallback.
+        self._cull_state: Dict[str, Dict[str, float]] = {}
 
     def _gang_key(self, key: str) -> str:
         return f"notebook/{key}"
 
     def on_delete(self, obj) -> None:
         self.gangs.delete(self._gang_key(obj.key))
+        self._cull_state.pop(self._gang_key(obj.key), None)
 
     # -- reconcile ----------------------------------------------------------
     def reconcile(self, key: str) -> Optional[Result]:
         nb = self.get_resource(key)
         if nb is None:
             self.gangs.delete(self._gang_key(key))
+            self._cull_state.pop(self._gang_key(key), None)
             return None
         assert isinstance(nb, Notebook)
         gkey = self._gang_key(key)
@@ -235,7 +241,7 @@ class NotebookController(Controller):
             self._update_status(nb)
 
         if running:
-            self._maybe_cull(nb, gang, gkey)
+            self._maybe_cull(nb, gang, gkey, int(port))
         return None
 
     def _volume_env(self, nb: Notebook) -> Dict[str, str]:
@@ -307,24 +313,120 @@ class NotebookController(Controller):
         except OSError:
             return False
 
-    def _maybe_cull(self, nb: Notebook, gang: G.Gang, gkey: str) -> None:
+    @staticmethod
+    def _jupyter_activity(port: int) -> Optional[float]:
+        """Last-activity timestamp from the notebook's kernels API —
+        exactly what the reference culler polls (`GET /api/kernels`:
+        per-kernel ``last_activity`` + ``execution_state``). Returns a
+        timestamp (now for a busy kernel), 0.0 for a reachable endpoint
+        with no active kernels, or None when the server doesn't speak
+        the API (fall back to the CPU probe)."""
+        import json as _json
+        import urllib.request
+        from datetime import datetime, timezone
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/kernels",
+                    timeout=0.5) as r:
+                kernels = _json.loads(r.read().decode())
+            if not isinstance(kernels, list):
+                return None
+        except Exception:
+            return None
+        last = 0.0
+        for k in kernels:
+            if not isinstance(k, dict):
+                return None
+            if k.get("execution_state") == "busy":
+                return time.time()
+            ts = k.get("last_activity")
+            if ts:
+                try:
+                    dt = datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
+                    if dt.tzinfo is None:
+                        dt = dt.replace(tzinfo=timezone.utc)
+                    last = max(last, dt.timestamp())
+                except ValueError:
+                    return None
+        return last
+
+    @staticmethod
+    def _proc_cpu_seconds(pid: Optional[int]) -> Optional[float]:
+        """Cumulative CPU seconds of the notebook process and its direct
+        children (kernels it forked) — a busy-but-silent kernel shows up
+        here even though it writes nothing."""
+        if not pid:
+            return None
+
+        def one(p: int) -> float:
+            with open(f"/proc/{p}/stat") as f:
+                parts = f.read().split(")")[-1].split()
+            # utime, stime are fields 14,15 of stat == parts[11], [12]
+            # after the (comm) split (state is parts[0]).
+            return (int(parts[11]) + int(parts[12])) / os.sysconf("SC_CLK_TCK")
+
+        try:
+            total = one(pid)
+        except (OSError, ValueError, IndexError):
+            return None
+        try:
+            for child in os.listdir("/proc"):
+                if not child.isdigit():
+                    continue
+                try:
+                    with open(f"/proc/{child}/stat") as f:
+                        ppid = int(f.read().split(")")[-1].split()[1])
+                    if ppid == pid:
+                        total += one(int(child))
+                except (OSError, ValueError, IndexError):
+                    continue
+        except OSError:
+            pass
+        return total
+
+    # Minimum CPU seconds between two reconcile samples that counts as
+    # activity: a spinning kernel accrues ~RESYNC_PERIOD per sample, a
+    # heartbeat-printing idle loop stays in the milliseconds.
+    CPU_ACTIVE_DELTA_S = 0.1
+
+    def _maybe_cull(self, nb: Notebook, gang: G.Gang, gkey: str,
+                    port: int) -> None:
         """Idle culling: the reference culler stops a notebook whose last
-        activity is older than the idle window. Activity proxy: the
-        process's output log mtime (requests to a notebook produce access
-        logs), floored at the last (re)start."""
+        activity is older than the idle window. Activity is measured,
+        not proxied from output: first the Jupyter kernels API (the
+        reference culler's own source), else the process tree's CPU-time
+        delta — the previous log-mtime proxy culled busy-but-silent
+        kernels and kept chatty idle ones alive forever."""
         idle_s = nb.culling_idle_seconds()
         if idle_s <= 0:
             return
         st = gang.status()
         started = max((r.started_at or 0.0) for r in st.replicas.values())
-        last = started
-        log_path = gang.log_path("notebook-0")
-        try:
-            last = max(last, os.path.getmtime(log_path))
-        except OSError:
-            pass
-        if (time.time() - last) < idle_s:
+        state = self._cull_state.get(gkey)
+        if state is None or state["started"] != started:
+            state = {"started": started, "last_active": started,
+                     "cpu": -1.0}
+            self._cull_state[gkey] = state
+
+        # Sample CPU every pass (even when the kernels API answers):
+        # otherwise one API timeout would compare against a many-windows-
+        # old baseline and read the server's own accrued request-serving
+        # CPU as fresh activity.
+        pid = next((r.pid for r in st.replicas.values() if r.pid), None)
+        cpu = self._proc_cpu_seconds(pid)
+        activity = self._jupyter_activity(port) if port else None
+        if activity is not None:
+            state["last_active"] = max(state["last_active"], activity)
+        elif cpu is not None and state["cpu"] >= 0 and \
+                cpu - state["cpu"] > self.CPU_ACTIVE_DELTA_S:
+            state["last_active"] = time.time()
+        if cpu is not None:
+            state["cpu"] = cpu
+
+        if (time.time() - state["last_active"]) < idle_s:
             return
+        self._cull_state.pop(gkey, None)
         self.gangs.delete(gkey)
         nb.set_condition(NOTEBOOK_CULLED, "True", "IdleCulled",
                          f"no activity for {idle_s}s")

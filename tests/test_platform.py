@@ -118,6 +118,69 @@ class TestNotebook:
               .has_condition("Culled", "False"), timeout=30,
               what="restart after spec change")
 
+    def test_busy_silent_notebook_survives_idle_window(self, cp):
+        """A kernel computing flat-out but writing NOTHING must not be
+        culled (the old log-mtime proxy would have killed it): the
+        /proc CPU-time delta is the activity signal."""
+        nb = _notebook("nb-busy", [PY, "-c", (
+            "x = 0\n"
+            "while True: x += 1\n")], idle_seconds=2, ports=False)
+        cp.apply([nb])
+        cp.wait_for_condition("Notebook", "nb-busy", "Ready", timeout=30)
+        time.sleep(8)  # several idle windows
+        got = cp.store.get("Notebook", "nb-busy")
+        assert not got.has_condition("Culled"), got.conditions
+        assert cp.gangs.get("notebook/default/nb-busy") is not None
+        cp.store.delete("Notebook", "nb-busy")
+
+    def test_idle_chatty_notebook_is_culled(self, cp):
+        """A process printing heartbeats but doing no work must be
+        culled (the old log-mtime proxy kept it alive forever)."""
+        nb = _notebook("nb-chat", [PY, "-u", "-c", (
+            "import time\n"
+            "while True:\n"
+            "    print('still here')\n"
+            "    time.sleep(0.2)\n")], idle_seconds=2, ports=False)
+        cp.apply([nb])
+        cp.wait_for_condition("Notebook", "nb-chat", "Ready", timeout=30)
+        _wait(lambda: cp.store.get("Notebook", "nb-chat")
+              .has_condition("Culled"), timeout=30, what="chatty culled")
+
+    _KERNELS_SERVER = (
+        "import http.server, json, os\n"
+        "BODY = json.dumps([{'execution_state': %r,\n"
+        "                    'last_activity': %r}]).encode()\n"
+        "class H(http.server.BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        body = BODY if self.path == '/api/kernels' else b'ok'\n"
+        "        self.send_response(200)\n"
+        "        self.send_header('Content-Length', str(len(body)))\n"
+        "        self.end_headers()\n"
+        "        self.wfile.write(body)\n"
+        "    def log_message(self, *a):\n"
+        "        pass\n"
+        "http.server.HTTPServer(('127.0.0.1',\n"
+        "    int(os.environ['KFX_NOTEBOOK_PORT'])), H).serve_forever()\n")
+
+    def test_jupyter_kernels_api_drives_culling(self, cp):
+        """Reference-culler parity: when the server speaks the kernels
+        API, its execution_state/last_activity decide — a busy kernel
+        (zero CPU here, nothing logged) survives; a stale idle one is
+        culled."""
+        busy = _notebook("nb-jup-busy", [PY, "-c", self._KERNELS_SERVER %
+                                         ("busy", "2020-01-01T00:00:00Z")],
+                         idle_seconds=2)
+        stale = _notebook("nb-jup-idle", [PY, "-c", self._KERNELS_SERVER %
+                                          ("idle", "2020-01-01T00:00:00Z")],
+                          idle_seconds=2)
+        cp.apply([busy, stale])
+        cp.wait_for_condition("Notebook", "nb-jup-busy", "Ready", timeout=30)
+        _wait(lambda: cp.store.get("Notebook", "nb-jup-idle")
+              .has_condition("Culled"), timeout=30, what="stale culled")
+        got = cp.store.get("Notebook", "nb-jup-busy")
+        assert not got.has_condition("Culled"), got.conditions
+        cp.store.delete("Notebook", "nb-jup-busy")
+
     def test_crash_restart(self, cp):
         nb = _notebook("nb3", [PY, "-c", (
             "import os, time\n"
