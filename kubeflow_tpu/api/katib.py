@@ -28,6 +28,15 @@ TRIAL_FAILED = "Failed"
 TRIAL_EARLY_STOPPED = "EarlyStopped"
 TRIAL_METRICS_UNAVAILABLE = "MetricsUnavailable"
 
+# Katib metrics-collector kinds: the accepted set, the subset with no
+# implementation here (surfaced as reconcile-time MetricsUnavailable),
+# and the subset that collects nothing. One source of truth for
+# apply-time validation AND the trial controller.
+COLLECTOR_KINDS = ("StdOut", "File", "TensorFlowEvent", "None",
+                   "PrometheusMetric", "Custom")
+UNSUPPORTED_COLLECTOR_KINDS = ("PrometheusMetric", "Custom")
+NO_COLLECTION_KINDS = ("None",) + UNSUPPORTED_COLLECTOR_KINDS
+
 OBJECTIVE_MAXIMIZE = "maximize"
 OBJECTIVE_MINIMIZE = "minimize"
 
@@ -125,10 +134,17 @@ class Experiment(Resource):
             raise ValidationError("spec.trialTemplate.trialSpec", "required")
         mc = self.metrics_collector_spec()
         ckind = (mc.get("collector") or {}).get("kind", "StdOut")
-        if ckind not in ("StdOut", "File", "TensorFlowEvent"):
+        # The full Katib collector-kind set is accepted at apply time
+        # (portable reference manifests use e.g. kind: None — PyYAML
+        # reads that as the STRING "None" — to disable collection);
+        # kinds this build does not implement (PrometheusMetric/Custom)
+        # surface as a reconcile-time MetricsUnavailable status, not an
+        # apply-time 400. A genuinely null kind (hand-built JSON) stays
+        # a loud 400 rather than silently disabling collection.
+        if ckind not in COLLECTOR_KINDS:
             raise ValidationError(
                 "spec.metricsCollectorSpec.collector.kind",
-                f"{ckind!r} not one of StdOut/File/TensorFlowEvent")
+                f"{ckind!r} not one of {'/'.join(COLLECTOR_KINDS)}")
         if ckind in ("File", "TensorFlowEvent") and not (
                 ((mc.get("source") or {}).get("fileSystemPath") or {})
                 .get("path")):

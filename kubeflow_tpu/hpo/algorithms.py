@@ -426,8 +426,13 @@ class DartsOneShot(Algorithm):
     name = "darts"
 
     def suggest(self, trials, count):
-        if trials:
-            return []  # the one search trial exists (or finished)
+        # Only a LIVE or finished search trial blocks a new one: a
+        # failed supernet search must be resubmitted (Katib relaunches
+        # failed trials within maxFailedTrialCount; counting it here
+        # would stall the experiment forever with zero succeeded
+        # trials).
+        if any((t.get("status") or "") != "Failed" for t in trials):
+            return []
         rng = self._rng(0)
         return [self.space.sample(rng)]
 

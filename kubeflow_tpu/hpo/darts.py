@@ -234,7 +234,15 @@ def evaluate_genotype(genotype: List[str], dataset: str = "mnist",
         xb, yb = next(it)
         params, state, _ = step_fn(params, state, xb, yb)
 
-    xe, ye = val.eval_arrays(2048)
+    # Disjoint scoring slice: search() optimizes the alphas on the eval
+    # split's epoch-0 batch stream, so scoring the genotype there would
+    # measure data the search selected for (a selection leak). The
+    # synthetic streams are seeded per (split, epoch, step): a far-away
+    # epoch_seed yields a deterministic, same-distribution sample set
+    # disjoint from every batch the alpha updates consumed.
+    parts = list(val.batches(1024, steps=2, epoch_seed=1_000_003))
+    xe = np.concatenate([p[0] for p in parts])
+    ye = np.concatenate([p[1] for p in parts])
 
     @jax.jit
     def acc_fn(params, x, y):

@@ -9,9 +9,14 @@ wire contract is ours on both ends).
 
 Service:  kfx.Suggestion / GetSuggestions, ValidateAlgorithmSettings
 Request:  {"algorithm": ..., "parameters": [...], "objectiveType": ...,
-           "trials": [{"assignments": {...}, "value": 1.0}], "count": N,
-           "settings": {...}, "seed": 0}
+           "trials": [{"assignments": {...}, "value": 1.0,
+                       "status": "Succeeded|Failed|EarlyStopped|Running"}],
+           "count": N, "settings": {...}, "seed": 0}
 Response: {"assignments": [{name: value}, ...]} | {"error": ...}
+
+``status`` is required for one-shot algorithms (darts): a Failed search
+trial must be distinguishable from a live/finished one so it can be
+resubmitted instead of permanently blocking the experiment.
 """
 
 from __future__ import annotations

@@ -133,7 +133,7 @@ class TrialController(Controller):
         emptyDir at /var/log/katib; here the gang workdir is the
         scratch the runner sees as cwd. Path is "" for StdOut."""
         spec = trial.spec.get("metricsCollectorSpec") or {}
-        kind = ((spec.get("collector") or {}).get("kind")) or "StdOut"
+        kind = (spec.get("collector") or {}).get("kind") or "StdOut"
         if kind == "StdOut":
             return kind, ""
         path = (((spec.get("source") or {})
@@ -142,14 +142,14 @@ class TrialController(Controller):
             path = os.path.join(self.gangs.workdir_for(gkey), path)
         return kind, path
 
-    def _collect_observations(self, trial: K.Trial, job,
+    def _collect_observations(self, kind: str, path: str, job,
                               metric_names: List[str]) -> List[dict]:
         """Observations per the collector spec (Katib collector kinds,
         SURVEY.md §2.2 metrics-collector row): StdOut (default) parses
         the chief log, File parses source.fileSystemPath.path,
-        TensorFlowEvent scans an event-file directory for scalar tags."""
-        gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
-        kind, path = self._collector_kind_path(trial, gkey)
+        TensorFlowEvent scans an event-file directory for scalar tags.
+        No-collection kinds never reach here (reconcile short-circuits
+        them before the db-manager legs)."""
         if kind == "File":
             return parse_metrics_text(self._read_text(path), metric_names)
         if kind == "TensorFlowEvent":
@@ -216,23 +216,42 @@ class TrialController(Controller):
             (trial.spec.get("objective") or {}).get(
                 "additionalMetricNames") or [])
         metric_names = [m for m in metric_names if m]
-        observations = self._collect_observations(trial, job, metric_names)
-        self.observations.report(trial.key, observations)
-        # Read BACK through the db-manager boundary (GetObservationLog):
-        # the trial's recorded observation is what the store serves, not
-        # the collector's local list — both legs of the reference's
-        # metrics flow cross the wire (SURVEY.md §3 CS2 step 4). The
-        # local list is the fallback iff the read comes back empty
-        # (report is replace-all, so a concurrent foreign writer racing
-        # this window could otherwise blank a successful trial's
-        # metrics; Katib shares the same last-writer-wins semantics).
-        stored = self.observations.get(trial.key)
-        summary = summarize(stored if stored else observations)
+        gkey = f"{job.KIND.lower()}/{job.namespace}/{job.name}"
+        ckind, cpath = self._collector_kind_path(trial, gkey)
+        if ckind in K.NO_COLLECTION_KINDS:
+            # Collection disabled (None) or unimplemented: nothing to
+            # push or read through the db-manager.
+            summary: Dict[str, Any] = {}
+        else:
+            observations = self._collect_observations(ckind, cpath, job,
+                                                      metric_names)
+            self.observations.report(trial.key, observations)
+            # Read BACK through the db-manager boundary
+            # (GetObservationLog): the trial's recorded observation is
+            # what the store serves, not the collector's local list —
+            # both legs of the reference's metrics flow cross the wire
+            # (SURVEY.md §3 CS2 step 4). The local list is the fallback
+            # iff the read comes back empty (report is replace-all, so a
+            # concurrent foreign writer racing this window could
+            # otherwise blank a successful trial's metrics; Katib shares
+            # the same last-writer-wins semantics).
+            stored = self.observations.get(trial.key)
+            summary = summarize(stored if stored else observations)
         observation = {"metrics": [
             {"name": name, **vals} for name, vals in summary.items()]}
 
         if job.has_condition("Succeeded"):
-            if trial.objective_metric() and \
+            if ckind == "None":
+                # Collection explicitly disabled (Katib collector kind
+                # None): the job's success stands, observation empty.
+                conds = [(K.TRIAL_SUCCEEDED, "True", "JobSucceeded")]
+            elif ckind in K.UNSUPPORTED_COLLECTOR_KINDS:
+                # Accepted at apply for manifest portability; surfaced
+                # here as the clear status the spec can act on.
+                conds = [(K.TRIAL_METRICS_UNAVAILABLE, "True",
+                          "UnsupportedCollector"),
+                         (K.TRIAL_FAILED, "True", "MetricsUnavailable")]
+            elif trial.objective_metric() and \
                     trial.objective_metric() not in summary:
                 conds = [(K.TRIAL_METRICS_UNAVAILABLE, "True",
                           "NoObjectiveInLog"),
@@ -413,7 +432,13 @@ class ExperimentController(Controller):
             self._finish(exp, K.EXP_FAILED, K.EXP_FAILED,
                          f"{len(failed)} trials failed")
             return None
-        if len(trials) >= exp.max_trial_count() and not running:
+        # Failed trials do NOT consume the trial budget: they are
+        # replaced (Katib resubmission semantics) until
+        # maxFailedTrialCount above fails the whole experiment — without
+        # this, a maxTrialCount=1 one-shot (DARTS) whose single search
+        # trial crashed would finish "Succeeded" with zero results.
+        budget_used = len(trials) - len(failed)
+        if budget_used >= exp.max_trial_count() and not running:
             self._finish(exp, K.EXP_SUCCEEDED, K.EXP_SUCCEEDED,
                          "max trials completed")
             return None
@@ -433,7 +458,7 @@ class ExperimentController(Controller):
         self._maybe_early_stop(exp, running, succeeded)
 
         want = min(exp.parallel_trial_count() - len(running),
-                   exp.max_trial_count() - len(trials))
+                   exp.max_trial_count() - budget_used)
         if want > 0:
             self._spawn_trials(exp, trials, want)
         return Result(requeue=True, requeue_after=0.5)
@@ -463,9 +488,17 @@ class ExperimentController(Controller):
         hist = []
         for t in trials:
             assert isinstance(t, K.Trial)
+            status = ("Failed" if t.has_condition(K.TRIAL_FAILED)
+                      else "Succeeded" if t.has_condition(K.TRIAL_SUCCEEDED)
+                      else "EarlyStopped"
+                      if t.has_condition(K.TRIAL_EARLY_STOPPED)
+                      else "Running")
             hist.append({
                 "assignments": t.assignments_dict(),
                 "value": t.final_metric(metric),
+                # One-shot algorithms need to distinguish a live/finished
+                # search trial from a failed one that must be replaced.
+                "status": status,
             })
         return hist
 

@@ -86,6 +86,24 @@ class TestAlgorithms:
         assert algo.suggest([{"assignments": first[0], "value": 0.9}],
                             5) == []
 
+    def test_darts_resubmits_after_failed_search_trial(self):
+        """A Failed supernet-search trial must not stall the experiment:
+        the single search trial is relaunched (within
+        maxFailedTrialCount), while a Running or Succeeded one blocks
+        new suggestions."""
+        from kubeflow_tpu.hpo.algorithms import get_algorithm
+
+        algo = get_algorithm("darts", [dict(p) for p in PARAMS], seed=7)
+        a = algo.suggest([], 1)[0]
+        failed = {"assignments": a, "value": None, "status": "Failed"}
+        assert len(algo.suggest([failed], 1)) == 1
+        assert algo.suggest(
+            [failed, {"assignments": a, "value": None,
+                      "status": "Running"}], 1) == []
+        assert algo.suggest(
+            [failed, {"assignments": a, "value": 0.8,
+                      "status": "Succeeded"}], 1) == []
+
     def test_grid_exhaustive_and_deduped(self):
         from kubeflow_tpu.hpo.algorithms import get_algorithm
 
@@ -317,8 +335,109 @@ spec:
 """
 
 
+class TestCollectorKinds:
+    def test_full_katib_kind_set_validates(self):
+        """Portable reference manifests (e.g. collector kind None to
+        disable collection) must pass apply-time validation; only
+        genuinely unknown kinds are 400s."""
+        import yaml
+
+        from kubeflow_tpu.api.base import ValidationError, from_manifest
+
+        def exp_with(kind):
+            doc = yaml.safe_load(EXPERIMENT.format(name="k", python=PY))
+            doc["spec"]["metricsCollectorSpec"] = {
+                "collector": {"kind": kind},
+                **({"source": {"fileSystemPath": {"path": "m.txt"}}}
+                   if kind in ("File", "TensorFlowEvent") else {})}
+            obj = from_manifest(doc)
+            obj.validate()
+            return obj
+
+        for kind in ("StdOut", "File", "TensorFlowEvent", "None",
+                     "PrometheusMetric", "Custom"):
+            exp_with(kind)
+        # A genuinely null kind (hand-built JSON; YAML's unquoted
+        # `kind: None` parses to the STRING "None") stays a loud 400
+        # rather than silently disabling collection.
+        with pytest.raises(ValidationError):
+            exp_with(None)
+        with pytest.raises(ValidationError, match="Bogus"):
+            exp_with("Bogus")
+
+    def test_none_collector_trial_succeeds_without_metrics(self, tmp_path):
+        """kind None disables collection: a succeeded job stays a
+        succeeded trial with an empty observation, and an unsupported
+        kind surfaces as reconcile-time MetricsUnavailable."""
+        import yaml
+
+        from kubeflow_tpu.api.base import from_manifest
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        doc = yaml.safe_load(EXPERIMENT.format(name="nocollect",
+                                               python=PY))
+        doc["spec"]["metricsCollectorSpec"] = {
+            "collector": {"kind": "None"}}
+        doc["spec"]["maxTrialCount"] = 1
+        doc["spec"]["parallelTrialCount"] = 1
+        # No objective can ever be observed with collection off; drop
+        # the goalless objective comparison to the trial-count budget.
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply([from_manifest(doc)])
+            _deadline = time.monotonic() + 60
+            trial = None
+            while time.monotonic() < _deadline:
+                trials = cp.store.list("Trial")
+                if trials and (trials[0].has_condition("Succeeded")
+                               or trials[0].has_condition("Failed")):
+                    trial = trials[0]
+                    break
+                time.sleep(0.3)
+            assert trial is not None, "trial never finished"
+            assert trial.has_condition("Succeeded"), trial.conditions
+            assert not trial.has_condition("MetricsUnavailable")
+            assert trial.status.get("observation", {}).get(
+                "metrics", []) == []
+
+
 @pytest.mark.slow
 class TestExperimentE2E:
+    def test_failed_trial_is_resubmitted_within_budget(self, tmp_path):
+        """Failed trials don't consume maxTrialCount (Katib resubmission
+        semantics): with maxTrialCount=1, a trial that crashes once is
+        replaced, and the experiment still reaches one succeeded trial —
+        maxFailedTrialCount remains the runaway guard."""
+        import yaml
+
+        from kubeflow_tpu.api.base import from_manifest
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        marker = tmp_path / "crashed-once"
+        doc = yaml.safe_load(EXPERIMENT.format(name="resub", python=PY))
+        doc["spec"]["maxTrialCount"] = 1
+        doc["spec"]["parallelTrialCount"] = 1
+        doc["spec"]["maxFailedTrialCount"] = 2
+        c = doc["spec"]["trialTemplate"]["trialSpec"]["spec"][
+            "jaxReplicaSpecs"]["Worker"]["template"]["spec"][
+            "containers"][0]
+        c["command"] = [PY, "-c", (
+            "import pathlib, sys\n"
+            f"p = pathlib.Path({str(marker)!r})\n"
+            "if p.exists():\n"
+            "    print('score=0.9')\n"
+            "else:\n"
+            "    p.write_text('x'); sys.exit(3)\n")]
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply([from_manifest(doc)])
+            exp = cp.wait_for_condition("Experiment", "resub", "Succeeded",
+                                        timeout=120)
+            s = exp.status
+            assert s["trialsSucceeded"] == 1, s
+            assert s["trialsFailed"] == 1, s
+            assert len(cp.store.list("Trial")) == 2
+
     def test_random_experiment_completes(self, tmp_path):
         """The sweep runs trials whose 'training' prints score=<x>; the
         best trial must be the one with the highest x."""
