@@ -437,9 +437,21 @@ class DartsOneShot(Algorithm):
         return [self.space.sample(rng)]
 
 
+class EnasOneShot(DartsOneShot):
+    """One-shot weight-sharing NAS with an RL controller (SURVEY.md
+    §2.2 ENAS/DARTS row). Identical suggestion shape to darts — the
+    single trial (``runners.enas_runner`` over ``hpo/enas.py``) owns
+    the search; there the controller samples subgraphs that all share
+    one supernet's weights and updates by REINFORCE, where darts
+    relaxes the choice differentiably."""
+
+    name = "enas"
+
+
 _ALGORITHMS = {cls.name: cls for cls in
                (RandomSearch, GridSearch, TPE, BayesianOptimization, CMAES,
-                Hyperband, RegularizedEvolution, DartsOneShot)}
+                Hyperband, RegularizedEvolution, DartsOneShot,
+                EnasOneShot)}
 # Katib aliases
 _ALGORITHMS["bayesian"] = BayesianOptimization
 _ALGORITHMS["skopt"] = BayesianOptimization

@@ -1,0 +1,210 @@
+"""ENAS-style weight-sharing NAS with an RL controller (tpu-first).
+
+Reference role (SURVEY.md §2.2 suggestion-services row): Katib names
+"NAS (ENAS/DARTS)" — two one-shot trial engines over ONE weight-sharing
+supernet. ``hpo/darts.py`` is the differentiable half; this module is
+the controller half: a policy samples DISCRETE subgraphs, the shared
+weights train on the sampled subgraph's loss, and the policy updates by
+REINFORCE on each subgraph's held-out accuracy. Every candidate
+architecture a trial evaluates therefore reuses one set of weights —
+the ENAS contract — instead of training per candidate.
+
+The JAX shape:
+* A sampled genotype becomes a saturated one-hot alpha into the SAME
+  ``SuperNet`` mixed op (softmax of ±20 logits ≈ exact selection), so
+  every sampled architecture runs the one already-compiled static-shape
+  XLA graph — no per-architecture recompiles, exactly the property that
+  makes weight sharing cheap on an accelerator.
+* The controller is a plain (edges, |OPS|) logits table (the RNN of the
+  paper adds sequence conditioning the chain search space doesn't
+  need); its REINFORCE step — advantage-weighted log-prob plus an
+  entropy bonus against premature collapse — is one jitted update.
+* Rewards come from a jitted shared-weight accuracy eval on held-out
+  batches; the moving-average baseline keeps the gradient low-variance.
+
+Discretization is argmax over the controller logits; the genotype is
+scored by retraining from scratch on a disjoint stream
+(``darts.evaluate_genotype``) — same honest protocol as DARTS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..data.synthetic import get_dataset
+from .darts import (
+    OPS,
+    SuperNet,
+    _xent,
+    evaluate_genotype,
+    random_genotype,
+)
+
+__all__ = ["OPS", "EnasResult", "search", "random_genotype"]
+
+# Saturated logit for the one-hot alpha: softmax([20, 0, ...]) puts
+# >1-1e-8 of the blend on the selected op in f32.
+_SELECT = 20.0
+
+
+@dataclasses.dataclass
+class EnasResult:
+    genotype: List[str]
+    val_accuracy: float
+    logits: np.ndarray
+    history: List[Dict[str, float]]
+
+
+def _onehot_alpha(idx: jnp.ndarray, n_ops: int) -> jnp.ndarray:
+    return jax.nn.one_hot(idx, n_ops, dtype=jnp.float32) * _SELECT
+
+
+def search(dataset: str = "mnist", edges: int = 3, features: int = 16,
+           search_steps: int = 120, eval_steps: int = 120,
+           batch_size: int = 128, lr: float = 2e-3,
+           ctrl_lr: float = 5e-2, samples_per_step: int = 4,
+           w_steps_per_round: int = 2, warmup_steps: Optional[int] = None,
+           baseline_decay: float = 0.9, entropy_weight: float = 1e-2,
+           seed: int = 0, log=None) -> EnasResult:
+    """Run ENAS (shared-weight training + REINFORCE controller), then
+    retrain + score the argmax genotype. Deterministic in (all args).
+
+    Two standard one-shot provisions keep the shared weights trainable
+    under the tiny budgets the tests use:
+    * fair warmup (FairNAS-style): the warmup phase cycles the PURE
+      single-op architectures (conv3^E, conv1^E, ...) so every
+      candidate op gets consistent gradient and the bf16 net breaks
+      symmetry — a uniform softmax blend attenuates each op by 1/|OPS|
+      per edge and compounds to near-zero signal, and per-step random
+      archs churn too fast to break symmetry at all (both measured
+      flat at ln(10) for 100 steps on the mnist preset);
+    * the weight phase resamples any 'zero' edge to a trainable op —
+      an all-zero path blanks every upstream gradient while teaching
+      nothing the reward phase doesn't already tell the controller
+      about zero."""
+    train = get_dataset(dataset, "train", seed=seed)
+    val = get_dataset(dataset, "eval", seed=seed)
+    net = SuperNet(num_classes=train.num_classes, edges=edges,
+                   features=features)
+    n_ops = len(OPS)
+
+    key = jax.random.PRNGKey(seed)
+    x0 = jnp.zeros((1, *train.shape), jnp.float32)
+    params = net.init(key, x0, jnp.zeros((edges, n_ops), jnp.float32))[
+        "params"]
+    w_opt = optax.adam(lr)
+    w_state = w_opt.init(params)
+    theta = jnp.zeros((edges, n_ops), jnp.float32)
+    c_opt = optax.adam(ctrl_lr)
+    c_state = c_opt.init(theta)
+
+    @jax.jit
+    def w_step(params, w_state, idx, xb, yb):
+        alphas = _onehot_alpha(idx, n_ops)
+
+        def loss_fn(p):
+            return _xent(net.apply({"params": p}, xb, alphas), yb)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, w_state = w_opt.update(g, w_state)
+        return optax.apply_updates(params, updates), w_state, loss
+
+    @jax.jit
+    def rewards_fn(params, idx_batch, xv, yv):
+        """Accuracy of every sampled arch on one val batch — vmapped
+        over the (K, E) arch batch: one dispatch, one transfer."""
+
+        def one(idx):
+            logits = net.apply({"params": params}, xv,
+                               _onehot_alpha(idx, n_ops))
+            return jnp.mean(
+                (jnp.argmax(logits, -1) == yv).astype(jnp.float32))
+
+        return jax.vmap(one)(idx_batch)
+
+    @jax.jit
+    def ctrl_step(theta, c_state, idx_batch, adv):
+        def loss_fn(th):
+            logp = jax.nn.log_softmax(th, axis=-1)          # (E, O)
+            sel = jnp.take_along_axis(
+                logp[None], idx_batch[:, :, None], axis=-1)  # (K, E, 1)
+            obj = jnp.mean(adv * jnp.sum(sel[..., 0], axis=-1))
+            probs = jax.nn.softmax(th, axis=-1)
+            entropy = -jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1)
+            return -(obj + entropy_weight * jnp.mean(entropy))
+
+        g = jax.grad(loss_fn)(theta)
+        updates, c_state = c_opt.update(g, c_state)
+        return optax.apply_updates(theta, updates), c_state
+
+    rng = np.random.default_rng(seed + 17)
+    zero_idx = OPS.index("zero")
+    trainable_ops = [i for i in range(n_ops) if i != zero_idx]
+
+    def sample(k: int, trainable_only: bool = False) -> np.ndarray:
+        probs = np.asarray(jax.nn.softmax(theta, axis=-1))
+        if trainable_only:
+            probs = probs.copy()
+            probs[:, zero_idx] = 0.0
+            probs /= probs.sum(axis=1, keepdims=True)
+        return np.stack([
+            [rng.choice(n_ops, p=probs[e]) for e in range(edges)]
+            for _ in range(k)]).astype(np.int32)
+
+    history: List[Dict[str, float]] = []
+    baseline: Optional[float] = None
+    train_it = train.batches(batch_size)
+    val_it = val.batches(batch_size)
+    if warmup_steps is None:
+        warmup_steps = len(trainable_ops) * 40
+    block = max(warmup_steps // max(len(trainable_ops), 1), 1)
+    for step in range(warmup_steps):
+        xb, yb = next(train_it)
+        # Fair warmup in CONSECUTIVE per-op blocks: each trainable op's
+        # pure architecture trains for `block` steps in a row — per-step
+        # alternation never breaks the bf16 net's symmetry (measured
+        # flat at ln(10)), while ~40 consecutive steps do.
+        op = trainable_ops[min(step // block, len(trainable_ops) - 1)]
+        arch = jnp.full((edges,), op, jnp.int32)
+        params, w_state, wl = w_step(params, w_state, arch,
+                                     jnp.asarray(xb), jnp.asarray(yb))
+        if log and step % 20 == 0:
+            log(f"warmup_step={step} shared_loss={float(wl):.4f}")
+    for step in range(search_steps):
+        # Shared-weight phase: train batches through sampled archs.
+        wl = 0.0
+        for _ in range(w_steps_per_round):
+            xb, yb = next(train_it)
+            w_arch = sample(1, trainable_only=True)[0]
+            params, w_state, wl = w_step(params, w_state,
+                                         jnp.asarray(w_arch),
+                                         jnp.asarray(xb), jnp.asarray(yb))
+        # Controller phase: K archs scored with the SHARED weights.
+        xv, yv = next(val_it)
+        archs = sample(samples_per_step)
+        rewards = np.asarray(rewards_fn(params, jnp.asarray(archs),
+                                        jnp.asarray(xv), jnp.asarray(yv)))
+        mean_r = float(rewards.mean())
+        baseline = mean_r if baseline is None else (
+            baseline_decay * baseline + (1 - baseline_decay) * mean_r)
+        adv = jnp.asarray(rewards - baseline, jnp.float32)
+        theta, c_state = ctrl_step(theta, c_state, jnp.asarray(archs), adv)
+        if log and (step % 20 == 0 or step == search_steps - 1):
+            log(f"step={step} shared_loss={float(wl):.4f} "
+                f"reward_mean={mean_r:.4f} baseline={baseline:.4f}")
+        history.append({"shared_loss": float(wl), "reward_mean": mean_r,
+                        "baseline": float(baseline)})
+
+    genotype = [OPS[int(i)]
+                for i in np.argmax(np.asarray(theta), axis=1)]
+    acc = evaluate_genotype(genotype, dataset=dataset, features=features,
+                            steps=eval_steps, batch_size=batch_size,
+                            lr=lr, seed=seed)
+    return EnasResult(genotype=genotype, val_accuracy=acc,
+                      logits=np.asarray(theta), history=history)

@@ -1,0 +1,67 @@
+"""ENAS weight-sharing NAS trial entrypoint (see hpo/enas.py).
+
+Reference role (SURVEY.md §2.2 suggestion-services row): Katib's ENAS
+runs ONE trial in which an RL controller samples subgraphs of a
+weight-sharing supernet — every candidate reuses one set of weights —
+and the discovered architecture is emitted, instead of one trial per
+candidate. Same process/metrics contract as the DARTS runner:
+``val_acc=X`` is the objective, ``genotype=a|b|c`` the architecture;
+``--arch=random`` trains a random genotype under the identical budget
+as the experiments' same-cost baseline arm.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="kfx ENAS one-shot NAS trial")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--edges", type=int, default=3)
+    p.add_argument("--features", type=int, default=16)
+    p.add_argument("--search-steps", type=int, default=120)
+    p.add_argument("--eval-steps", type=int, default=120)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--learning-rate", type=float, default=2e-3)
+    p.add_argument("--controller-lr", type=float, default=5e-2)
+    p.add_argument("--samples-per-step", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--arch", default="search", choices=["search", "random"],
+                   help="search: ENAS controller; random: a random "
+                        "genotype trained with the same eval budget "
+                        "(baseline arm)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from ..hpo.enas import evaluate_genotype, random_genotype, search
+
+    if args.arch == "random":
+        genotype = random_genotype(args.edges, seed=args.seed)
+        acc = evaluate_genotype(
+            genotype, dataset=args.dataset, features=args.features,
+            steps=args.eval_steps, batch_size=args.batch_size,
+            lr=args.learning_rate, seed=args.seed)
+        print(f"genotype={'|'.join(genotype)} arch_source=random",
+              flush=True)
+        print(f"step={args.eval_steps} val_acc={acc:.6f}", flush=True)
+        return 0
+
+    result = search(
+        dataset=args.dataset, edges=args.edges, features=args.features,
+        search_steps=args.search_steps, eval_steps=args.eval_steps,
+        batch_size=args.batch_size, lr=args.learning_rate,
+        ctrl_lr=args.controller_lr,
+        samples_per_step=args.samples_per_step, seed=args.seed,
+        log=lambda s: print(s, flush=True))
+    print(f"genotype={'|'.join(result.genotype)} arch_source=search",
+          flush=True)
+    print(f"step={args.search_steps} "
+          f"val_acc={result.val_accuracy:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

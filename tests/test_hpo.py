@@ -533,25 +533,17 @@ spec:
                   for p in best["parameterAssignments"]}
             assert pa["layers"] in ("2", "4", "8") and 64 <= int(pa["ffn"])
 
-    def test_darts_one_shot_nas_beats_random(self, tmp_path):
-        """One-shot differentiable NAS (SURVEY.md §2.2 ENAS/DARTS row):
-        a single trial trains the weight-sharing supernet, reports the
-        discovered genotype + val_acc, and the discovered architecture
-        must beat a random genotype trained with the same budget."""
-        from kubeflow_tpu.api.manifest import load_manifests
-        from kubeflow_tpu.controlplane import ControlPlane
-
-        text = f"""
+    NAS_EXPERIMENT = """
 apiVersion: kubeflow.org/v1
 kind: Experiment
 metadata:
-  name: darts
+  name: {name}
 spec:
   objective:
     type: maximize
     objectiveMetricName: val_acc
   algorithm:
-    algorithmName: darts
+    algorithmName: {algorithm}
   maxTrialCount: 1
   parallelTrialCount: 1
   maxFailedTrialCount: 1
@@ -561,7 +553,7 @@ spec:
     feasibleSpace: {{list: ["3"]}}
   - name: searchSteps
     parameterType: categorical
-    feasibleSpace: {{list: ["150"]}}
+    feasibleSpace: {{list: ["{search_steps}"]}}
   trialTemplate:
     trialParameters:
     - name: edges
@@ -580,43 +572,73 @@ spec:
               spec:
                 containers:
                 - name: t
-                  command: ["{PY}", "-m",
-                            "kubeflow_tpu.runners.darts_runner",
-                            "--edges=${{trialParameters.edges}}",
-                            "--search-steps=${{trialParameters.searchSteps}}",
-                            "--eval-steps=120", "--features=8",
-                            "--batch-size=64", "--learning-rate=4e-3",
-                            "--alpha-learning-rate=1e-2", "--seed=0"]
+                  command: [{command}]
 """
+
+    def _run_nas_e2e(self, tmp_path, name, algorithm, runner,
+                     search_steps, extra_args=()):
+        """Shared one-shot NAS harness: run the single-trial experiment,
+        return (objective value, chief log, control plane store dump of
+        the random-baseline accuracy under the identical eval budget)."""
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+        from kubeflow_tpu.hpo.darts import evaluate_genotype, random_genotype
+
+        args = ["--edges=${trialParameters.edges}",
+                "--search-steps=${trialParameters.searchSteps}",
+                "--eval-steps=120", "--features=8", "--batch-size=64",
+                "--learning-rate=4e-3", "--seed=0", *extra_args]
+        command = ", ".join(
+            f'"{a}"' for a in
+            [PY, "-m", f"kubeflow_tpu.runners.{runner}", *args])
+        text = self.NAS_EXPERIMENT.format(name=name, algorithm=algorithm,
+                                          search_steps=search_steps,
+                                          command=command)
         with ControlPlane(home=str(tmp_path / "kfx"),
                           worker_platform="cpu") as cp:
             cp.apply(load_manifests(text))
-            exp = cp.wait_for_condition("Experiment", "darts", "Succeeded",
+            exp = cp.wait_for_condition("Experiment", name, "Succeeded",
                                         timeout=600)
             s = exp.status
             assert s["trialsSucceeded"] == 1
             best = s["currentOptimalTrial"]
             searched_acc = float(best["observation"]["metrics"][0]["latest"])
-            # The discovered genotype is in the trial log.
             (job,) = cp.store.list("JAXJob")
             log = cp.job_logs("JAXJob", job.name, job.namespace)
-            assert "arch_source=search" in log
-            genotype_line = next(ln for ln in log.splitlines()
-                                 if ln.startswith("genotype="))
-            genotype = genotype_line.split()[0].split("=")[1].split("|")
-            assert len(genotype) == 3
-            # Better than random: same eval budget, random genotype.
-            from kubeflow_tpu.hpo.darts import (
-                evaluate_genotype,
-                random_genotype,
-            )
+        assert "arch_source=search" in log
+        genotype_line = next(ln for ln in log.splitlines()
+                             if ln.startswith("genotype="))
+        genotype = genotype_line.split()[0].split("=")[1].split("|")
+        assert len(genotype) == 3
+        # Better than random: same eval budget, random genotype.
+        rand_acc = evaluate_genotype(random_genotype(3, seed=1),
+                                     steps=120, features=8,
+                                     batch_size=64, lr=4e-3, seed=0)
+        assert searched_acc > rand_acc + 0.1, (
+            f"{algorithm} search {searched_acc} vs random {rand_acc}")
+        assert searched_acc > 0.8
+        return searched_acc, log
 
-            rand_acc = evaluate_genotype(random_genotype(3, seed=1),
-                                         steps=120, features=8,
-                                         batch_size=64, lr=4e-3, seed=0)
-            assert searched_acc > rand_acc + 0.1, (
-                f"search {searched_acc} vs random {rand_acc}")
-            assert searched_acc > 0.8
+    def test_darts_one_shot_nas_beats_random(self, tmp_path):
+        """One-shot differentiable NAS (SURVEY.md §2.2 ENAS/DARTS row):
+        a single trial trains the weight-sharing supernet, reports the
+        discovered genotype + val_acc, and the discovered architecture
+        must beat a random genotype trained with the same budget."""
+        self._run_nas_e2e(tmp_path, "darts", "darts", "darts_runner",
+                          search_steps=150,
+                          extra_args=("--alpha-learning-rate=1e-2",))
+
+    def test_enas_weight_sharing_nas_beats_random(self, tmp_path):
+        """ENAS half of SURVEY.md §2.2's "NAS (ENAS/DARTS)": a single
+        trial in which an RL controller samples subgraphs that all share
+        one supernet's weights (REINFORCE on held-out accuracy), and the
+        discovered architecture must beat a random genotype trained with
+        the same budget."""
+        _, log = self._run_nas_e2e(tmp_path, "enas", "enas", "enas_runner",
+                                   search_steps=100)
+        # Weight sharing is observable: controller rewards are scored
+        # against the ONE shared supernet, logged per round.
+        assert "reward_mean=" in log
 
     def test_file_metrics_collector(self, tmp_path):
         """Katib collector-kind parity: kind=File reads the objective
