@@ -158,7 +158,12 @@ def _box_check() -> dict:
     per-section story lives in _BoxGuard's report)."""
     strays = _find_strays()
     out = {"stray_workers_at_start": len(strays),
-           "load_avg_at_start": round(os.getloadavg()[0], 2)}
+           "load_avg_at_start": round(os.getloadavg()[0], 2),
+           # Host shape, for cross-round comparability of the CPU-bound
+           # rows: the round-4 box exposes ONE core (full suite 1008s in
+           # r3 -> 2896s in r4 on identical tests), so wall-clock deltas
+           # must be read against this field, not assumed to be code.
+           "cpu_count": len(os.sched_getaffinity(0))}
     if strays:
         out["stray_workers_at_start_evidence"] = strays[:5]
     return out

@@ -475,12 +475,21 @@ class InferenceServiceController(Controller):
         url = f"http://127.0.0.1:{rt.router.port}"
         ready_counts = {name: len(rev.endpoints())
                         for name, rev in rt.revisions.items()}
+        # Total spawned replicas alongside ready ones (KFServing's
+        # component status carries both): the autoscaler's DECISION is
+        # observable the moment it spawns, even while a new replica is
+        # still loading its model.
+        replica_counts = {name: len(rev.replicas)
+                          for name, rev in rt.revisions.items()}
         changed = False
         if isvc.status.get("url") != url:
             isvc.status["url"] = url
             changed = True
         if isvc.status.get("readyReplicas") != ready_counts:
             isvc.status["readyReplicas"] = ready_counts
+            changed = True
+        if isvc.status.get("replicas") != replica_counts:
+            isvc.status["replicas"] = replica_counts
             changed = True
         status = "True" if all_ready else "False"
         for ctype in (ISVC_PREDICTOR_READY, ISVC_READY):
