@@ -21,12 +21,13 @@ ISVC_FAILED = "Failed"
 
 # Accepted predictor frameworks. Servers exist for jax (serving/server.py),
 # pytorch (TorchScript, serving/torch_server.py), tensorflow (SavedModel,
-# serving/tf_server.py) and the LM export (:generate). sklearn / xgboost /
-# onnx / triton match the reference API surface but are NOT serveable in
-# this environment — those runtimes are not installed and there is no
-# network to fetch them (SURVEY.md §0.1); applying one fails at revision
-# startup with a clear server-side error rather than at validation, so the
-# same manifest works on an environment that has them.
+# serving/tf_server.py), sklearn (joblib, serving/sklearn_server.py) and
+# the LM export (:generate). xgboost / onnx / triton match the reference
+# API surface but are NOT serveable in this environment — those runtimes
+# are not installed and there is no network to fetch them (SURVEY.md
+# §0.1); applying one fails at revision startup with a clear server-side
+# error rather than at validation, so the same manifest works on an
+# environment that has them.
 PREDICTOR_FRAMEWORKS = ["jax", "sklearn", "xgboost", "pytorch", "tensorflow",
                         "onnx", "triton", "custom"]
 COMPONENTS = ["predictor", "transformer", "explainer"]

@@ -55,7 +55,7 @@ SEQ = 32
 # divergence source is reduction order in the cross-process collectives.
 RTOL = 2e-3
 
-VARIANTS = ("tp_fsdp", "cp")
+VARIANTS = ("tp_fsdp", "cp", "ep")
 
 
 def _build_loop(variant: str, n_devices: int):
@@ -74,6 +74,13 @@ def _build_loop(variant: str, n_devices: int):
         tp = 2 if n_devices % 2 == 0 else 1
         mesh, plan = make_mesh(n_devices, tp=tp, fsdp=True)
         cfg = TransformerConfig(**kw)
+    elif variant == "ep":
+        # MoE experts shard over "data" (dp=4 with 2 procs -> experts
+        # 0-1 live in process 0, 2-3 in process 1): the token-routing
+        # all-to-alls cross the process boundary.
+        tp = 2 if n_devices % 2 == 0 else 1
+        mesh, plan = make_mesh(n_devices, tp=tp, fsdp=True)
+        cfg = TransformerConfig(n_experts=plan.dp, **kw)
     else:
         raise ValueError(f"unknown variant {variant!r}; have {VARIANTS}")
     hp = LMHyperParams(total_steps=CHECK_STEPS, warmup_steps=1)

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import queue
 import threading
 import time
@@ -44,6 +45,19 @@ class Predictor:
     def predict(self, instances: np.ndarray,
                 probabilities: bool = False) -> Dict[str, Any]:
         raise NotImplementedError
+
+
+def load_export_meta(model_dir: str, filename: str = "config.json"):
+    """(input_shape, num_classes) from an export's metadata sidecar —
+    the shared shape every framework predictor records at export time."""
+    path = os.path.join(model_dir, filename)
+    if not os.path.exists(path):
+        return None, None
+    with open(path) as f:
+        meta = json.load(f)
+    shape = tuple(meta["input_shape"]) if meta.get("input_shape") else None
+    ncls = int(meta["num_classes"]) if meta.get("num_classes") else None
+    return shape, ncls
 
 
 class JaxPredictor(Predictor):
@@ -487,13 +501,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help=">0 enables the micro-batcher")
     p.add_argument("--batcher-reply-timeout-s", type=float, default=60.0)
     p.add_argument("--framework", default="auto",
-                   choices=["auto", "jax", "pytorch", "tensorflow", "lm"],
+                   choices=["auto", "jax", "pytorch", "tensorflow",
+                            "sklearn", "lm"],
                    help="predict backend; auto sniffs the export format")
     args = p.parse_args(argv)
 
     framework = args.framework
     if framework == "auto":
         from .lm_server import is_lm_export
+        from .sklearn_server import is_sklearn_export
         from .tf_server import is_tf_export
         from .torch_server import is_torch_export
 
@@ -503,6 +519,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             framework = "pytorch"
         elif is_tf_export(args.model_dir):
             framework = "tensorflow"
+        elif is_sklearn_export(args.model_dir):
+            framework = "sklearn"
         else:
             framework = "jax"
     if framework == "lm":
@@ -527,6 +545,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         predictor = TFPredictor(args.model_dir, name=args.name,
                                 max_batch_size=args.max_batch_size)
+    elif framework == "sklearn":
+        from .sklearn_server import SKLearnPredictor
+
+        predictor = SKLearnPredictor(args.model_dir, name=args.name,
+                                     max_batch_size=args.max_batch_size)
     else:
         predictor = JaxPredictor(args.model_dir, name=args.name,
                                  max_batch_size=args.max_batch_size,

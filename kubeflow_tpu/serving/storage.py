@@ -39,6 +39,7 @@ from typing import Callable, Dict, List
 EXPORT_FORMATS = (
     ("lm_config.json", ("lm_config.json", "params.msgpack")),
     ("model.pt", ("model.pt", "config.json")),
+    ("model.joblib", ("model.joblib", "config.json")),
     ("config.json", ("config.json", "params.msgpack")),
 )
 

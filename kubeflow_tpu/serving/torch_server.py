@@ -59,17 +59,13 @@ class TorchPredictor(Predictor):
     def load(self) -> None:
         import torch
 
+        from .server import load_export_meta
+
         self._module = torch.jit.load(
             os.path.join(self.model_dir, MODEL_FILE), map_location="cpu")
         self._module.eval()
-        cfg_path = os.path.join(self.model_dir, "config.json")
-        if os.path.exists(cfg_path):
-            with open(cfg_path) as f:
-                meta = json.load(f)
-            if meta.get("input_shape"):
-                self.input_shape = tuple(meta["input_shape"])
-            if meta.get("num_classes"):
-                self.num_classes = int(meta["num_classes"])
+        self.input_shape, self.num_classes = load_export_meta(
+            self.model_dir)
         # Warm one forward so the first request doesn't pay lazy init.
         if self.input_shape:
             x = np.zeros((1,) + self.input_shape, np.float32)

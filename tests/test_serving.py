@@ -105,6 +105,67 @@ spec:
             assert status == 200 and len(body["predictions"]) == 2
 
 
+class TestSKLearnServing:
+    """sklearn-server parity: a joblib export behind the same V1
+    protocol and InferenceService operator (framework auto-sniffed from
+    the export format)."""
+
+    @pytest.fixture(scope="class")
+    def sklearn_export(self, tmp_path_factory):
+        from sklearn.linear_model import LogisticRegression
+
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.serving.sklearn_server import export_sklearn
+
+        ds = get_dataset("mnist")
+        images, labels = next(ds.batches(512))
+        est = LogisticRegression(max_iter=50)
+        est.fit(images.reshape(len(images), -1), labels)
+        out = tmp_path_factory.mktemp("sk-export")
+        export_sklearn(str(out), est, input_shape=ds.shape,
+                       num_classes=ds.num_classes)
+        return str(out)
+
+    def test_predictor_direct(self, sklearn_export):
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.serving.sklearn_server import SKLearnPredictor
+
+        p = SKLearnPredictor(sklearn_export, name="sk")
+        p.load()
+        assert p.ready and p.input_shape == (28, 28, 1)
+        ds = get_dataset("mnist", split="eval")
+        images, labels = ds.eval_arrays(64)
+        out = p.predict(images, probabilities=True)
+        assert (np.asarray(out["predictions"]) == labels).mean() > 0.5
+        assert np.allclose(np.sum(out["probabilities"], axis=-1), 1.0,
+                           atol=1e-5)
+
+    def test_isvc_e2e(self, sklearn_export, tmp_path):
+        from kubeflow_tpu.api.manifest import load_manifests
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: sk
+spec:
+  predictor:
+    minReplicas: 1
+    sklearn:
+      storageUri: file://{sklearn_export}
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply(load_manifests(manifest))
+            isvc = cp.wait_for_condition("InferenceService", "sk",
+                                         "Ready", timeout=120)
+            url = isvc.status["url"]
+            x = np.zeros((2, 28, 28, 1), np.float32)
+            status, body = _post(f"{url}/v1/models/sk:predict",
+                                 {"instances": x.tolist()}, timeout=60)
+            assert status == 200 and len(body["predictions"]) == 2
+
+
 class TestModelServer:
     @pytest.fixture(scope="class")
     def server(self, export_dir):

@@ -20,3 +20,9 @@ class TestCrossProcessSPMD:
         ctx block 0 lives in process 0 and block 1 in process 1, so the
         ring ppermutes themselves cross the process boundary."""
         spmd_check.check("cp", str(tmp_path))
+
+    def test_ep_matches_single_process(self, tmp_path):
+        """Expert parallelism: 4 MoE experts sharded over the dp=4 data
+        axis put experts 0-1 in process 0 and 2-3 in process 1, so the
+        token-routing all-to-alls cross the process boundary."""
+        spmd_check.check("ep", str(tmp_path))

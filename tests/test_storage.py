@@ -225,6 +225,30 @@ class TestMultiFormatRemote:
             srv.shutdown()
             srv.server_close()
 
+    def test_sklearn_export_over_http(self, tmp_path):
+        from sklearn.linear_model import LogisticRegression
+
+        from kubeflow_tpu.serving.sklearn_server import (
+            export_sklearn, is_sklearn_export)
+        from kubeflow_tpu.serving.storage import initialize
+
+        import numpy as np
+
+        est = LogisticRegression(max_iter=10)
+        est.fit(np.zeros((8, 4)), np.array([0, 1] * 4))
+        root = tmp_path / "web" / "sk"
+        root.mkdir(parents=True)
+        export_sklearn(str(root), est, input_shape=(4,), num_classes=2)
+        srv, base = self._serve(tmp_path / "web", tmp_path)
+        try:
+            local = initialize(f"{base}/sk", str(tmp_path / "cache"))
+            assert is_sklearn_export(local)
+            assert sorted(os.listdir(local)) == ["config.json",
+                                                 "model.joblib"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
     def test_unknown_format_clear_error(self, tmp_path):
         from kubeflow_tpu.serving.storage import initialize
 
