@@ -404,6 +404,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if not user:
                     return self._error(400, "user is required")
                 tok = getattr(self.server, "user_tokens", None)
+                if tok is None:
+                    return self._error(503, "token store is not configured")
                 minted = tok.issue(user, rotate=True)
                 return self._json(200, {"user": user, "token": minted})
             return self._error(404, f"no route {url.path}")

@@ -152,7 +152,17 @@ def search(dataset: str = "mnist", edges: int = 3, features: int = 16,
         if trainable_only:
             probs = probs.copy()
             probs[:, zero_idx] = 0.0
-            probs /= probs.sum(axis=1, keepdims=True)
+            # If an edge's softmax mass collapsed entirely onto 'zero'
+            # (f32 underflow at large logit gaps), masking leaves a
+            # zero row — renormalizing would be 0/0 and rng.choice
+            # rejects NaN. Fall back to uniform over trainable ops.
+            row_sums = probs.sum(axis=1, keepdims=True)
+            dead = (row_sums[:, 0] == 0.0)
+            if dead.any():
+                probs[dead] = 0.0
+                probs[np.ix_(dead, trainable_ops)] = 1.0 / len(trainable_ops)
+                row_sums = probs.sum(axis=1, keepdims=True)
+            probs /= row_sums
         return np.stack([
             [rng.choice(n_ops, p=probs[e]) for e in range(edges)]
             for _ in range(k)]).astype(np.int32)

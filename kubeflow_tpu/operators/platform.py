@@ -353,9 +353,12 @@ class NotebookController(Controller):
 
     @staticmethod
     def _proc_cpu_seconds(pid: Optional[int]) -> Optional[float]:
-        """Cumulative CPU seconds of the notebook process and its direct
-        children (kernels it forked) — a busy-but-silent kernel shows up
-        here even though it writes nothing."""
+        """Cumulative CPU seconds of the notebook process and its FULL
+        descendant tree — a busy-but-silent kernel shows up here even
+        though it writes nothing. Kernels are often grandchildren (a
+        wrapper shell or kernel provisioner sits between the server and
+        the kernel), so a direct-children walk would read a busy kernel
+        as idle and cull it."""
         if not pid:
             return None
 
@@ -370,19 +373,31 @@ class NotebookController(Controller):
             total = one(pid)
         except (OSError, ValueError, IndexError):
             return None
+        # One /proc pass to build child lists, then BFS from pid: the
+        # tree can't be raced into a cycle (a reparented process goes to
+        # init, never to its own descendant).
+        children: Dict[int, list] = {}
         try:
-            for child in os.listdir("/proc"):
-                if not child.isdigit():
+            for entry in os.listdir("/proc"):
+                if not entry.isdigit():
                     continue
                 try:
-                    with open(f"/proc/{child}/stat") as f:
+                    with open(f"/proc/{entry}/stat") as f:
                         ppid = int(f.read().split(")")[-1].split()[1])
-                    if ppid == pid:
-                        total += one(int(child))
+                    children.setdefault(ppid, []).append(int(entry))
                 except (OSError, ValueError, IndexError):
                     continue
         except OSError:
             pass
+        frontier = [pid]
+        while frontier:
+            p = frontier.pop()
+            for child in children.get(p, ()):
+                try:
+                    total += one(child)
+                except (OSError, ValueError, IndexError):
+                    continue
+                frontier.append(child)
         return total
 
     # Minimum CPU seconds between two reconcile samples that counts as

@@ -131,7 +131,8 @@ def main(argv=None) -> int:
     if ckpt_dir and not args.no_checkpoint:
         ckpt = Checkpointer(ckpt_dir, save_every=args.checkpoint_every,
                             keep=args.keep_checkpoints)
-        restored = ckpt.restore_latest(state)
+        restored = ckpt.restore_latest(
+            state, legacy_layouts=loop.legacy_checkpoint_layouts(state))
         if restored is not None:
             # CLI hyperparams override the checkpointed ones (the
             # checkpoint carries lr in opt_state via inject_hyperparams).

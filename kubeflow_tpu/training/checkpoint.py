@@ -56,28 +56,47 @@ class Checkpointer:
         self.manager.save(step, args=ocp.args.StandardSave(state))
         return True
 
-    def restore_latest(self, target: Any) -> Optional[Any]:
+    def restore_latest(self, target: Any,
+                       legacy_layouts: Any = ()) -> Optional[Any]:
         """Restore the newest checkpoint into the structure of ``target``
-        (an abstract or concrete state pytree). None if no checkpoint, or
-        if the stored tree no longer matches ``target``'s structure (e.g.
-        a checkpoint written before an optimizer-state layout change) —
-        degrading to a fresh start keeps the job runnable, and the
+        (an abstract or concrete state pytree).
+
+        ``legacy_layouts`` is a sequence of ``(name, legacy_target,
+        upgrade)`` triples tried in order when the stored tree does not
+        match ``target`` — e.g. checkpoints written before an
+        optimizer-state layout change. ``upgrade(restored_legacy)`` maps
+        the legacy pytree onto the current layout, so old progress is
+        migrated instead of silently discarded.
+
+        Returns None if there is no checkpoint, or if no layout matches
+        — degrading to a fresh start keeps the job runnable, and the
         printed reason keeps the degradation observable."""
         step = self.manager.latest_step()
         if step is None:
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
-        try:
-            return self.manager.restore(
-                step, args=ocp.args.StandardRestore(abstract))
-        except (ValueError, KeyError, TypeError) as e:
-            # Tree-shape/-structure mismatches only. I/O errors (stale
-            # NFS handle, object-store hiccup) propagate: silently
-            # retraining from step 0 on a recoverable error would let the
-            # keep-rotation delete good checkpoints.
-            print(f"checkpoint_restore_incompatible step={step} "
-                  f"error={type(e).__name__} — starting fresh", flush=True)
-            return None
+        candidates = [("current", target, None)]
+        candidates += [tuple(entry) for entry in legacy_layouts]
+        tried = []
+        for name, tgt, upgrade in candidates:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, tgt)
+            try:
+                restored = self.manager.restore(
+                    step, args=ocp.args.StandardRestore(abstract))
+            except (ValueError, KeyError, TypeError) as e:
+                # Tree-shape/-structure mismatches only. I/O errors
+                # (stale NFS handle, object-store hiccup) propagate:
+                # silently retraining from step 0 on a recoverable error
+                # would let the keep-rotation delete good checkpoints.
+                tried.append(f"{name}:{type(e).__name__}")
+                continue
+            if upgrade is not None:
+                print(f"checkpoint_migrated step={step} layout={name}",
+                      flush=True)
+                restored = upgrade(restored)
+            return restored
+        print(f"checkpoint_restore_incompatible step={step} "
+              f"tried=[{', '.join(tried)}] — starting fresh", flush=True)
+        return None
 
     def wait(self) -> None:
         self.manager.wait_until_finished()

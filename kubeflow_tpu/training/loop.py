@@ -95,6 +95,29 @@ class TrainLoop:
                   for k, v in opt.hyperparams.items()}
         return state.replace(opt_state=opt._replace(hyperparams=new_hp))
 
+    def legacy_checkpoint_layouts(self, state: TrainState):
+        """Layout-migration triples for Checkpointer.restore_latest.
+
+        Checkpoints written before hyperparameters moved into opt_state
+        (optax.inject_hyperparams) stored the bare inner transformation's
+        state where the wrapper state now sits. The inner pytree is
+        unchanged — inject_hyperparams wraps, it does not restructure —
+        so a legacy checkpoint restores into ``opt_state.inner_state``
+        and is upgraded by grafting it back under a freshly initialised
+        wrapper carrying THIS loop's configured hyperparams (which is
+        also what reapply_hyperparams would assert)."""
+        opt = state.opt_state
+        if not hasattr(opt, "inner_state"):
+            return []
+        legacy_target = state.replace(opt_state=opt.inner_state)
+
+        def upgrade(restored: TrainState) -> TrainState:
+            wrapper = self.tx.init(restored.params)
+            wrapper = wrapper._replace(inner_state=restored.opt_state)
+            return restored.replace(opt_state=wrapper)
+
+        return [("pre-hyperparam-injection", legacy_target, upgrade)]
+
     # -- steps -------------------------------------------------------------
     def _step_body(self):
         """The single SGD update (state, images, labels) -> (state, loss,

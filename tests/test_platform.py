@@ -133,6 +133,39 @@ class TestNotebook:
         assert cp.gangs.get("notebook/default/nb-busy") is not None
         cp.store.delete("Notebook", "nb-busy")
 
+    def test_busy_grandchild_counts_as_activity(self, cp):
+        """Kernels usually sit BEHIND an intermediate process (wrapper
+        shell, kernel provisioner): a busy grandchild must register in
+        the CPU fallback, or a server that doesn't speak /api/kernels
+        gets culled while its kernel computes (advisor r4)."""
+        import subprocess
+
+        from kubeflow_tpu.operators.platform import NotebookController
+
+        # server -> wrapper -> spinner: only the grandchild burns CPU.
+        # Own session so the finally can killpg the WHOLE tree — a leaked
+        # spinner would eat this box's single core for the rest of the
+        # suite.
+        proc = subprocess.Popen([PY, "-c", (
+            "import subprocess, sys, time\n"
+            "child = subprocess.Popen([sys.executable, '-c',\n"
+            "    'import subprocess, sys, time\\n'\n"
+            "    'g = subprocess.Popen([sys.executable, \"-c\",'\n"
+            "    ' \"x=0\\\\nwhile True: x+=1\"])\\n'\n"
+            "    'g.wait()\\n'])\n"
+            "child.wait()\n")], start_new_session=True)
+        try:
+            t0 = NotebookController._proc_cpu_seconds(proc.pid)
+            time.sleep(1.5)
+            t1 = NotebookController._proc_cpu_seconds(proc.pid)
+            assert t0 is not None and t1 is not None
+            assert t1 - t0 > NotebookController.CPU_ACTIVE_DELTA_S, \
+                (t0, t1)
+        finally:
+            import signal
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait()
+
     def test_idle_chatty_notebook_is_culled(self, cp):
         """A process printing heartbeats but doing no work must be
         culled (the old log-mtime proxy kept it alive forever)."""

@@ -219,6 +219,52 @@ class TestCheckpoint:
             "learning_rate"]) == pytest.approx(5e-4)  # CLI wins
         ckpt.close()
 
+    def test_legacy_checkpoint_migrates_into_injected_layout(
+            self, tmp_path, capfd):
+        """Checkpoints written before hyperparams moved into opt_state
+        (inject_hyperparams) hold the bare inner optimizer state. A
+        resume must MIGRATE that progress — graft the legacy opt_state
+        under a fresh wrapper — not silently restart at step 0 and let
+        the keep-rotation delete it (advisor r4, medium)."""
+        import jax
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import Checkpointer, TrainLoop
+
+        ds = get_dataset("mnist")
+        loop = TrainLoop(get_model("mlp"), learning_rate=1e-3)
+        state = loop.init_state(ds.shape)
+        for images, labels in ds.batches(128, steps=2):
+            state, *_ = loop.train_step(state, images, labels)
+        # What the pre-injection code saved: the inner optimizer state
+        # directly (inject_hyperparams wraps, it does not restructure).
+        legacy = state.replace(opt_state=state.opt_state.inner_state)
+        ckpt = Checkpointer(str(tmp_path / "ck"), save_every=1)
+        ckpt.maybe_save(2, legacy, force=True)
+        ckpt.wait()
+
+        fresh = loop.init_state(ds.shape)
+        restored = ckpt.restore_latest(
+            fresh, legacy_layouts=loop.legacy_checkpoint_layouts(fresh))
+        assert restored is not None
+        assert int(restored.step) == 2
+        assert "checkpoint_migrated" in capfd.readouterr().out
+        # Progress carried over: params and adam moments match, and the
+        # wrapper carries the configured lr so training can continue.
+        a = jax.tree.leaves(jax.device_get(state.params))
+        b = jax.tree.leaves(jax.device_get(restored.params))
+        assert all(np.allclose(x, y) for x, y in zip(a, b))
+        m_old = jax.tree.leaves(jax.device_get(
+            state.opt_state.inner_state))
+        m_new = jax.tree.leaves(jax.device_get(
+            restored.opt_state.inner_state))
+        assert all(np.allclose(x, y) for x, y in zip(m_old, m_new))
+        assert float(restored.opt_state.hyperparams[
+            "learning_rate"]) == pytest.approx(1e-3)
+        restored, loss, acc = loop.train_step(
+            restored, *next(iter(ds.batches(128, steps=1))))
+        assert np.isfinite(loss)
+        ckpt.close()
+
     def test_incompatible_structure_falls_back_to_fresh(self, tmp_path, capfd):
         """A checkpoint whose tree no longer matches the target (e.g.
         written before an optimizer-state layout change) must degrade to
