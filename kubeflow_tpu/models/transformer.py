@@ -83,6 +83,14 @@ class TransformerConfig:
     # flash_max_seq=0 means no upper bound.
     flash_min_seq: int = 2048
     flash_max_seq: int = 4096
+    # Sequence-chunked cross-entropy: >0 makes the train loop apply
+    # lm_head + softmax per chunk of this many tokens (lax.scan with a
+    # rematted chunk body), so the [B, S, vocab] f32 logits never
+    # materialise whole — at base/b8/S=2048 that transient is ~3G of
+    # the 15.75G HBM, exactly the headroom the save_flash remat policy
+    # needs. Costs one lm_head recompute in the backward (~2% of step
+    # FLOPs at base). 0 = whole-sequence logits (unchanged path).
+    loss_chunk: int = 0
     # Autoregressive decoding: every attention layer keeps a KV cache
     # ("cache" collection) of max_seq_len slots and calls attend the new
     # tokens against it. Position ids must be passed explicitly (pads are
@@ -164,15 +172,24 @@ class Attention(nn.Module):
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name=name)
         # checkpoint_name tags mark the fat matmul outputs for the
-        # "save_dense" remat policy: keep these, recompute only the
-        # cheap elementwise chain and the O(S^2) score block (whose
-        # buffers are what make full activations not fit).
-        q = checkpoint_name(
-            proj("query", (cfg.n_heads, cfg.head_dim))(x), "attn_q")
-        k = checkpoint_name(
-            proj("key", (cfg.n_heads, cfg.head_dim))(x), "attn_k")
-        v = checkpoint_name(
-            proj("value", (cfg.n_heads, cfg.head_dim))(x), "attn_v")
+        # "save_dense"/"save_flash" remat policies: keep these, recompute
+        # only the cheap elementwise chain and the attention internals.
+        # Tagged FLAT ([B, S, H*D]) and reshaped after: a saved
+        # [B, S, H, D] buffer puts head_dim on the 128-lane tile, and at
+        # D=64 XLA pads it 2x — measured 1.5G instead of 768M PER TENSOR
+        # per save at base/b8/S=2048 (the round-5 HBM ladder); the flat
+        # layout's minor dim is H*D, tile-aligned, no padding.
+        def tagged_heads(name, y):
+            B_, S_, H_, D_ = y.shape
+            y = checkpoint_name(y.reshape(B_, S_, H_ * D_), name)
+            return y.reshape(B_, S_, H_, D_)
+
+        q = tagged_heads("attn_q",
+                         proj("query", (cfg.n_heads, cfg.head_dim))(x))
+        k = tagged_heads("attn_k",
+                         proj("key", (cfg.n_heads, cfg.head_dim))(x))
+        v = tagged_heads("attn_v",
+                         proj("value", (cfg.n_heads, cfg.head_dim))(x))
         # RoPE with absolute positions (pads carry -1; their rows are
         # masked out of every decode-mode attention, so the garbage
         # rotation never contributes).
@@ -199,14 +216,16 @@ class Attention(nn.Module):
         elif self._use_flash(S):
             import functools
 
-            from ..ops.flash_attention import flash_attention
+            from ..ops.flash_attention import (
+                flash_attention_apply, flash_attention_fwd)
 
             # Off-TPU (forced via attn_impl="flash", e.g. tests) the
-            # kernel runs in pallas interpret mode — same code path,
+            # kernels run in pallas interpret mode — same code path,
             # reference semantics.
-            flash_attention = functools.partial(
-                flash_attention,
-                interpret=jax.default_backend() != "tpu")
+            interpret = jax.default_backend() != "tpu"
+            fwd = functools.partial(flash_attention_fwd, interpret=interpret)
+            apply = functools.partial(flash_attention_apply,
+                                      interpret=interpret)
             mesh = jax.sharding.get_abstract_mesh()
             if not mesh.empty:
                 # Under GSPMD a pallas call must be per-shard: batch rides
@@ -215,11 +234,26 @@ class Attention(nn.Module):
                 from jax.sharding import PartitionSpec as P
 
                 spec = P(AXIS_DATA, None, AXIS_MODEL, None)
-                out = jax.shard_map(flash_attention,
-                                    in_specs=(spec, spec, spec),
-                                    out_specs=spec)(q, k, v)
+                o, lse = jax.shard_map(fwd, in_specs=(spec, spec, spec),
+                                       out_specs=(spec, spec))(q, k, v)
             else:
-                out = flash_attention(q, k, v)
+                o, lse = fwd(q, k, v)
+            # Tagged OUTSIDE the shard_map so remat policies see the
+            # names: "save_flash" keeps the kernel's O(B·S·H·D) output
+            # and its log-sum-exp rows — the linear-in-S residuals that
+            # are flash attention's entire memory story — so the remat
+            # backward runs only the flash backward kernels, never the
+            # forward one (the re-run full remat forces). Flat-tagged
+            # like q/k/v (see tagged_heads): the [B,S,H,D] layout pads
+            # D=64 to the 128-lane tile, doubling the save.
+            o = tagged_heads("flash_o", o)
+            lse = checkpoint_name(lse, "flash_lse")
+            if not mesh.empty:
+                out = jax.shard_map(
+                    apply, in_specs=(spec, spec, spec, spec, spec),
+                    out_specs=spec)(q, k, v, o, lse)
+            else:
+                out = apply(q, k, v, o, lse)
         else:
             # Dense causal attention (XLA fuses the softmax chain).
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
@@ -411,7 +445,8 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, positions=None):
+    def __call__(self, tokens, train: bool = False, positions=None,
+                 return_hidden: bool = False):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
@@ -459,13 +494,49 @@ class TransformerLM(nn.Module):
                 "save_dense": jax.checkpoint_policies.save_only_these_names(
                     "attn_q", "attn_k", "attn_v", "attn_out",
                     "mlp_wi", "mlp_wo", "moe_wi", "moe_wo"),
+                # Long-context policies, composed with the flash kernel:
+                # keep the kernel's own residuals (output + log-sum-exp,
+                # O(B·S·D) — the linear-in-S memory that is flash
+                # attention's point) so the remat backward runs only the
+                # two flash bwd kernels; full remat re-runs the fwd
+                # kernel first, and save_dense's save set never included
+                # (o, lse) so the fwd re-ran anyway. save_flash also
+                # keeps the q/k/v projections the bwd kernels consume;
+                # the wider set with attn_out+mlp_wo measured 18.02G —
+                # 2.28G over the v5e's 15.75G at base/b8/S=2048
+                # (BASELINE.md HBM table).
+                "save_flash": jax.checkpoint_policies.save_only_these_names(
+                    "attn_q", "attn_k", "attn_v", "flash_o", "flash_lse"),
+                # Minimal variant: only the kernel residuals; q/k/v are
+                # recomputed from the layer input (3 thin matmuls + rope).
+                "save_flash_min":
+                    jax.checkpoint_policies.save_only_these_names(
+                        "flash_o", "flash_lse"),
+                # Widest flash set that fits at base/b8/S=2048 (15.2G
+                # measured — the flat [B,S,H*D] tags are what make it
+                # fit; loss_chunk is NOT needed, the logits transient is
+                # not at the HBM peak): backward recomputes only
+                # ln/rope/SwiGLU elementwise and the mlp_wi matmul.
+                "save_flash_full":
+                    jax.checkpoint_policies.save_only_these_names(
+                        "attn_q", "attn_k", "attn_v", "attn_out",
+                        "mlp_wo", "flash_o", "flash_lse"),
             }
-            try:
-                policy = policies[cfg.remat_policy]
-            except KeyError:
-                raise ValueError(
-                    f"unknown remat_policy {cfg.remat_policy!r} "
-                    f"(have {sorted(policies)})") from None
+            if cfg.remat_policy.startswith("save_names:"):
+                # Ad-hoc save set ("save_names:attn_k,attn_v,flash_o"):
+                # the HBM-frontier probes (BASELINE.md ladder) walk tag
+                # subsets without a named policy per experiment.
+                names = [n for n in
+                         cfg.remat_policy.split(":", 1)[1].split(",") if n]
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    *names)
+            else:
+                try:
+                    policy = policies[cfg.remat_policy]
+                except KeyError:
+                    raise ValueError(
+                        f"unknown remat_policy {cfg.remat_policy!r} "
+                        f"(have {sorted(policies)})") from None
             kw = {"policy": policy} if policy is not None else {}
             block = nn.remat(Block, prevent_cse=False, **kw)
         ScanBlock = nn.scan(
@@ -479,6 +550,13 @@ class TransformerLM(nn.Module):
         x, _ = ScanBlock(cfg, name="layers")(x, positions)
 
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            # Big-vocab loss chunking (parallel/lm_train.py): the caller
+            # applies lm_head per sequence chunk so the [B, S, vocab]
+            # f32 logits (2.1G at base/b8/S=2048) never materialise
+            # whole. lm_head params still exist (created at init via the
+            # normal path); the train loop consumes them directly.
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -232,21 +232,74 @@ def _bwd(block_q, block_k, interpret, res, do):
 # ---------------------------------------------------------------------------
 # Public op
 # ---------------------------------------------------------------------------
+#
+# The op is split in two so activation-rematerialisation policies can SAVE
+# the forward kernel's outputs instead of re-running it in the backward:
+#
+#   o, lse = flash_attention_fwd(q, k, v)      # raw kernel, no grad path
+#   o   = checkpoint_name(o, "flash_o")        # (done by the model)
+#   lse = checkpoint_name(lse, "flash_lse")
+#   out = flash_attention_apply(q, k, v, o, lse)
+#
+# flash_attention_apply is numerically the identity on ``o`` but carries
+# the custom VJP: its residuals are exactly its own INPUTS, so when a
+# remat policy keeps (o, lse) — and (q, k, v) are cheap to recompute from
+# saved projections — the backward pass runs ONLY the two flash backward
+# kernels, never the forward one. With policies that don't save the names
+# the behavior (and cost) is identical to the classic fused custom_vjp:
+# the recompute re-runs the forward kernel to rebuild (o, lse).
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k,
-                interpret=interpret)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_apply(q, k, v, o, lse, block_q, block_k, interpret):
     return o
 
 
-def _flash_fwd(q, k, v, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k,
-                  interpret=interpret)
+def _flash_apply_fwd(q, k, v, o, lse, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+def _flash_apply_bwd(block_q, block_k, interpret, res, do):
+    dq, dk, dv = _bwd(block_q, block_k, interpret, res, do)
+    _, _, _, o, lse = res
+    # The (o, lse) inputs are precomputed constants of the differentiated
+    # path (stop_gradient'd at the producer); their cotangents are dead.
+    return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
+
+
+_flash_apply.defvjp(_flash_apply_fwd, _flash_apply_bwd)
+
+
+def flash_attention_fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                        block_q: int = 256, block_k: int = 256,
+                        interpret: bool = False
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw forward kernel: [B, S, H, D] -> (o [B, S, H, D],
+    lse [B, S, H, 1] fp32). No gradient flows through this call — pair it
+    with flash_attention_apply, which owns the backward."""
+    B, S, H, D = q.shape
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(S, block_k)
+    q, k, v = (jax.lax.stop_gradient(x).transpose(0, 2, 1, 3)
+               for x in (q, k, v))                  # [B,H,S,D]
+    o, lse = _fwd(q, k, v, block_q=bq, block_k=bk, interpret=interpret)
+    return o.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1, 3)
+
+
+def flash_attention_apply(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          o: jnp.ndarray, lse: jnp.ndarray, *,
+                          block_q: int = 256, block_k: int = 256,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Attention output given the precomputed (o, lse) of
+    flash_attention_fwd. Numerically returns ``o``; gradients to q/k/v
+    run the flash backward kernels against the given residuals."""
+    B, S, H, D = q.shape
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(S, block_k)
+    qt, kt, vt, ot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, o))
+    out = _flash_apply(qt, kt, vt, ot, lse.transpose(0, 2, 1, 3),
+                       bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
@@ -254,12 +307,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     interpret: bool = False) -> jnp.ndarray:
     """Causal attention, [B, S, H, D] in/out. q must be pre-scaled by
     1/sqrt(D) (matching models/transformer.py's convention)."""
-    B, S, H, D = q.shape
-    bq = _pick_block(S, block_q)
-    bk = _pick_block(S, block_k)
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))  # [B,H,S,D]
-    out = _flash(qt, kt, vt, bq, bk, interpret)
-    return out.transpose(0, 2, 1, 3)
+    o, lse = flash_attention_fwd(q, k, v, block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
+    return flash_attention_apply(q, k, v, o, lse, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
 
 
 def supported(seq_len: int, head_dim: int) -> bool:

@@ -154,6 +154,45 @@ class LMTrainLoop:
             return init(jax.random.PRNGKey(self.hp.seed))
 
     # -- loss ---------------------------------------------------------------
+    def _chunked_ce(self, params, hidden, targets):
+        """lm_head + CE per sequence chunk (cfg.loss_chunk tokens) via
+        lax.scan, chunk body rematted: the [B, S, vocab] f32 logits never
+        exist whole — only one [B, C, vocab] transient at a time. Returns
+        (mean ce, mean accuracy); grads to lm_head flow through the
+        manual einsum against params["lm_head"]["kernel"] (same math as
+        the nn.Dense it replaces: use_bias=False, cfg.dtype compute,
+        f32 softmax)."""
+        cfg = self.cfg
+        C = cfg.loss_chunk
+        B, S, D = hidden.shape
+        if S % C:
+            raise ValueError(f"seq len {S} not divisible by "
+                             f"loss_chunk={C}")
+        n = S // C
+        kernel = params["lm_head"]["kernel"]
+        h = hidden.reshape(B, n, C, D).transpose(1, 0, 2, 3)  # [n,B,C,D]
+        t = targets.reshape(B, n, C).transpose(1, 0, 2)
+
+        def body(carry, xs):
+            h_c, t_c = xs
+
+            def chunk(h_c):
+                logits = jnp.einsum(
+                    "bcd,dv->bcv", h_c.astype(cfg.dtype),
+                    kernel.astype(cfg.dtype)).astype(jnp.float32)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, t_c)
+                hit = (logits.argmax(-1) == t_c).astype(jnp.float32)
+                return jnp.sum(ce), jnp.sum(hit)
+
+            ce_s, hit_s = jax.checkpoint(chunk)(h_c)
+            return (carry[0] + ce_s, carry[1] + hit_s), None
+
+        init = (jnp.float32(0.0), jnp.float32(0.0))
+        (ce_sum, hit_sum), _ = jax.lax.scan(body, init, (h, t))
+        total = B * S
+        return ce_sum / total, hit_sum / total
+
     def _loss_fn(self, params, tokens):
         """tokens: [B, S+1] int32 (inputs || shifted targets)."""
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -161,13 +200,18 @@ class LMTrainLoop:
             cons = lambda x: jax.lax.with_sharding_constraint(
                 x, NamedSharding(self.mesh, P(AXIS_DATA, AXIS_CTX)))
             inputs, targets = cons(inputs), cons(targets)
+        chunked = self.cfg.loss_chunk > 0
         outputs = self.model.apply(
-            {"params": params}, inputs,
+            {"params": params}, inputs, return_hidden=chunked,
             mutable=["aux_loss"] if self.cfg.n_experts else [])
-        logits, aux = outputs if isinstance(outputs, tuple) else (outputs, {})
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-        loss = ce.mean()
-        acc = (logits.argmax(-1) == targets).mean()
+        out, aux = outputs if isinstance(outputs, tuple) else (outputs, {})
+        if chunked:
+            loss, acc = self._chunked_ce(params, out, targets)
+        else:
+            ce = optax.softmax_cross_entropy_with_integer_labels(out,
+                                                                 targets)
+            loss = ce.mean()
+            acc = (out.argmax(-1) == targets).mean()
         if self.cfg.n_experts:
             aux_vals = jax.tree.leaves(aux.get("aux_loss", {}))
             moe_aux = sum(jnp.sum(v) for v in aux_vals) / max(

@@ -1,0 +1,73 @@
+"""One-off measurement: S=2048 long-context MFU across remat policies on
+the real TPU. Mirrors bench.py's _bench_lm(batch=8, seq_len=2048) so the
+winner can become the bench's lm_long default.
+
+Usage: python scripts/measure_lm_long.py [policy ...]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.runners.jax_runner import enable_compile_cache
+
+enable_compile_cache()
+
+
+def run(policy: str, batch: int = 8, seq_len: int = 2048, n_steps: int = 6,
+        preset: str = "base", loss_chunk: int = 0) -> dict:
+    import numpy as np
+    import jax
+
+    from kubeflow_tpu.data.lm import LMDataset
+    from kubeflow_tpu.models.transformer import preset_config
+    from kubeflow_tpu.parallel.lm_train import LMHyperParams, LMTrainLoop
+    from kubeflow_tpu.parallel.mesh import make_mesh
+    from kubeflow_tpu.utils.flops import (
+        mfu, transformer_train_flops_per_token)
+
+    cfg = preset_config(preset, max_seq_len=seq_len, remat=True,
+                        remat_policy=policy, loss_chunk=loss_chunk)
+    mesh, plan = make_mesh(1)
+    loop = LMTrainLoop(cfg, mesh, plan,
+                       LMHyperParams(total_steps=1000, warmup_steps=10))
+    state = loop.init_state()
+    ds = LMDataset(vocab_size=cfg.vocab_size, seq_len=seq_len)
+    it = ds.batches(batch)
+    t_c = time.perf_counter()
+    state, _, _ = loop.train_many(state, [next(it)])
+    compile_s = time.perf_counter() - t_c
+    steps = [next(it) for _ in range(n_steps)]
+    t0 = time.perf_counter()
+    state, loss, _ = loop.train_many(state, steps)
+    dt = (time.perf_counter() - t0) / n_steps
+    fpt = transformer_train_flops_per_token(cfg, seq_len)
+    tok_s = batch * seq_len / dt
+    return {"policy": policy, "batch": batch, "seq": seq_len,
+            "loss_chunk": loss_chunk,
+            "step_ms": round(dt * 1000, 1),
+            "tokens_per_s": round(tok_s, 0),
+            "mfu": round(mfu(tok_s, fpt), 4),
+            "loss": round(float(loss), 3),
+            "compile_s": round(compile_s, 1)}
+
+
+if __name__ == "__main__":
+    # Each arg: POLICY[@LOSS_CHUNK][#BATCH]
+    specs = sys.argv[1:] or ["nothing", "save_flash"]
+    for spec in specs:
+        rest, _, batch = spec.partition("#")
+        pol, _, chunk = rest.partition("@")
+        try:
+            r = run(pol, loss_chunk=int(chunk or 0),
+                    batch=int(batch or 8))
+        except Exception as e:
+            msg = str(e)
+            key = "Ran out of memory in memory space hbm."
+            if key in msg:
+                msg = key + " " + msg.split(key, 1)[1][:160]
+            r = {"policy": spec, "error": msg[:300]}
+        print(json.dumps(r), flush=True)

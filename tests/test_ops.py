@@ -142,3 +142,36 @@ class TestModelAttnImpl:
         attn = Attention(self._cfg("auto", 128))
         if jax.default_backend() != "tpu":
             assert not attn._use_flash(128)
+
+    def test_save_flash_remat_grads_match(self):
+        """The save_flash policy (keep the flash kernel's o/lse so the
+        remat backward skips the forward kernel) must be a pure
+        scheduling change: loss and grads match full remat exactly-ish."""
+        import dataclasses
+
+        from kubeflow_tpu.models.transformer import TransformerLM
+
+        tokens = jnp.asarray(
+            np.random.default_rng(11).integers(0, 128, (2, 128)), jnp.int32)
+        base = dataclasses.replace(self._cfg("flash", 128), remat=True)
+
+        def loss_fn(cfg):
+            model = TransformerLM(cfg)
+
+            def loss(params):
+                logits = model.apply({"params": params}, tokens)
+                return jnp.mean(logits ** 2)
+
+            return model, loss
+
+        m0, loss0 = loss_fn(dataclasses.replace(base,
+                                                remat_policy="nothing"))
+        params = m0.init(jax.random.PRNGKey(0), tokens)["params"]
+        l0, g0 = jax.value_and_grad(loss0)(params)
+        _, loss1 = loss_fn(dataclasses.replace(base,
+                                               remat_policy="save_flash"))
+        l1, g1 = jax.value_and_grad(loss1)(params)
+        assert abs(float(l0) - float(l1)) < 1e-5
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            scale = max(float(jnp.max(jnp.abs(a))), 1e-6)
+            assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-3
