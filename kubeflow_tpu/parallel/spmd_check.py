@@ -22,6 +22,16 @@ Variants (2 processes x 4 devices):
     nontrivial axis, so ctx block 0 lives wholly in process 0 and block 1
     in process 1 — the ring-attention ppermutes themselves cross the
     process boundary (dp=2,cp=2 would keep the ring intra-process).
+  * ``ep`` — MoE experts over the dp=4 "data" axis: experts 0-1 live in
+    process 0 and 2-3 in process 1, so the token-routing all-to-alls
+    cross the process boundary.
+  * ``pp`` — mesh (pp=2, dp=2, tp=2), PipelinedLMTrainLoop: "stage" is
+    the outermost mesh axis, so stage 0 is wholly process 0 and stage 1
+    wholly process 1 — every per-microbatch activation ppermute at the
+    stage boundary (forward AND its reversed backward) crosses the
+    process boundary. This is exactly the transfer a single-process
+    pipeline run never exercises (on a real pod the stage axis spans
+    hosts).
 
 The check is wired two ways:
   * ``__graft_entry__.dryrun_multichip`` runs it as its cross-process tier
@@ -55,7 +65,7 @@ SEQ = 32
 # divergence source is reduction order in the cross-process collectives.
 RTOL = 2e-3
 
-VARIANTS = ("tp_fsdp", "cp", "ep")
+VARIANTS = ("tp_fsdp", "cp", "ep", "pp")
 
 
 def _build_loop(variant: str, n_devices: int):
@@ -65,6 +75,7 @@ def _build_loop(variant: str, n_devices: int):
 
     kw = dict(vocab_size=VOCAB, d_model=32, n_heads=4, head_dim=8,
               n_layers=2, d_ff=64, max_seq_len=SEQ)
+    hp = LMHyperParams(total_steps=CHECK_STEPS, warmup_steps=1)
     if variant == "cp":
         # cp outermost-nontrivial (dp=1): the ring crosses processes.
         tp = n_devices // 2
@@ -81,9 +92,18 @@ def _build_loop(variant: str, n_devices: int):
         tp = 2 if n_devices % 2 == 0 else 1
         mesh, plan = make_mesh(n_devices, tp=tp, fsdp=True)
         cfg = TransformerConfig(n_experts=plan.dp, **kw)
+    elif variant == "pp":
+        # Stage axis outermost: with 2 processes each owning half the
+        # devices, stage 0 IS process 0 and stage 1 IS process 1 — the
+        # GPipe activation ppermutes cross the process boundary every
+        # tick. n_layers=2 / pp=2 -> one layer per stage.
+        from .pipeline import PipelinedLMTrainLoop
+
+        tp = 2 if n_devices % 4 == 0 else 1
+        mesh, plan = make_mesh(n_devices, pp=2, tp=tp, fsdp=True)
+        return PipelinedLMTrainLoop(TransformerConfig(**kw), mesh, plan, hp)
     else:
         raise ValueError(f"unknown variant {variant!r}; have {VARIANTS}")
-    hp = LMHyperParams(total_steps=CHECK_STEPS, warmup_steps=1)
     return LMTrainLoop(cfg, mesh, plan, hp)
 
 

@@ -26,3 +26,11 @@ class TestCrossProcessSPMD:
         axis put experts 0-1 in process 0 and 2-3 in process 1, so the
         token-routing all-to-alls cross the process boundary."""
         spmd_check.check("ep", str(tmp_path))
+
+    def test_pp_matches_single_process(self, tmp_path):
+        """Pipeline parallelism on a (pp=2, dp=2, tp=2) mesh: the stage
+        axis is outermost, so stage 0 lives wholly in process 0 and
+        stage 1 in process 1 — every GPipe stage-boundary activation
+        ppermute (and its reversed backward) crosses the process
+        boundary."""
+        spmd_check.check("pp", str(tmp_path))
