@@ -389,10 +389,17 @@ class NotebookController(Controller):
                     continue
         except OSError:
             pass
+        # Visited-set: the /proc scan is not atomic, so pid reuse mid-scan
+        # can stitch a cycle into the child map; without it the walk would
+        # spin the reconcile thread forever.
         frontier = [pid]
+        seen = {pid}
         while frontier:
             p = frontier.pop()
             for child in children.get(p, ()):
+                if child in seen:
+                    continue
+                seen.add(child)
                 try:
                     total += one(child)
                 except (OSError, ValueError, IndexError):

@@ -214,6 +214,102 @@ class TestNotebook:
         assert not got.has_condition("Culled"), got.conditions
         cp.store.delete("Notebook", "nb-jup-busy")
 
+    @staticmethod
+    def _jupyter_nb(name, idle_seconds, runtime_dir):
+        """A Notebook resource running the REAL installed jupyter_server
+        (SURVEY.md §3 CS4 — the reference spawns actual Jupyter servers;
+        every prior round used stand-ins). Token auth off + xsrf off so
+        the test (and the culler) can drive the kernels API directly;
+        JUPYTER_RUNTIME_DIR pinned so the test can find the kernel's ZMQ
+        connection file."""
+        return _notebook(name, [
+            PY, "-m", "jupyter_server",
+            "--ServerApp.ip=127.0.0.1", "--ServerApp.port=$(KFX_PORT)",
+            "--ServerApp.open_browser=False", "--IdentityProvider.token=",
+            "--ServerApp.password=", "--ServerApp.disable_check_xsrf=True",
+            "--ServerApp.allow_root=True", "--ServerApp.root_dir=/tmp"],
+            idle_seconds=idle_seconds,
+            env={"JUPYTER_RUNTIME_DIR": runtime_dir})
+
+    @staticmethod
+    def _api(port, path="/api/kernels", data=None, timeout=5):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    @pytest.mark.slow
+    def test_real_jupyter_kernel_culling(self, cp, tmp_path):
+        """The culler against REAL Jupyter: a kernel made busy through an
+        actual ZMQ execute (jupyter_client against the server-owned
+        kernel) survives the idle window, because the server's own
+        /api/kernels reports execution_state=busy; a server whose kernel
+        never executes goes stale at its creation last_activity and is
+        culled. Generous windows: this box is 1 core and jupyter + an
+        ipykernel cold-start can take >10s under load."""
+        from jupyter_client import BlockingKernelClient
+
+        rt_busy = str(tmp_path / "rt-busy")
+        rt_stale = str(tmp_path / "rt-stale")
+        busy = self._jupyter_nb("nb-jreal-busy", 30, rt_busy)
+        stale = self._jupyter_nb("nb-jreal-stale", 15, rt_stale)
+        cp.apply([busy, stale])
+        t_start = time.monotonic()
+        ports = {}
+        for n in ("nb-jreal-busy", "nb-jreal-stale"):
+            got = cp.wait_for_condition("Notebook", n, "Ready", timeout=90)
+            ports[n] = int(got.status["url"].rsplit(":", 1)[1].split("/")[0])
+
+        # Create one kernel on each server (the API answering is the
+        # readiness signal the TCP probe can't give).
+        kids = {}
+        for n, port in ports.items():
+            _wait(lambda: self._try_kernel(port, kids, n), timeout=60,
+                  what=f"kernel created on {n}")
+
+        # Drive the busy server's kernel through a real execute.
+        cf = os.path.join(rt_busy, f"kernel-{kids['nb-jreal-busy']}.json")
+        _wait(lambda: os.path.exists(cf), timeout=30,
+              what="kernel connection file")
+        kc = BlockingKernelClient(connection_file=cf)
+        kc.load_connection_file()
+        kc.start_channels()
+        try:
+            # No wait_for_ready: its heartbeat-based liveness check
+            # false-negatives on a loaded 1-core box. ZMQ queues the
+            # execute until the kernel binds; the server's own
+            # /api/kernels view below is the readiness AND busy-ness
+            # assertion.
+            kc.execute("import time\nwhile True: time.sleep(0.2)")
+            _wait(lambda: any(
+                k.get("execution_state") == "busy"
+                for k in self._api(ports["nb-jreal-busy"])), timeout=60,
+                what="server reports kernel busy")
+
+            # Stale server: culled from its kernel's creation timestamp.
+            _wait(lambda: cp.store.get("Notebook", "nb-jreal-stale")
+                  .has_condition("Culled"), timeout=90,
+                  what="stale real-jupyter culled")
+            # Busy server: hold past its own idle window (measured from
+            # notebook start) and assert it survived on busy-ness alone.
+            remaining = 35 - (time.monotonic() - t_start)
+            if remaining > 0:
+                time.sleep(remaining)
+            got = cp.store.get("Notebook", "nb-jreal-busy")
+            assert not got.has_condition("Culled"), got.conditions
+            assert cp.gangs.get("notebook/default/nb-jreal-busy") is not None
+        finally:
+            kc.stop_channels()
+            cp.store.delete("Notebook", "nb-jreal-busy")
+
+    def _try_kernel(self, port, kids, name):
+        try:
+            kids[name] = self._api(port, data=b"{}")["id"]
+            return True
+        except Exception:
+            return False
+
     def test_crash_restart(self, cp):
         nb = _notebook("nb3", [PY, "-c", (
             "import os, time\n"
