@@ -1,0 +1,128 @@
+"""Standalone repro: worker->ps computed-tensor SEND deadlock in
+tf.distribute.ParameterServerStrategy (docs/ps-strategy.md).
+
+Plain TensorFlow + stdlib — no kfx imports — so the finding is checkable
+outside this repo/image. The script spawns the worker and ps
+`tf.distribute.Server` processes itself, then, from the chief, runs ONE
+multi-device function on the worker that assigns a value into the
+ps-hosted variable:
+
+  --value computed  (default): the assigned value is runtime-computed on
+                    the worker, so it must be SENT worker->ps inside the
+                    function. In this image's TF (2.21.0, py3.12) the
+                    transfer never completes — the call hangs.
+  --value constant: the assigned value is a constant; constant folding
+                    places it inside the ps component function, no
+                    cross-task send — completes immediately.
+
+Exit codes: 0 = completed, 2 = hang detected (deadlock reproduced).
+Usage: python ps_deadlock_repro.py [--value computed|constant]
+                                   [--timeout 60]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+SERVER_CODE = """
+import json, os
+import tensorflow as tf
+tf.distribute.Server(
+    tf.train.ClusterSpec(json.loads(os.environ["REPRO_CLUSTER"])),
+    job_name=os.environ["REPRO_ROLE"],
+    task_index=int(os.environ["REPRO_IDX"]),
+    protocol="grpc").join()
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_listening(addr: str, timeout: float = 60.0) -> None:
+    host, port = addr.rsplit(":", 1)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.3)
+    raise TimeoutError(f"server {addr} did not come up")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--value", choices=["constant", "computed"],
+                    default="computed")
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="seconds before the attempt is declared hung")
+    args = ap.parse_args()
+
+    cluster = {"chief": [f"127.0.0.1:{_free_port()}"],
+               "worker": [f"127.0.0.1:{_free_port()}"],
+               "ps": [f"127.0.0.1:{_free_port()}"]}
+    procs = []
+    for role in ("worker", "ps"):
+        env = dict(os.environ, REPRO_CLUSTER=json.dumps(cluster),
+                   REPRO_ROLE=role, REPRO_IDX="0")
+        procs.append(subprocess.Popen([sys.executable, "-c", SERVER_CODE],
+                                      env=env))
+    try:
+        for role in ("worker", "ps"):
+            _wait_listening(cluster[role][0])
+
+        os.environ["TF_CONFIG"] = json.dumps(
+            {"cluster": cluster, "task": {"type": "chief", "index": 0}})
+        import tensorflow as tf
+
+        resolver = tf.distribute.cluster_resolver.TFConfigClusterResolver()
+        strategy = tf.distribute.ParameterServerStrategy(resolver)
+        with strategy.scope():
+            a = tf.Variable(0.0)
+
+        value_kind = args.value
+
+        @tf.function
+        def poison():
+            if value_kind == "computed":
+                a.assign_add(tf.random.stateless_uniform((), seed=[1, 2]))
+            else:
+                a.assign_add(tf.constant(1.0))
+            return a.read_value()
+
+        done: dict = {}
+
+        def attempt():
+            with tf.device("/job:worker/replica:0/task:0/device:CPU:0"):
+                done["value"] = float(poison())
+
+        t = threading.Thread(target=attempt, daemon=True)
+        t0 = time.time()
+        t.start()
+        t.join(args.timeout)
+        hang = "value" not in done
+        out = {"value_kind": value_kind, "hang": hang,
+               "elapsed_s": round(time.time() - t0, 1)}
+        if not hang:
+            out["result"] = done["value"]
+        print(json.dumps(out), flush=True)
+        return 2 if hang else 0
+    finally:
+        for p in procs:
+            p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
