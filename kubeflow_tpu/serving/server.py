@@ -235,20 +235,32 @@ class MicroBatcher:
     """Aggregates concurrent predict calls into one device dispatch.
 
     KFServing batcher contract: flush when maxBatchSize items are waiting
-    or the oldest has waited maxLatencyMs."""
+    or the oldest has waited maxLatencyMs.
+
+    ``workers`` > 1 runs that many batcher threads so a second batch
+    dispatches while the first is still in flight — on a high-latency
+    device transport (docs/serving-latency.md: ~65-100ms per completion
+    sync on this tunnel) the dispatch round-trip is dead time the next
+    batch can pipeline into. Each JAX dispatch is thread-safe (the GIL
+    releases during the blocking device fetch); per-request ordering is
+    preserved by the per-request reply queues."""
 
     def __init__(self, predictor: Predictor, max_batch_size: int = 32,
-                 max_latency_ms: float = 2.0, reply_timeout_s: float = 60.0):
+                 max_latency_ms: float = 2.0, reply_timeout_s: float = 60.0,
+                 workers: int = 1):
         self.predictor = predictor
         self.max_batch_size = max_batch_size
         self.max_latency_s = max_latency_ms / 1000.0
         self.reply_timeout_s = reply_timeout_s
         self._q: "queue.Queue[Tuple[np.ndarray, bool, queue.Queue]]" = \
             queue.Queue()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="kfx-batcher")
         self._stop = threading.Event()
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"kfx-batcher-{i}")
+            for i in range(max(1, workers))]
+        for t in self._threads:
+            t.start()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -365,7 +377,8 @@ class ModelServer:
                 predictor,
                 max_batch_size=int(batcher.get("maxBatchSize", 32)),
                 max_latency_ms=float(batcher.get("maxLatencyMs", 2.0)),
-                reply_timeout_s=float(batcher.get("replyTimeoutS", 60.0)))
+                reply_timeout_s=float(batcher.get("replyTimeoutS", 60.0)),
+                workers=int(batcher.get("workers", 1)))
 
     # -- request handling ---------------------------------------------------
     def _handle_get(self, h) -> None:
@@ -500,6 +513,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--batcher-max-latency-ms", type=float, default=0.0,
                    help=">0 enables the micro-batcher")
     p.add_argument("--batcher-reply-timeout-s", type=float, default=60.0)
+    p.add_argument("--batcher-workers", type=int, default=1,
+                   help=">1 pipelines device dispatches across batcher "
+                        "threads (wins when the per-dispatch sync floor "
+                        "dominates, e.g. a tunneled accelerator)")
     p.add_argument("--framework", default="auto",
                    choices=["auto", "jax", "pytorch", "tensorflow",
                             "sklearn", "lm"],
@@ -561,7 +578,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.batcher_max_latency_ms > 0:
         batcher = {"maxBatchSize": args.max_batch_size,
                    "maxLatencyMs": args.batcher_max_latency_ms,
-                   "replyTimeoutS": args.batcher_reply_timeout_s}
+                   "replyTimeoutS": args.batcher_reply_timeout_s,
+                   "workers": args.batcher_workers}
     server.register(predictor, batcher)
     server.start()
     print(f"server_ready name={args.name} port={server.port} "

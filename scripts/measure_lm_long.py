@@ -18,7 +18,7 @@ enable_compile_cache()
 
 
 def run(policy: str, batch: int = 8, seq_len: int = 2048, n_steps: int = 6,
-        preset: str = "base", loss_chunk: int = 0) -> dict:
+        preset: str = "base", loss_chunk: int = 0, **overrides) -> dict:
     import numpy as np
     import jax
 
@@ -30,7 +30,8 @@ def run(policy: str, batch: int = 8, seq_len: int = 2048, n_steps: int = 6,
         mfu, transformer_train_flops_per_token)
 
     cfg = preset_config(preset, max_seq_len=seq_len, remat=True,
-                        remat_policy=policy, loss_chunk=loss_chunk)
+                        remat_policy=policy, loss_chunk=loss_chunk,
+                        **overrides)
     mesh, plan = make_mesh(1)
     loop = LMTrainLoop(cfg, mesh, plan,
                        LMHyperParams(total_steps=1000, warmup_steps=10))

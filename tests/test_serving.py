@@ -323,6 +323,36 @@ class TestMicroBatcher:
         finally:
             batcher.close()
 
+    def test_pipelined_workers_serve_all_requests(self, export_dir):
+        """workers=2 (two batcher threads pipelining device dispatches
+        into the transport's sync floor): every request still gets its
+        own correct-length reply — per-request reply queues make the
+        interleaving safe."""
+        import threading
+
+        from kubeflow_tpu.serving.server import JaxPredictor, MicroBatcher
+
+        predictor = JaxPredictor(export_dir, name="m", max_batch_size=8)
+        predictor.load()
+        batcher = MicroBatcher(predictor, max_batch_size=8,
+                               max_latency_ms=2.0, workers=2)
+        results = [None] * 24
+
+        def hit(i):
+            n = 1 + (i % 3)
+            x = np.zeros((n, 28, 28, 1), np.float32)
+            results[i] = (n, batcher.predict(x))
+
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batcher.close()
+        assert all(r is not None and len(r[1]["predictions"]) == r[0]
+                   for r in results), results
+
     def test_non_pow2_max_batch_is_a_bucket(self, export_dir):
         from kubeflow_tpu.serving.server import JaxPredictor
 
