@@ -181,6 +181,21 @@ class Attention(nn.Module):
         # layout's minor dim is H*D, tile-aligned, no padding.
         def tagged_heads(name, y):
             B_, S_, H_, D_ = y.shape
+            tp = 1
+            mesh_ = jax.sharding.get_abstract_mesh()
+            if not mesh_.empty:
+                from ..parallel.mesh import AXIS_MODEL
+
+                tp = mesh_.shape.get(AXIS_MODEL, 1) or 1
+            if D_ % 128 == 0 and (H_ // tp) % 8 == 0:
+                # Tile-aligned in BOTH minor dims per shard (lanes: D;
+                # sublanes: the per-tp-shard head count): the 4D layout
+                # wastes nothing and tags in place — the flat
+                # round-trip measured ~2% slower at d2048 (relayout
+                # copies). Misaligned shapes (D=64, or tp slicing heads
+                # below the 8-sublane tile) save flat: a padded save
+                # costs 2x HBM per tensor (measured 1.5G vs 768M).
+                return checkpoint_name(y, name)
             y = checkpoint_name(y.reshape(B_, S_, H_ * D_), name)
             return y.reshape(B_, S_, H_, D_)
 
@@ -234,8 +249,15 @@ class Attention(nn.Module):
                 from jax.sharding import PartitionSpec as P
 
                 spec = P(AXIS_DATA, None, AXIS_MODEL, None)
+                # check_vma only on real TPU lowering: in interpret mode
+                # the kernels run as jax ops inside shard_map and the
+                # VMA tracker rejects their internal dynamic_slices
+                # (same known wart parallel/pipeline.py works around);
+                # the untracked lowering is what the grad-parity tests
+                # check.
                 o, lse = jax.shard_map(fwd, in_specs=(spec, spec, spec),
-                                       out_specs=(spec, spec))(q, k, v)
+                                       out_specs=(spec, spec),
+                                       check_vma=not interpret)(q, k, v)
             else:
                 o, lse = fwd(q, k, v)
             # Tagged OUTSIDE the shard_map so remat policies see the
@@ -251,7 +273,7 @@ class Attention(nn.Module):
             if not mesh.empty:
                 out = jax.shard_map(
                     apply, in_specs=(spec, spec, spec, spec, spec),
-                    out_specs=spec)(q, k, v, o, lse)
+                    out_specs=spec, check_vma=not interpret)(q, k, v, o, lse)
             else:
                 out = apply(q, k, v, o, lse)
         else:

@@ -36,9 +36,10 @@ def parse_args(argv=None):
                    help="context parallel ways (ring attention over 'ctx')")
     p.add_argument("--experts", type=int, default=0, help="MoE experts (ep)")
     p.add_argument("--remat", action="store_true")
+    # Not argparse-choices: the model owns the policy names (including
+    # the save_flash* family and the free-form "save_names:a,b,..."
+    # escape hatch) and rejects unknown ones with the full list.
     p.add_argument("--remat-policy", default="nothing",
-                   choices=["nothing", "dots", "dots_no_batch",
-                            "save_dense"],
                    help="what remat may KEEP (save_dense: fat matmul "
                         "outputs stay, only elementwise + the S^2 "
                         "block recompute; needs the linear-in-S saves "
