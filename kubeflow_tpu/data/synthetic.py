@@ -90,19 +90,21 @@ class Dataset:
         high-latency link (this environment's tunneled TPU) that is the
         difference between transfer-bound and compute-bound training.
 
-        Returns fn(key, batch_size) -> (images, labels), closed over the
-        device-resident prototypes (one tiny upload). Same distribution
-        as `batches` (sigma, label noise), different (jax) random
-        stream — equivalent training, not bit-equal batches.
+        Returns fn(protos, key, batch_size) -> (images, labels), with
+        the device-resident prototype table exposed as ``fn.consts`` so
+        the train loop passes it as a jit ARGUMENT (never close over
+        it: closure arrays embed in the program as constants — 602M at
+        ImageNet geometry). Same distribution as `batches` (sigma,
+        label noise), different (jax) random stream — equivalent
+        training, not bit-equal batches.
         """
         import jax
         import jax.numpy as jnp
 
-        protos = jnp.asarray(self._prototypes())
         C, sigma, p_flip = self.num_classes, self.sigma, self.label_noise
         shape = self.shape
 
-        def make(key, batch_size: int):
+        def make(protos, key, batch_size: int):
             k1, k2, k3, k4 = jax.random.split(key, 4)
             labels = jax.random.randint(k1, (batch_size,), 0, C)
             noise = sigma * jax.random.normal(
@@ -115,6 +117,12 @@ class Dataset:
                     labels)
             return images, labels.astype(jnp.int32)
 
+        # The prototype table rides as a jit ARGUMENT (TrainLoop threads
+        # `.consts` through), never a closure: a closed-over array is
+        # baked into the program as a constant, and at ImageNet geometry
+        # (1000 x 224^2 x 3 f32 = 602M) that constant blew the
+        # remote-compile transport's request-size limit (HTTP 413).
+        make.consts = jnp.asarray(self._prototypes())
         return make
 
     def eval_arrays(self, n: int | None = None) -> Tuple[np.ndarray, np.ndarray]:

@@ -62,11 +62,15 @@ class LMGenerator:
     # -- the compiled path --------------------------------------------------
     def _generate_fn(self, prompt_pad: int, max_new: int):
         """One compile per (batch, prompt bucket, max_new bucket);
-        sampling knobs ride in as traced scalars."""
-        model, params, cfg = self.model, self.params, self.cfg
+        sampling knobs ride in as traced scalars. ``params`` is a jit
+        ARGUMENT, never a closure: a closed-over param tree is embedded
+        in the lowered program as constants — 1.9G of MLIR at the base
+        preset, which broke the remote-compile transport (and bloated
+        every compile's payload by the model size)."""
+        model, cfg = self.model, self.cfg
 
         @jax.jit
-        def run(tokens, true_len, rng, temperature, top_k):
+        def run(params, tokens, true_len, rng, temperature, top_k):
             """tokens [B, prompt_pad] (right-padded), true_len [B]."""
             B = tokens.shape[0]
             pos = jnp.arange(prompt_pad, dtype=jnp.int32)[None, :]
@@ -137,7 +141,7 @@ class LMGenerator:
         if fn is None:
             fn = self._generate_fn(pad, new_bucket)
             self._compiled[key] = fn
-        out = fn(jnp.asarray(tokens), jnp.asarray(true_len),
+        out = fn(self.params, jnp.asarray(tokens), jnp.asarray(true_len),
                  jax.random.PRNGKey(seed),
                  jnp.float32(temperature), jnp.int32(top_k))
         return np.asarray(out)[:, :max_new_tokens].tolist()

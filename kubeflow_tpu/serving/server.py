@@ -118,15 +118,18 @@ class JaxPredictor(Predictor):
         self.input_shape = tuple(config["input_shape"])
         self.num_classes = config["num_classes"]
 
-        def make_fn(p, bs):
-            def fn(x):
-                variables = {"params": p}
-                if bs:
-                    variables["batch_stats"] = bs
-                logits = model.apply(variables, x, train=False)
-                probs = jax.nn.softmax(logits, -1)
-                return logits.argmax(-1), probs
-            return fn
+        def fn(p, bs, x):
+            # Params/batch_stats are jit ARGUMENTS, not closures: a
+            # closed-over tree is embedded in the lowered program as
+            # constants, bloating every bucket's compile payload by the
+            # full model size (and breaking the remote-compile transport
+            # outright for big models — the LMGenerator lesson).
+            variables = {"params": p}
+            if bs:
+                variables["batch_stats"] = bs
+            logits = model.apply(variables, x, train=False)
+            probs = jax.nn.softmax(logits, -1)
+            return logits.argmax(-1), probs
 
         # AOT-compile every bucket (jit().lower().compile()): no request
         # ever pays a compile AND dispatch skips the jit signature-matching
@@ -146,20 +149,24 @@ class JaxPredictor(Predictor):
         if device == "auto" and default_dev.platform == "cpu":
             device = "default"
 
-        fns: Dict[Any, Any] = {}
+        placed: Dict[Any, Any] = {}
 
-        def fn_for(dev):
-            if dev not in fns:
-                fns[dev] = make_fn(
+        def placed_on(dev):
+            if dev not in placed:
+                placed[dev] = (
                     jax.device_put(params, dev),
                     jax.device_put(batch_stats, dev) if batch_stats else {})
-            return fns[dev]
+            return placed[dev]
 
         def compile_on(dev, bucket):
             sharding = jax.sharding.SingleDeviceSharding(dev)
             spec = jax.ShapeDtypeStruct((bucket,) + self.input_shape,
                                         jnp.float32, sharding=sharding)
-            return jax.jit(fn_for(dev)).lower(spec).compile()
+            p_dev, bs_dev = placed_on(dev)
+            compiled = jax.jit(fn).lower(p_dev, bs_dev, spec).compile()
+            # Bind the device-resident trees so callers keep the old
+            # fn(x) shape; args pass by reference, no per-call transfer.
+            return lambda x: compiled(p_dev, bs_dev, x)
 
         cache: Dict[Tuple[str, int], Any] = {}
         if device == "auto":

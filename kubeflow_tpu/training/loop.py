@@ -190,11 +190,19 @@ class TrainLoop:
         step = self._step_body()
         spec_x = self.batch_sharding
         spec_y = self.batch_sharding
+        has_consts = getattr(batch_fn, "consts", None) is not None
 
-        def many(state: TrainState, base_key, start_step):
+        def many(state: TrainState, base_key, start_step, consts):
             def one(state, i):
                 key = jax.random.fold_in(base_key, start_step + i)
-                images, labels = batch_fn(key, batch_size)
+                # `consts` are the batch_fn's device-resident tables
+                # passed as jit arguments — a closure capture would bake
+                # them into the program as constants (602M at ImageNet
+                # geometry, breaking the remote-compile transport).
+                if has_consts:
+                    images, labels = batch_fn(consts, key, batch_size)
+                else:
+                    images, labels = batch_fn(key, batch_size)
                 images = jax.lax.with_sharding_constraint(images, spec_x)
                 labels = jax.lax.with_sharding_constraint(labels, spec_y)
                 state, loss, acc = step(state, images, labels)
@@ -206,7 +214,7 @@ class TrainLoop:
 
         return jax.jit(
             many,
-            in_shardings=(self.repl, self.repl, self.repl),
+            in_shardings=(self.repl, self.repl, self.repl, self.repl),
             out_shardings=(self.repl, self.repl, self.repl),
             donate_argnums=(0,),
         )
@@ -218,12 +226,18 @@ class TrainLoop:
         fn_key = (id(batch_fn), n_steps, batch_size)
         entry = self._device_fns.get(fn_key)
         if entry is None:
-            entry = (batch_fn, self._build_train_many_device(
+            consts = getattr(batch_fn, "consts", None)
+            if consts is not None:
+                # Commit to the replicated sharding ONCE: an uncommitted
+                # default-device array would be re-broadcast across the
+                # mesh on every dispatch (602M at ImageNet geometry).
+                consts = jax.device_put(consts, self.repl)
+            entry = (batch_fn, consts, self._build_train_many_device(
                 batch_fn, batch_size, n_steps))
             self._device_fns[fn_key] = entry
-        _, fn = entry
+        _, consts, fn = entry
         state, loss, acc = fn(state, self._device_key,
-                              jnp.int32(start_step))
+                              jnp.int32(start_step), consts)
         return state, float(loss), float(acc)
 
     def train_steps(self, state: TrainState, images: np.ndarray,
