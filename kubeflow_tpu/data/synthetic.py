@@ -122,7 +122,10 @@ class Dataset:
         # baked into the program as a constant, and at ImageNet geometry
         # (1000 x 224^2 x 3 f32 = 602M) that constant blew the
         # remote-compile transport's request-size limit (HTTP 413).
-        make.consts = jnp.asarray(self._prototypes())
+        # Kept as HOST memory here — the train loop owns the single
+        # device placement (a jnp array here would pin a second,
+        # default-device copy for the batch_fn's lifetime).
+        make.consts = self._prototypes()
         return make
 
     def eval_arrays(self, n: int | None = None) -> Tuple[np.ndarray, np.ndarray]:

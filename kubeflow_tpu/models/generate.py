@@ -55,7 +55,13 @@ class LMGenerator:
         self.cfg = dataclasses.replace(
             cfg, decode=True, remat=False, sp=False, cp=1, attn_impl="xla",
             max_seq_len=max_len or cfg.max_seq_len)
-        self.params = params
+        import jax as _jax
+
+        # Device-commit once: params arrive as host numpy from the
+        # export loaders, and a jit call does NOT cache host-array
+        # transfers — without this every generate() would re-upload the
+        # full tree (~1.9G at base) through the device link.
+        self.params = _jax.device_put(params)
         self.model = TransformerLM(self.cfg)
         self._compiled: Dict[Tuple[int, int, int, float, int], any] = {}
 

@@ -74,14 +74,17 @@ class TransformerConfig:
     # attention (its own seq-sharded kernel).
     attn_impl: str = "auto"
     # The seq-len window where "auto" picks flash. The defaults are a
-    # MEASUREMENT, not a law: on this environment's emulated v5e (base
-    # preset, 8-step train) XLA's fused dense attention wins up to
-    # S=1024 (kernel-launch overhead dominates), flash wins 1.24x at
-    # S=2048 (the O(S^2) score matrix stops touching HBM), and above
-    # 4096 the emulator's compiler rejects scan+remat+kernel. On other
-    # hardware re-measure and set these (or force attn_impl="flash");
-    # flash_max_seq=0 means no upper bound.
-    flash_min_seq: int = 2048
+    # MEASUREMENT, not a law: on this environment's v5e (base preset,
+    # b16, matched save policies) dense wins at S=512 (0.415 vs 0.362 —
+    # kernel-launch overhead dominates the small S^2 block) and flash
+    # wins from S=1024 (0.351 vs 0.338; 0.336 vs 0.309 at S=2048) —
+    # the round-5 save_flash remat composition moved the crossover
+    # down from 2048, because only flash can skip its forward re-run
+    # in the backward. Above 4096 this environment's compiler rejects
+    # scan+remat+kernel. On other hardware re-measure and set these
+    # (or force attn_impl="flash"); flash_max_seq=0 means no upper
+    # bound.
+    flash_min_seq: int = 1024
     flash_max_seq: int = 4096
     # Sequence-chunked cross-entropy: >0 makes the train loop apply
     # lm_head + softmax per chunk of this many tokens (lax.scan with a

@@ -371,7 +371,13 @@ class ModelServer:
             def do_POST(self):
                 server._handle_post(self)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # Default listen backlog is 5: a burst of concurrent clients
+            # (the bench's 32-connection load leg) overflows it and the
+            # kernel resets the excess SYNs. Size it for bursty fleets.
+            request_queue_size = 128
+
+        self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 

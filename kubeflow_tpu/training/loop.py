@@ -65,7 +65,10 @@ class TrainLoop:
         # Device-data pipeline: compiled fns keyed by (generator identity,
         # chunk length, batch size); values pin the batch_fn so id() can
         # never be recycled while its compile is cached.
-        self._device_fns: Dict[Any, Tuple[Any, Any]] = {}
+        self._device_fns: Dict[Any, Tuple[Any, Any, Any]] = {}
+        # Device-placed batch_fn consts, one copy per batch_fn (see
+        # train_steps_device).
+        self._device_consts: Dict[int, Any] = {}
         self._device_key = jax.random.PRNGKey(seed + 1)
 
     # -- state -------------------------------------------------------------
@@ -226,14 +229,20 @@ class TrainLoop:
         fn_key = (id(batch_fn), n_steps, batch_size)
         entry = self._device_fns.get(fn_key)
         if entry is None:
-            consts = getattr(batch_fn, "consts", None)
-            if consts is not None:
-                # Commit to the replicated sharding ONCE: an uncommitted
-                # default-device array would be re-broadcast across the
-                # mesh on every dispatch (602M at ImageNet geometry).
-                consts = jax.device_put(consts, self.repl)
-            entry = (batch_fn, consts, self._build_train_many_device(
-                batch_fn, batch_size, n_steps))
+            # Place consts ONCE per batch_fn (not per chunk length — the
+            # runner's chunk planner emits several k values for the same
+            # fn, and each placement would pin its own replicated copy:
+            # 602M apiece at ImageNet geometry). device_put commits to
+            # the replicated sharding so dispatches never re-broadcast.
+            ckey = id(batch_fn)
+            if ckey not in self._device_consts:
+                consts = getattr(batch_fn, "consts", None)
+                if consts is not None:
+                    consts = jax.device_put(consts, self.repl)
+                self._device_consts[ckey] = consts
+            entry = (batch_fn, self._device_consts[ckey],
+                     self._build_train_many_device(
+                         batch_fn, batch_size, n_steps))
             self._device_fns[fn_key] = entry
         _, consts, fn = entry
         state, loss, acc = fn(state, self._device_key,

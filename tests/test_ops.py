@@ -117,7 +117,8 @@ class TestModelAttnImpl:
         from kubeflow_tpu.models.transformer import flash_window_ok
 
         cfg = self._cfg("auto", 2048)
-        assert not flash_window_ok(cfg, 1024)
+        assert not flash_window_ok(cfg, 512)
+        assert flash_window_ok(cfg, 1024)  # r5 crossover (save_flash)
         assert flash_window_ok(cfg, 2048)
         assert not flash_window_ok(cfg, 4096)
         wide = dataclasses.replace(cfg, flash_min_seq=512,
