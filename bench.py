@@ -772,10 +772,16 @@ def _bench_serving_p50(n_requests: int = 200, load_clients: int = 32,
         conn = connect(server.port)
         lat = [one(conn) for _ in range(n_requests)]
         conn.close()
+        # Server-reported latency distribution (obs registry histogram):
+        # recorded next to the client-observed number so a drift between
+        # the two (queueing outside the handler) is visible in BENCH.
+        server_p50 = (server._latency_summary()
+                      .get("resnet", {}).get("p50"))
         server.stop()
         lat.sort()
         out = {
             "serving_p50_ms": round(lat[len(lat) // 2], 2),
+            "serving_p50_ms_server": server_p50,
             "serving_p99_ms": round(lat[int(len(lat) * 0.99)], 2),
             # The headline p50 is a batch-1 predict: name the device the
             # measured placement probe chose for it, so a CPU number is

@@ -189,28 +189,51 @@ class UserTokens:
                     hmac.compare_digest(self._hash(token), ref))
 
 
-def prometheus_text(m: dict) -> str:
-    """Encode the metrics snapshot in Prometheus exposition format
-    (SURVEY.md §5.5: the reference's operators expose Prometheus-scrapable
-    text; JSON stays available via /metrics?format=json)."""
-    from .utils.prom import prom_text
+def metrics_json(snapshot: dict) -> dict:
+    """Project the registry snapshot (the ONE metrics source — the
+    exposition text renders the same state) into the legacy JSON shape
+    of /metrics?format=json, plus per-kind reconcile-latency summaries
+    derived from the histograms."""
+    def samples(name):
+        return snapshot.get(name, {}).get("samples", [])
 
-    metrics = [
-        ("kfx_resources", "gauge", "Number of stored resources by kind.",
-         [({"kind": k}, n) for k, n in sorted(m["resources"].items())]),
-    ]
+    def scalar(name, default=0):
+        s = samples(name)
+        return s[0]["value"] if s else default
+
+    controllers: dict = {}
     for stat in ("depth", "delayed", "processing", "retrying"):
-        metrics.append(
-            (f"kfx_workqueue_{stat}", "gauge",
-             f"Workqueue {stat} by controller.",
-             [({"controller": k}, stats.get(stat, 0))
-              for k, stats in sorted(m["controllers"].items())]))
-    metrics += [
-        ("kfx_gangs", "gauge", "Live process gangs.", m["gangs"]),
-        ("kfx_events_total", "counter",
-         "Events recorded since startup.", m["events"]),
-    ]
-    return prom_text(metrics)
+        for s in samples(f"kfx_workqueue_{stat}"):
+            controllers.setdefault(
+                s["labels"]["controller"], {})[stat] = s["value"]
+    reconcile: dict = {}
+    for s in samples("kfx_reconcile_duration_seconds"):
+        kind = s["labels"].get("kind", "")
+        reconcile[kind] = {
+            "count": s["count"],
+            "p50_ms": _bucket_percentile_ms(s, 0.5),
+            "p99_ms": _bucket_percentile_ms(s, 0.99),
+        }
+    return {
+        "resources": {s["labels"]["kind"]: s["value"]
+                      for s in samples("kfx_resources")},
+        "controllers": controllers,
+        "gangs": scalar("kfx_gangs"),
+        "events": scalar("kfx_events_total"),
+        "reconcile": reconcile,
+    }
+
+
+def _bucket_percentile_ms(sample: dict, q: float) -> Optional[float]:
+    """Percentile (ms) from a snapshot histogram sample's cumulative
+    [le, count] buckets (le serialized as strings, "+Inf" for the last)
+    — delegates to the one interpolation in obs.metrics."""
+    from .obs.metrics import percentile_from_buckets
+
+    buckets = [(float("inf") if le == "+Inf" else float(le), cum)
+               for le, cum in sample.get("buckets", [])]
+    p = percentile_from_buckets(buckets, q)
+    return round(p * 1000, 3) if p is not None else None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -284,11 +307,14 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path == "/metrics":
                 from .utils.prom import PROM_CTYPE
 
+                # Both formats come off the registry — exposition text
+                # via render(), JSON via the same snapshot — so there
+                # is exactly one metric inventory.
                 if (q.get("format") or [""])[0] == "json":
-                    return self._json(200, self._metrics())
+                    return self._json(
+                        200, metrics_json(self.cp.metrics.snapshot()))
                 return self._send(
-                    200, prometheus_text(self._metrics()).encode(),
-                    PROM_CTYPE)
+                    200, self.cp.metrics.render().encode(), PROM_CTYPE)
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
             if parts == ["ui", "notebooks"]:
@@ -325,7 +351,8 @@ class _Handler(BaseHTTPRequestHandler):
             evs = self.cp.store.events_for(cls.KIND, f"{ns}/{name}")
             return self._json(200, {"events": [
                 {"timestamp": e.timestamp, "type": e.type,
-                 "reason": e.reason, "message": e.message} for e in evs]})
+                 "reason": e.reason, "message": e.message,
+                 "traceId": e.trace_id} for e in evs]})
         if len(parts) == 4 and parts[3] == "logs":
             ns, name = parts[1], parts[2]
             replica = (q.get("replica") or [""])[0]
@@ -335,6 +362,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(400, "offset must be an integer")
             if offset < 0:
                 return self._error(400, "offset must be >= 0")
+            # ?tail=N serves only the last N bytes (what remote `kfx
+            # top` uses instead of downloading whole chief logs).
+            if (q.get("tail") or [""])[0]:
+                try:
+                    tail = int(q["tail"][0])
+                except ValueError:
+                    return self._error(400, "tail must be an integer")
+                if tail <= 0:
+                    return self._error(400, "tail must be > 0")
+                offset = -tail
             # job_logs_from returns ("", offset) before the gang has
             # written anything — pollers between apply and launch get an
             # empty 200, never an aborted connection.
@@ -352,12 +389,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._body_consumed = True
         try:
             if url.path == "/apis":
+                from .obs import trace as obs_trace
+
                 resources = load_manifests(text)
                 self._authorize_apply(resources)
-                applied = self.cp.apply(resources)
+                # Admission mints (or adopts the caller's) trace ID;
+                # echoing it per applied object lets clients follow the
+                # submission through events, gang envs and logs.
+                applied = self.cp.apply(
+                    resources,
+                    trace_id=self.headers.get(obs_trace.TRACE_HEADER)
+                    or None)
                 out = {"applied": [
                     {"kind": o.KIND, "name": o.name,
-                     "namespace": o.namespace, "verb": verb}
+                     "namespace": o.namespace, "verb": verb,
+                     "traceId": obs_trace.trace_of(o)}
                     for o, verb in applied]}
                 # A Profile applied BY THE CLUSTER ADMIN mints its
                 # owner's bearer token (plaintext returned exactly once,
@@ -445,23 +491,6 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             return self._error(500, f"{type(e).__name__}: {e}")
         return self._json(200, {"deleted": f"{parts[1]}/{parts[3]}"})
-
-    def _metrics(self) -> dict:
-        """Operator observability (SURVEY.md §5.5 Prometheus-metrics
-        role): per-kind resource counts, per-controller workqueue stats,
-        live gang count, event-log size."""
-        resources = {}
-        for kind in registered_kinds():
-            n = len(self.cp.store.list(kind))
-            if n:
-                resources[kind] = n
-        controllers = {
-            kind: ctrl.queue.stats()
-            for kind, ctrl in self.cp.manager.controllers.items()}
-        return {"resources": resources,
-                "controllers": controllers,
-                "gangs": self.cp.gangs.count(),
-                "events": self.cp.store.event_count()}
 
     # -- authorization ------------------------------------------------------
     def _caller(self) -> str:
@@ -1039,6 +1068,15 @@ class Client:
             f"/apis/{kind}/{namespace}/{name}/logs"
             f"?replica={replica}&offset={offset}")
         return text, int(headers.get("X-Kfx-Log-Offset") or offset)
+
+    def logs_tail(self, kind: str, namespace: str, name: str,
+                  replica: str = "", max_bytes: int = 16384) -> str:
+        """Only the last ``max_bytes`` of a replica log (?tail=N) — the
+        `kfx top` path, which must not transfer a huge log for its last
+        few metric lines."""
+        return self._call(
+            f"/apis/{kind}/{namespace}/{name}/logs"
+            f"?replica={replica}&tail={max_bytes}")[1]
 
     def events(self, kind: str, namespace: str, name: str) -> List[dict]:
         return self._json(f"/apis/{kind}/{namespace}/{name}/events")["events"]

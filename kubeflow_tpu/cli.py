@@ -185,7 +185,9 @@ class KfxCLI:
         if events:
             print("events:")
             for e in events:
-                print(f"  {e.timestamp} {e.type} {e.reason}: {e.message}")
+                trace = f" [trace={e.trace_id}]" if e.trace_id else ""
+                print(f"  {e.timestamp} {e.type} {e.reason}: "
+                      f"{e.message}{trace}")
         return 0
 
     def delete(self, kind: str, name: str, namespace: str) -> int:
@@ -217,8 +219,29 @@ class KfxCLI:
     def events(self, kind: str, name: str, namespace: str) -> int:
         cls = resource_class(kind)
         for e in self.cp.store.events_for(cls.KIND, f"{namespace}/{name}"):
-            print(f"{e.timestamp} {e.type} {e.reason}: {e.message}")
+            trace = f" [trace={e.trace_id}]" if e.trace_id else ""
+            print(f"{e.timestamp} {e.type} {e.reason}: {e.message}{trace}")
         return 0
+
+    def top(self) -> int:
+        """Live training telemetry (the `kubectl top` analogue): latest
+        step/loss/throughput per training job, parsed from each chief
+        log with the same stdout-metric contract the HPO collector uses
+        (SURVEY.md §5.5) — so `kfx top`, Katib observations and the
+        runner all agree on one number."""
+        rows = []
+        for kind in _training_kinds():
+            for job in self.cp.store.list(kind):
+                try:
+                    # Negative offset = tail: never read a huge chief
+                    # log whole for its last few metric lines.
+                    text, _ = self.cp.job_logs_from(
+                        kind, job.name, job.namespace, "", -16384)
+                except (OSError, KeyError):
+                    text = ""
+                rows.append([job.name, kind, job.namespace,
+                             _job_state(job)] + _telemetry_cells(text))
+        return _print_top(rows)
 
     def profile(self, kind: str, name: str, namespace: str, replica: str,
                 duration_ms: int, logdir: str) -> int:
@@ -273,6 +296,50 @@ class KfxCLI:
         return 1
 
 
+def _training_kinds() -> List[str]:
+    from .api.base import registered_kinds
+
+    out = []
+    for kind in registered_kinds():
+        try:
+            if issubclass(resource_class(kind), TrainingJob):
+                out.append(kind)
+        except KeyError:
+            continue
+    return out
+
+
+def _telemetry_cells(text: str) -> List[str]:
+    """[step, loss, step_time, rate] display cells from a chief log tail
+    (shared by local and remote `kfx top`)."""
+    from .hpo.collector import parse_metrics_text
+
+    wanted = ["step", "loss", "step_time",
+              "examples_per_sec", "tokens_per_s"]
+    latest = {}
+    for ob in parse_metrics_text(text, wanted):
+        latest[ob["name"]] = ob["value"]
+        latest["step"] = ob["step"]
+
+    def fmt(key, spec="{:.4g}"):
+        v = latest.get(key)
+        return spec.format(v) if v is not None else "-"
+
+    rate = latest.get("tokens_per_s", latest.get("examples_per_sec"))
+    return [str(int(latest.get("step", 0))) if latest else "-",
+            fmt("loss"), fmt("step_time"),
+            "{:.1f}".format(rate) if rate is not None else "-"]
+
+
+def _print_top(rows: List[List[str]]) -> int:
+    if not rows:
+        print("no training jobs")
+        return 0
+    _print_table(rows, ["NAME", "KIND", "NAMESPACE", "STATE", "STEP",
+                        "LOSS", "STEP_TIME", "EX_OR_TOK/S"])
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="kfx",
                                 description="TPU-native ML platform CLI")
@@ -320,6 +387,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("events", help="print resource events")
     sp.add_argument("kind")
     sp.add_argument("name")
+
+    sub.add_parser("top", help="live training telemetry (latest step/"
+                               "loss/throughput per job)")
 
     sp = sub.add_parser("kill-replica", help="fault injection: kill a replica")
     sp.add_argument("kind")
@@ -399,7 +469,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
             print(p)
         return 0
     _REMOTE_VERBS = ("apply", "run", "get", "describe", "delete", "logs",
-                     "events")
+                     "events", "top")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
     if args.cmd == "server":
@@ -439,7 +509,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # finished/ownerless gang case is the only one left after the routing
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
-                           "delete", "kill-replica")
+                           "delete", "kill-replica", "top")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -493,6 +563,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return cli.logs(args.kind, args.name, args.namespace, args.replica)
         if args.cmd == "events":
             return cli.events(args.kind, args.name, args.namespace)
+        if args.cmd == "top":
+            return cli.top()
         if args.cmd == "kill-replica":
             return cli.kill_replica(args.kind, args.name, args.namespace,
                                     args.replica)
@@ -696,9 +768,26 @@ def _remote_dispatch(client, args) -> int:
         return 0
     if args.cmd == "events":
         for e in client.events(args.kind, args.namespace, args.name):
+            trace = f" [trace={e['traceId']}]" if e.get("traceId") else ""
             print(f"{e['timestamp']} {e['type']} {e['reason']}: "
-                  f"{e['message']}")
+                  f"{e['message']}{trace}")
         return 0
+    if args.cmd == "top":
+        from .apiserver import ApiError
+
+        rows = []
+        for kind in _training_kinds():
+            for o in client.list(kind):
+                ns = o["metadata"].get("namespace", "default")
+                name = o["metadata"]["name"]
+                try:
+                    # Tail: don't download whole logs for a few lines.
+                    text = client.logs_tail(kind, ns, name)
+                except ApiError:
+                    text = ""
+                rows.append([name, kind, ns, _dict_state(o)]
+                            + _telemetry_cells(text))
+        return _print_top(rows)
     raise AssertionError(f"unhandled remote cmd {args.cmd}")
 
 

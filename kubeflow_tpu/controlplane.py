@@ -17,6 +17,8 @@ from .api.manifest import load_manifest_file, load_manifests
 from .api.training import TrainingJob
 from .core.controller import Manager
 from .core.store import ResourceStore
+from .obs import trace as obs_trace
+from .obs.metrics import MetricsRegistry
 from .operators import training_controllers
 from .runtime.gang import GangManager
 
@@ -77,7 +79,15 @@ class ControlPlane:
         self.store = ResourceStore(journal_path=journal_path)
         self.gangs = GangManager(os.path.join(self.home, "gangs"))
         self.manager = Manager(self.store)
+        # One registry per plane: reconcile histograms recorded live by
+        # the controllers, plus pull-time collectors for state that
+        # lives elsewhere (store counts, workqueue depths). Both
+        # /metrics formats render from this single snapshot path.
+        self.metrics = MetricsRegistry()
+        self.metrics.add_collector(self._collect_platform_metrics)
         self._register_controllers(worker_platform)
+        for ctrl in self.manager.controllers.values():
+            ctrl.metrics = self.metrics
         self._started = False
 
     def _register_controllers(self, worker_platform: Optional[str]) -> None:
@@ -155,11 +165,62 @@ class ControlPlane:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- observability -------------------------------------------------------
+    def _collect_platform_metrics(self, reg: MetricsRegistry) -> None:
+        """Pull-time collector: project live platform state into the
+        registry (SURVEY.md §5.5 Prometheus-metrics role) — per-kind
+        resource counts, per-controller workqueue gauges/counters, live
+        gang count, event-log size."""
+        from .api.base import registered_kinds
+
+        g = reg.gauge("kfx_resources", "Number of stored resources by kind.")
+        g.clear()
+        for kind in registered_kinds():
+            n = len(self.store.list(kind))
+            if n:
+                g.set(n, kind=kind)
+        stat_gauges = {
+            stat: reg.gauge(f"kfx_workqueue_{stat}",
+                            f"Workqueue {stat} by controller.")
+            for stat in ("depth", "delayed", "processing", "retrying")}
+        adds = reg.counter("kfx_workqueue_adds_total",
+                           "Keys added to the workqueue by controller.")
+        requeues = reg.counter(
+            "kfx_workqueue_requeues_total",
+            "Rate-limited (failure) requeues by controller.")
+        for kind, ctrl in self.manager.controllers.items():
+            stats = ctrl.queue.stats()
+            for stat, gauge in stat_gauges.items():
+                gauge.set(stats.get(stat, 0), controller=kind)
+            counters = ctrl.queue.counters()
+            adds.set_total(counters["adds"], controller=kind)
+            requeues.set_total(counters["requeues"], controller=kind)
+        reg.gauge("kfx_gangs", "Live process gangs.").set(self.gangs.count())
+        reg.counter("kfx_events_total",
+                    "Events recorded since startup.").set_total(
+                        self.store.event_count())
+
     # -- user-facing operations (the kubectl verbs) -------------------------
-    def apply(self, resources: List[Resource]) -> List[Tuple[Resource, str]]:
+    def apply(self, resources: List[Resource],
+              trace_id: Optional[str] = None) -> List[Tuple[Resource, str]]:
+        # Admission mints ONE trace ID per submission (or adopts the
+        # caller's, e.g. the apiserver's X-Kfx-Trace-Id): every new
+        # object in the batch shares it, so a job and the resources it
+        # arrived with join on one correlation ID. Stored on metadata,
+        # it rides through reconciles into gang envs and events.
+        trace_id = trace_id or obs_trace.new_trace_id()
         out = []
         for obj in resources:
             obj.validate()
+            # Re-applies keep the live object's ID so an unchanged
+            # manifest stays "unchanged" (no resourceVersion churn).
+            existing = self.store.try_get(obj.KIND, obj.name, obj.namespace)
+            inherited = obs_trace.trace_of(existing)
+            if inherited and not obs_trace.trace_of(obj):
+                obj.metadata.annotations[obs_trace.TRACE_ANNOTATION] = \
+                    inherited
+            else:
+                obs_trace.ensure_trace(obj, trace_id)
             out.append(self.store.apply(obj))
         return out
 
@@ -227,11 +288,17 @@ class ControlPlane:
     def job_logs_from(self, kind: str, name: str, namespace: str,
                       replica: str, offset: int) -> Tuple[str, int]:
         """Incremental tail: read from byte ``offset``, return (new text,
-        next offset) — pollers don't re-read the whole file."""
+        next offset) — pollers don't re-read the whole file. A NEGATIVE
+        offset reads the last ``-offset`` bytes (the `kfx top` path: a
+        multi-hundred-MB chief log must not be read whole for its last
+        few metric lines)."""
         path = self._replica_log_path(kind, name, namespace, replica)
         if not os.path.exists(path):
-            return "", offset
+            return "", max(offset, 0)
         with open(path, "rb") as f:
+            if offset < 0:
+                f.seek(0, os.SEEK_END)
+                offset = max(0, f.tell() + offset)
             f.seek(offset)
             data = f.read()
         return data.decode(errors="replace"), offset + len(data)

@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional
 
 from ..api.base import Resource
+from ..obs import trace as obs_trace
 from .store import DELETED, ResourceStore, WatchEvent
 from .workqueue import RateLimitingQueue
 
@@ -45,6 +47,9 @@ class Controller:
     def __init__(self, store: ResourceStore):
         self.store = store
         self.queue = RateLimitingQueue()
+        # Set by the control plane: reconcile durations/outcomes land in
+        # this registry (kfx_reconcile_* with {kind=...} labels).
+        self.metrics = None
 
     # -- helpers -----------------------------------------------------------
     def get_resource(self, key: str) -> Optional[Resource]:
@@ -53,7 +58,12 @@ class Controller:
 
     def record_event(self, obj: Resource, etype: str, reason: str,
                      message: str) -> None:
-        self.store.record_event(obj, etype, reason, message)
+        # Events carry the submission's trace ID (resource annotation,
+        # falling back to the reconcile-scoped thread-local) so `kfx
+        # events` can join a job's whole story on one correlation ID.
+        trace_id = obs_trace.trace_of(obj) or obs_trace.current_trace_id()
+        self.store.record_event(obj, etype, reason, message,
+                                trace_id=trace_id)
         log.info("%s %s: %s %s: %s", self.KIND, obj.key, etype, reason, message)
 
     # -- the reconcile contract -------------------------------------------
@@ -74,9 +84,16 @@ class Controller:
         key = self.queue.get(timeout=0.2)
         if key is None:
             return False
+        # Scope the submission's trace ID onto this worker thread for
+        # the duration of the reconcile, so any event recorded inside
+        # (even against a child object) carries it.
+        obs_trace.set_trace_id(obs_trace.trace_of(self.get_resource(key)))
+        t0 = time.monotonic()
+        outcome = "ok"
         try:
             result = self.reconcile(key)
         except Exception:
+            outcome = "error"
             log.error("reconcile %s %s failed:\n%s", self.KIND, key,
                       traceback.format_exc())
             retries = self.queue.num_requeues(key)
@@ -89,10 +106,26 @@ class Controller:
         else:
             self.queue.forget(key)
             if result is not None and result.requeue:
+                outcome = "requeue"
                 self.queue.add_after(key, result.requeue_after)
         finally:
+            self._record_reconcile(time.monotonic() - t0, outcome)
+            obs_trace.set_trace_id("")
             self.queue.done(key)
         return True
+
+    def _record_reconcile(self, seconds: float, outcome: str) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.histogram(
+            "kfx_reconcile_duration_seconds",
+            "Reconcile wall time by controller kind.",
+        ).observe(seconds, kind=self.KIND)
+        self.metrics.counter(
+            "kfx_reconcile_total",
+            "Reconcile outcomes by controller kind "
+            "(result: ok|requeue|error).",
+        ).inc(1, kind=self.KIND, result=outcome)
 
     def run(self, stop: threading.Event) -> None:
         while not stop.is_set():

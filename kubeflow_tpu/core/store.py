@@ -50,23 +50,31 @@ class WatchEvent:
 
 
 class Event:
-    """A k8s Event equivalent: recorded against an involved object."""
+    """A k8s Event equivalent: recorded against an involved object.
+    ``trace_id`` is the submission's correlation ID (obs.trace) when
+    the recorder knew it — what lets `kfx events` join a job's story
+    across admission, reconciles and gang launches."""
 
-    __slots__ = ("timestamp", "type", "reason", "message", "kind", "key")
+    __slots__ = ("timestamp", "type", "reason", "message", "kind", "key",
+                 "trace_id")
 
     def __init__(self, kind: str, key: str, etype: str, reason: str, message: str,
-                 timestamp: Optional[str] = None):
+                 timestamp: Optional[str] = None, trace_id: str = ""):
         self.timestamp = timestamp or utcnow()
         self.type = etype  # "Normal" | "Warning"
         self.reason = reason
         self.message = message
         self.kind = kind
         self.key = key
+        self.trace_id = trace_id
 
     def to_dict(self) -> Dict[str, str]:
-        return {"timestamp": self.timestamp, "type": self.type,
-                "reason": self.reason, "message": self.message,
-                "kind": self.kind, "key": self.key}
+        d = {"timestamp": self.timestamp, "type": self.type,
+             "reason": self.reason, "message": self.message,
+             "kind": self.kind, "key": self.key}
+        if self.trace_id:
+            d["traceId"] = self.trace_id
+        return d
 
 
 class ResourceStore:
@@ -76,6 +84,7 @@ class ResourceStore:
         self._rv = 0
         self._watchers: List[queue.Queue] = []
         self._events: List[Event] = []
+        self._events_total = 0  # monotonic; survives _events trimming
         self._journal: Optional[sqlite3.Connection] = None
         self._journal_lock = threading.Lock()
         if journal_path:
@@ -90,7 +99,13 @@ class ResourceStore:
             " PRIMARY KEY (kind, namespace, name))")
         conn.execute(
             "CREATE TABLE IF NOT EXISTS events ("
-            " ts TEXT, kind TEXT, key TEXT, type TEXT, reason TEXT, message TEXT)")
+            " ts TEXT, kind TEXT, key TEXT, type TEXT, reason TEXT,"
+            " message TEXT, trace TEXT)")
+        # Pre-trace journals lack the trace column; upgrade in place.
+        try:
+            conn.execute("ALTER TABLE events ADD COLUMN trace TEXT")
+        except sqlite3.OperationalError:
+            pass  # column already there
         conn.commit()
         self._journal = conn
         # Recover prior state.
@@ -261,21 +276,28 @@ class ResourceStore:
 
     # -- events ------------------------------------------------------------
     def event_count(self) -> int:
+        """Events recorded since startup — monotonic even though the
+        in-memory list is trimmed, so the exported counter never goes
+        backwards (a decrease would read as a counter reset and fake
+        thousands of phantom events in rate() queries)."""
         with self._lock:
-            return len(self._events)
+            return self._events_total
 
     def record_event(self, obj: Resource, etype: str, reason: str,
-                     message: str) -> None:
-        ev = Event(obj.KIND, obj.key, etype, reason, message)
+                     message: str, trace_id: str = "") -> None:
+        ev = Event(obj.KIND, obj.key, etype, reason, message,
+                   trace_id=trace_id)
         with self._lock:
             self._events.append(ev)
+            self._events_total += 1
             if len(self._events) > 10000:
                 self._events = self._events[-5000:]
         if self._journal is not None:
             with self._journal_lock:
                 self._journal.execute(
-                    "INSERT INTO events VALUES (?,?,?,?,?,?)",
-                    (ev.timestamp, ev.kind, ev.key, ev.type, ev.reason, ev.message))
+                    "INSERT INTO events VALUES (?,?,?,?,?,?,?)",
+                    (ev.timestamp, ev.kind, ev.key, ev.type, ev.reason,
+                     ev.message, ev.trace_id))
                 self._journal.commit()
 
     def events_for(self, kind: str, key: str) -> List[Event]:

@@ -26,6 +26,8 @@ class RateLimitingQueue:
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._shutdown = False
+        self._adds_total = 0
+        self._requeues_total = 0
 
     def stats(self) -> Dict[str, int]:
         """Observability snapshot (the Prometheus-workqueue-metrics role):
@@ -36,11 +38,20 @@ class RateLimitingQueue:
                     "processing": len(self._processing),
                     "retrying": len(self._failures)}
 
+    def counters(self) -> Dict[str, int]:
+        """Cumulative counters since construction (the monotonic half of
+        the workqueue metrics; stats() is the gauge half): total keys
+        added and total rate-limited requeues."""
+        with self._cond:
+            return {"adds": self._adds_total,
+                    "requeues": self._requeues_total}
+
     # -- adding ------------------------------------------------------------
     def add(self, key: str) -> None:
         with self._cond:
             if self._shutdown:
                 return
+            self._adds_total += 1
             if key in self._processing:
                 self._dirty.add(key)
                 return
@@ -65,6 +76,7 @@ class RateLimitingQueue:
         with self._cond:
             n = self._failures.get(key, 0)
             self._failures[key] = n + 1
+            self._requeues_total += 1
         delay = min(self._base_delay * (2 ** n), self._max_delay)
         self.add_after(key, delay)
 

@@ -1,0 +1,27 @@
+"""kfx observability: metrics registry + trace-ID propagation.
+
+``obs.metrics`` is the process-wide instrument registry every /metrics
+endpoint renders; ``obs.trace`` carries one correlation ID from
+apiserver admission through reconciles, gang environments and serving
+request logs. See docs/observability.md.
+"""
+
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .trace import (  # noqa: F401
+    TRACE_ANNOTATION,
+    TRACE_ENV,
+    TRACE_HEADER,
+    current_trace_id,
+    ensure_trace,
+    new_trace_id,
+    set_trace_id,
+    span,
+    trace_of,
+)

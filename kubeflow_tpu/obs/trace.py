@@ -1,0 +1,96 @@
+"""Trace-ID propagation: one correlation ID per submission, end to end.
+
+The reference platform gets request correlation from Istio's
+x-request-id; this self-hosted control plane mints its own. The flow:
+
+  1. minted at admission (``ControlPlane.apply`` — the apiserver POST
+     and local `kfx apply` both land there) and stored on resource
+     metadata under the ``kubeflow.org/trace-id`` annotation;
+  2. picked up by controller reconciles (thread-local scope around each
+     ``reconcile`` call) so recorded events carry it;
+  3. exported into every gang member's environment as ``KFX_TRACE_ID``
+     so runner logs can echo it;
+  4. echoed by serving request logs (``X-Kfx-Trace-Id`` header in and
+     out of the model server).
+
+`kfx events <job>` then joins the whole story on one ID.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+import uuid
+from typing import Iterator, Optional
+
+TRACE_ENV = "KFX_TRACE_ID"
+TRACE_ANNOTATION = "kubeflow.org/trace-id"
+TRACE_HEADER = "X-Kfx-Trace-Id"
+
+_tls = threading.local()
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def set_trace_id(trace_id: str) -> None:
+    """Set the calling thread's current trace ID ("" clears it)."""
+    _tls.trace_id = trace_id or ""
+
+
+def current_trace_id() -> str:
+    """The calling thread's trace ID, falling back to the process env
+    (gang members inherit KFX_TRACE_ID from the operator)."""
+    return getattr(_tls, "trace_id", "") or os.environ.get(TRACE_ENV, "")
+
+
+def trace_of(obj) -> str:
+    """The trace ID stored on a resource's metadata, or ""."""
+    if obj is None:
+        return ""
+    return obj.metadata.annotations.get(TRACE_ANNOTATION, "")
+
+
+def ensure_trace(obj, trace_id: Optional[str] = None) -> str:
+    """Make sure a resource carries a trace annotation (minting one if
+    absent); returns the effective ID."""
+    existing = trace_of(obj)
+    if existing:
+        return existing
+    tid = trace_id or new_trace_id()
+    obj.metadata.annotations[TRACE_ANNOTATION] = tid
+    return tid
+
+
+class Span:
+    """One timed unit of work under a trace ID."""
+
+    __slots__ = ("name", "trace_id", "started", "elapsed")
+
+    def __init__(self, name: str, trace_id: str):
+        self.name = name
+        self.trace_id = trace_id
+        self.started = time.perf_counter()
+        self.elapsed = 0.0
+
+
+@contextlib.contextmanager
+def span(name: str, trace_id: str = "", histogram=None,
+         **labels: str) -> Iterator[Span]:
+    """Scope a trace ID onto the current thread and time the body.
+    ``histogram`` (an obs Histogram) gets the duration observed with
+    ``labels`` on exit — success or failure."""
+    tid = trace_id or current_trace_id()
+    prev = getattr(_tls, "trace_id", "")
+    _tls.trace_id = tid
+    sp = Span(name, tid)
+    try:
+        yield sp
+    finally:
+        sp.elapsed = time.perf_counter() - sp.started
+        _tls.trace_id = prev
+        if histogram is not None:
+            histogram.observe(sp.elapsed, **labels)

@@ -293,11 +293,14 @@ class NotebookController(Controller):
                 if applied:
                     ctrl.record_event(nb, "Normal", "PodDefaultsApplied",
                                       ", ".join(applied))
+            from ..obs.trace import trace_of
+
             return G.Gang(
                 name=nb.name, specs=specs, workdir=workdir,
                 restart_policy="OnFailure", backoff_limit=5,
                 chief_replica_type="Notebook",
-                on_change=lambda g: ctrl.queue.add(key))
+                on_change=lambda g: ctrl.queue.add(key),
+                trace_id=trace_of(nb))
 
         return self.gangs.ensure(gkey, factory)
 

@@ -179,6 +179,8 @@ class TrainingControllerBase(Controller):
             # chief's wins as it decides success anyway).
             chief = job.chief_replica_type()
             rp = job.replica_specs()[chief].restart_policy
+            from ..obs.trace import trace_of
+
             return G.Gang(
                 name=job.name,
                 specs=specs,
@@ -191,6 +193,7 @@ class TrainingControllerBase(Controller):
                 chief_replica_type=chief,
                 on_change=lambda g: ctrl.queue.add(key),
                 restart_env_hook=env_hook,
+                trace_id=trace_of(job),
             )
 
         return self.gangs.ensure(gkey, factory)

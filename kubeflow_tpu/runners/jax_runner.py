@@ -114,9 +114,13 @@ def main(argv=None) -> int:
         # All ranks print (per-replica logs); collector reads the chief's.
         print(msg, flush=True)
 
+    # The gang exports the submission's trace ID (obs.trace); echoing it
+    # makes this log joinable with `kfx events` on one correlation ID.
+    trace_id = os.environ.get("KFX_TRACE_ID", "")
     log(f"runner_start model={args.model} dataset={args.dataset} "
         f"rank={rank} world={world} devices={jax.device_count()} "
-        f"platform={jax.devices()[0].platform}")
+        f"platform={jax.devices()[0].platform}"
+        + (f" trace={trace_id}" if trace_id else ""))
 
     dataset = get_dataset(args.dataset, split="train", seed=args.seed)
     model = get_model(args.model, num_classes=dataset.num_classes)
@@ -142,6 +146,7 @@ def main(argv=None) -> int:
 
     t_start = time.time()
     t_last = t_start
+    last_log_step = start_step
     # auto: on-device generation only where there is a transfer to save
     # (an accelerator backend). On the CPU backend host feeding is free
     # of transfer AND avoids XLA:CPU's very slow compiles of conv models
@@ -250,10 +255,17 @@ def main(argv=None) -> int:
         step += k
         now = time.time()
         if step % args.log_every == 0 or step == args.steps:
-            dt = (now - t_last) / args.log_every
+            # Divide by the steps actually elapsed since the last log —
+            # the final partial interval (steps not a multiple of
+            # log_every) must not report inflated throughput.
+            dt = (now - t_last) / max(step - last_log_step, 1)
+            # examples_per_sec rides the same stdout metric contract the
+            # HPO collector parses; `kfx top` reads it live.
+            eps = args.batch_size / dt if dt > 0 else 0.0
             log(f"step={step} loss={loss:.6f} accuracy={acc:.6f} "
-                f"step_time={dt:.4f}")
+                f"step_time={dt:.4f} examples_per_sec={eps:.1f}")
             t_last = now
+            last_log_step = step
         if ckpt is not None:
             ckpt.maybe_save(step, state)
 

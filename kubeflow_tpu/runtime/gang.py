@@ -124,6 +124,7 @@ class Gang:
         on_change: Optional[Callable[["Gang"], None]] = None,
         restart_env_hook: Optional[
             Callable[[int], Dict[str, Dict[str, str]]]] = None,
+        trace_id: str = "",
     ):
         self.name = name
         self.specs = specs
@@ -135,6 +136,10 @@ class Gang:
         self.chief_replica_type = chief_replica_type or (
             specs[0].replica_type if specs else "")
         self.on_change = on_change
+        # Submission correlation ID (obs.trace): exported to every
+        # member as KFX_TRACE_ID and stamped on the log attempt header,
+        # so runner output joins the control plane's events on one ID.
+        self.trace_id = trace_id
         # Called with the attempt number before each (re)launch; returns
         # env overrides keyed by replica id — used to re-allocate
         # rendezvous ports so a restart (or a port-collision crash) always
@@ -212,11 +217,14 @@ class Gang:
                 env.update(overrides.get("*", {}))
                 env.update(overrides.get(spec.id, {}))
                 env[lifetime.PARENT_FD_ENV] = str(self._keepalive_r)
+                if self.trace_id:
+                    env.setdefault("KFX_TRACE_ID", self.trace_id)
                 argv = [expand_k8s_refs(a, env) for a in spec.argv]
                 logf = open(self.log_path(spec.id), "ab")
+                trace_tag = f" trace={self.trace_id}" if self.trace_id else ""
                 logf.write(
                     f"==== attempt {attempt} {time.strftime('%Y-%m-%dT%H:%M:%S')}"
-                    f" ====\n".encode())
+                    f"{trace_tag} ====\n".encode())
                 logf.flush()
                 p = subprocess.Popen(
                     argv, env=env, cwd=spec.cwd or self.workdir,

@@ -19,12 +19,14 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from typing import Any, Dict
 
 import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+from ..obs.metrics import default_registry
 from .server import Predictor
 
 CONFIG_FILE = "lm_config.json"
@@ -75,6 +77,9 @@ class LMPredictor(Predictor):
         self.device = device
         self._gen = None
         self.vocab_size = 0
+        # Replaced with the hosting ModelServer's registry at register()
+        # time so decode throughput shows up on that server's /metrics.
+        self.metrics = default_registry()
 
     def load(self) -> None:
         import jax
@@ -112,10 +117,29 @@ class LMPredictor(Predictor):
                     arr.max() >= self.vocab_size:
                 raise ValueError(
                     f"prompt token ids must be in [0, {self.vocab_size})")
+        t0 = time.perf_counter()
         out = self._gen.generate(
             [list(map(int, p)) for p in prompts],
             max_new_tokens=int(body.get("max_new_tokens", 32)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             seed=int(body.get("seed", 0)))
-        return {"generated_tokens": out}
+        elapsed = time.perf_counter() - t0
+        n_tokens = sum(len(ids) for ids in out)
+        tps = n_tokens / elapsed if elapsed > 0 else 0.0
+        # Decode throughput is the LM serving headline (BENCH lm rows);
+        # exporting it makes `kfx top` and /metrics agree with bench.
+        self.metrics.counter(
+            "kfx_lm_generated_tokens_total",
+            "Tokens generated since startup.").inc(n_tokens,
+                                                   model=self.name)
+        self.metrics.gauge(
+            "kfx_lm_tokens_per_second",
+            "Decode throughput of the most recent generate call.").set(
+                round(tps, 2), model=self.name)
+        self.metrics.histogram(
+            "kfx_lm_generate_seconds",
+            "Wall time of generate calls.").observe(elapsed,
+                                                    model=self.name)
+        return {"generated_tokens": out,
+                "tokens_per_second": round(tps, 2)}

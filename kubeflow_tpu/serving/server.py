@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import queue
 import threading
@@ -31,6 +32,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import TRACE_HEADER
+
+request_log = logging.getLogger("kfx.serving")
+
+# Request-latency buckets (seconds): sub-millisecond host predicts up
+# to multi-second LM generations, fine enough near the tunnel's
+# 65-100ms floor that the p50 estimate tracks bench-observed latency.
+SERVING_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.0075, 0.01, 0.015, 0.02, 0.03, 0.04, 0.05,
+    0.065, 0.08, 0.1, 0.13, 0.17, 0.25, 0.4, 0.65, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0)
 
 
 class Predictor:
@@ -340,8 +354,21 @@ class ModelServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self.predictors: Dict[str, Predictor] = {}
         self.batchers: Dict[str, MicroBatcher] = {}
-        self.request_count = 0
-        self._lock = threading.Lock()
+        # Server-reported latency distribution (so serving_p50_ms is a
+        # /metrics fact, not only a bench observation) + request/error
+        # counters, all rendered by the registry on /metrics.
+        self.metrics = MetricsRegistry()
+        self.latency = self.metrics.histogram(
+            "kfx_serving_request_seconds",
+            "End-to-end predict/generate handling time by model and verb.",
+            buckets=SERVING_BUCKETS)
+        self.requests_total = self.metrics.counter(
+            "kfx_serving_requests_total",
+            "Predict requests served since startup.")
+        self.errors_total = self.metrics.counter(
+            "kfx_serving_errors_total",
+            "Requests answered with a non-2xx status.")
+        self.metrics.add_collector(self._collect_model_gauges)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -362,8 +389,13 @@ class ModelServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                trace = self.headers.get(TRACE_HEADER, "")
+                if trace:
+                    # Echo the caller's correlation ID (obs.trace flow).
+                    self.send_header(TRACE_HEADER, trace)
                 self.end_headers()
                 self.wfile.write(body)
+                self._last_code = code
 
             def do_GET(self):
                 server._handle_get(self)
@@ -381,10 +413,68 @@ class ModelServer:
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
+    # -- observability ------------------------------------------------------
+    @property
+    def request_count(self) -> int:
+        """Total routed predict/generate requests — a view over the
+        registry counter, so the JSON and exposition formats can never
+        disagree on the request total."""
+        return int(sum(v for _, v in self.requests_total.samples()))
+
+    def _collect_model_gauges(self, reg: MetricsRegistry) -> None:
+        reg.gauge("kfx_serving_models",
+                  "Registered models.").set(len(self.predictors))
+        reg.gauge("kfx_serving_models_ready",
+                  "Models ready to serve.").set(
+                      sum(1 for p in self.predictors.values() if p.ready))
+
+    def _latency_summary(self) -> Dict[str, Dict[str, Optional[float]]]:
+        """Server-reported per-model p50/p99 (ms) from the request
+        histogram — the number bench-observed serving_p50_ms should
+        agree with (±bucket resolution)."""
+        out: Dict[str, Dict[str, Optional[float]]] = {}
+        for name in self.predictors:
+            if not self.latency.count(model=name):
+                continue
+            p50 = self.latency.percentile(0.5, {"model": name})
+            p99 = self.latency.percentile(0.99, {"model": name})
+            out[name] = {
+                "p50": round(p50 * 1000, 3) if p50 is not None else None,
+                "p99": round(p99 * 1000, 3) if p99 is not None else None,
+            }
+        return out
+
+    def _finish_request(self, h, name: str, verb: str, t0: float) -> None:
+        """Record latency/outcome for one routed request and emit the
+        structured request log line (trace ID echoed from the caller)."""
+        dt = time.perf_counter() - t0
+        # _last_code was reset at routing time, so 0 here means the
+        # handler died before sending anything (connection reset,
+        # write failure) — an error, not a success.
+        code = getattr(h, "_last_code", 0)
+        # The model label comes from the URL; only registered names may
+        # become label values, or a scanner cycling arbitrary model
+        # names would grow the counter's label space without bound.
+        model = name if name in self.predictors else "unknown"
+        self.requests_total.inc(1, model=model, verb=verb)
+        if 200 <= code < 400:
+            # Only successful requests shape the latency distribution —
+            # sub-ms 4xx rejections (and aborted connections) would
+            # distort the p50 clients actually experience.
+            self.latency.observe(dt, model=model, verb=verb)
+        else:
+            self.errors_total.inc(1, model=model, verb=verb)
+        request_log.info(
+            "request model=%s verb=%s status=%s ms=%.2f trace=%s",
+            name, verb, code, dt * 1000, h.headers.get(TRACE_HEADER, ""))
+
     # -- registration -------------------------------------------------------
     def register(self, predictor: Predictor,
                  batcher: Optional[Dict[str, Any]] = None) -> None:
         self.predictors[predictor.name] = predictor
+        # Predictors with their own instruments (LM tokens/sec) record
+        # into the server's registry so one /metrics shows everything.
+        predictor.metrics = self.metrics
         if batcher:
             self.batchers[predictor.name] = MicroBatcher(
                 predictor,
@@ -401,25 +491,18 @@ class ModelServer:
         elif path == "/metrics" or path.startswith("/metrics?"):
             # Prometheus exposition by default (the reference model
             # servers are Prometheus-scrapable); JSON via ?format=json.
+            # Both formats render the same registry state.
             from urllib.parse import parse_qs, urlsplit
 
             q = parse_qs(urlsplit(path).query)
             if (q.get("format") or [""])[0] == "json":
                 h._send(200, {"request_count": self.request_count,
-                              "models": sorted(self.predictors)})
+                              "models": sorted(self.predictors),
+                              "latency_ms": self._latency_summary()})
             else:
-                from ..utils.prom import PROM_CTYPE, prom_text
+                from ..utils.prom import PROM_CTYPE
 
-                ready = sum(1 for p in self.predictors.values() if p.ready)
-                h._send_text(200, prom_text([
-                    ("kfx_serving_requests_total", "counter",
-                     "Predict requests served since startup.",
-                     self.request_count),
-                    ("kfx_serving_models", "gauge",
-                     "Registered models.", len(self.predictors)),
-                    ("kfx_serving_models_ready", "gauge",
-                     "Models ready to serve.", ready),
-                ]), PROM_CTYPE)
+                h._send_text(200, self.metrics.render(), PROM_CTYPE)
         elif path == "/v1/models":
             h._send(200, {"models": sorted(self.predictors)})
         elif path.startswith("/v1/models/"):
@@ -434,12 +517,27 @@ class ModelServer:
 
     def _handle_post(self, h) -> None:
         path = h.path
+        t0 = time.perf_counter()
+        # Reset per request: the handler object persists across a
+        # keep-alive connection, and a stale 200 from the previous
+        # request must not mark an aborted one as served.
+        h._last_code = 0
         if path.startswith("/v1/models/") and path.endswith(":generate"):
-            return self._handle_generate(h)
+            name = path[len("/v1/models/"):-len(":generate")]
+            try:
+                return self._handle_generate(h, name)
+            finally:
+                self._finish_request(h, name, "generate", t0)
         if not (path.startswith("/v1/models/") and path.endswith(":predict")):
             h._send(404, {"error": f"no route {path}"})
             return
         name = path[len("/v1/models/"):-len(":predict")]
+        try:
+            self._handle_predict(h, name)
+        finally:
+            self._finish_request(h, name, "predict", t0)
+
+    def _handle_predict(self, h, name: str) -> None:
         p = self.predictors.get(name)
         if p is None:
             h._send(404, {"error": f"model {name!r} not found"})
@@ -455,8 +553,6 @@ class ModelServer:
         except (ValueError, KeyError) as e:
             h._send(400, {"error": f"bad request: {e}"})
             return
-        with self._lock:
-            self.request_count += 1
         try:
             batcher = self.batchers.get(name)
             result = (batcher or p).predict(instances,
@@ -466,10 +562,9 @@ class ModelServer:
             return
         h._send(200, result)
 
-    def _handle_generate(self, h) -> None:
+    def _handle_generate(self, h, name: str) -> None:
         """LM text generation (serving/lm_server.py): token ids in,
         generated token ids out."""
-        name = h.path[len("/v1/models/"):-len(":generate")]
         p = self.predictors.get(name)
         if p is None:
             h._send(404, {"error": f"model {name!r} not found"})
@@ -487,8 +582,6 @@ class ModelServer:
         except ValueError as e:
             h._send(400, {"error": f"bad request: {e}"})
             return
-        with self._lock:
-            self.request_count += 1
         try:
             result = p.generate(body)
         except ValueError as e:

@@ -23,6 +23,8 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.metrics import default_registry
+
 
 class TrainState(struct.PyTreeNode):
     step: jax.Array
@@ -70,6 +72,29 @@ class TrainLoop:
         # train_steps_device).
         self._device_consts: Dict[int, Any] = {}
         self._device_key = jax.random.PRNGKey(seed + 1)
+        # Step timing into the process registry (SURVEY.md §5.5): the
+        # runner's stdout lines stay the collector contract, but the
+        # registry gives in-process consumers (tests, embedded servers)
+        # the same distribution without log parsing.
+        obs = default_registry()
+        self._obs_step = obs.histogram(
+            "kfx_train_step_seconds",
+            "Per-optimizer-step wall time (fused dispatches amortised).")
+        self._obs_rate = obs.gauge(
+            "kfx_train_examples_per_second",
+            "Training throughput of the most recent dispatch.")
+        # Several loops can share one process (bench ladders, HPO
+        # trials); the model label keeps their distributions apart.
+        self._obs_model = type(model).__name__
+
+    def _record_steps(self, seconds: float, n_steps: int,
+                      batch_size: int) -> None:
+        if seconds <= 0 or n_steps <= 0:
+            return
+        self._obs_step.observe(seconds / n_steps, n=n_steps,
+                               model=self._obs_model)
+        self._obs_rate.set(round(n_steps * batch_size / seconds, 2),
+                           model=self._obs_model)
 
     # -- state -------------------------------------------------------------
     def init_state(self, sample_shape: Tuple[int, ...]) -> TrainState:
@@ -245,15 +270,19 @@ class TrainLoop:
                          batch_fn, batch_size, n_steps))
             self._device_fns[fn_key] = entry
         _, consts, fn = entry
+        t0 = time.perf_counter()
         state, loss, acc = fn(state, self._device_key,
                               jnp.int32(start_step), consts)
-        return state, float(loss), float(acc)
+        loss, acc = float(loss), float(acc)  # sync before timing
+        self._record_steps(time.perf_counter() - t0, n_steps, batch_size)
+        return state, loss, acc
 
     def train_steps(self, state: TrainState, images: np.ndarray,
                     labels: np.ndarray) -> Tuple[TrainState, float, float]:
         """Run a [K, B, ...] stacked chunk in one dispatch."""
         if self._train_many_fn is None:
             self._train_many_fn = self._build_train_many()
+        t0 = time.perf_counter()
         if jax.process_count() == 1:
             g_images = jax.device_put(images, self.chunk_sharding)
             g_labels = jax.device_put(labels, self.chunk_sharding)
@@ -263,7 +292,10 @@ class TrainLoop:
             g_labels = jax.make_array_from_process_local_data(
                 self.chunk_sharding, labels)
         state, loss, acc = self._train_many_fn(state, g_images, g_labels)
-        return state, float(loss), float(acc)
+        loss, acc = float(loss), float(acc)  # sync before timing
+        self._record_steps(time.perf_counter() - t0, images.shape[0],
+                           images.shape[1])
+        return state, loss, acc
 
     def _build_eval_step(self):
         model = self.model
@@ -298,9 +330,12 @@ class TrainLoop:
                    labels: np.ndarray) -> Tuple[TrainState, float, float]:
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        t0 = time.perf_counter()
         g_images, g_labels = self.global_batch(images, labels)
         state, loss, acc = self._train_step(state, g_images, g_labels)
-        return state, float(loss), float(acc)
+        loss, acc = float(loss), float(acc)  # sync before timing
+        self._record_steps(time.perf_counter() - t0, 1, images.shape[0])
+        return state, loss, acc
 
     def evaluate(self, state: TrainState, images: np.ndarray,
                  labels: np.ndarray, batch_size: int = 512) -> Dict[str, float]:

@@ -1,13 +1,30 @@
-"""Prometheus exposition-format 0.0.4 emission, shared by every
-/metrics endpoint (apiserver, model server) so the format conventions
-live in exactly one place (SURVEY.md §5.5: the reference's operators
-and model servers are Prometheus-scrapable)."""
+"""Prometheus exposition-format 0.0.4 emission and validation, shared
+by every /metrics endpoint (apiserver, model server) so the format
+conventions live in exactly one place (SURVEY.md §5.5: the reference's
+operators and model servers are Prometheus-scrapable).
+
+Three layers:
+  * ``prom_text`` renders [(name, type, help, value)] to exposition
+    text — scalars, labelled gauges, and (since the obs subsystem)
+    histograms with ``_bucket``/``le``, ``_sum`` and ``_count`` series;
+  * ``parse_prom_text`` parses exposition text back into samples —
+    the round-trip half used by label-escaping tests and `kfx top`;
+  * ``validate_exposition`` collects per-line format errors — what
+    scripts/scrape_metrics.py runs against every live endpoint so a
+    malformed label or value fails CI instead of a scrape.
+"""
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple, Union
+import math
+import re
+from typing import Dict, List, Optional, Tuple, Union
 
 PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 
 
 def _esc_label(v: str) -> str:
@@ -21,14 +38,59 @@ def _esc_label(v: str) -> str:
 def _esc_help(v: str) -> str:
     return str(v).replace("\\", r"\\").replace("\n", r"\n")
 
-# value: a bare number, or {label-dict-as-tuple...} — see prom_text.
-Value = Union[int, float, List[Tuple[Dict[str, str], Union[int, float]]]]
+
+class HistogramValue:
+    """Rendered form of one histogram sample: cumulative ``buckets``
+    [(upper_bound, cumulative_count)] (the last bound is +Inf), plus
+    the running ``sum`` and total ``count``."""
+
+    __slots__ = ("buckets", "sum", "count")
+
+    def __init__(self, buckets: List[Tuple[float, int]],
+                 sum_: float, count: int):
+        self.buckets = buckets
+        self.sum = sum_
+        self.count = count
+
+
+def fmt_le(bound: float) -> str:
+    """Bucket upper bound as Prometheus spells it (``le`` label)."""
+    if math.isinf(bound):
+        return "+Inf"
+    return f"{bound:g}"
+
+
+# value: a bare number, a HistogramValue, or a list of (labels, one of
+# those) pairs — see prom_text.
+Scalar = Union[int, float]
+Value = Union[Scalar, HistogramValue,
+              List[Tuple[Dict[str, str], Union[Scalar, HistogramValue]]]]
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    return ",".join(f'{k}="{_esc_label(v)}"' for k, v in labels.items())
+
+
+def _render_sample(lines: List[str], name: str, labels: Dict[str, str],
+                   value: Union[Scalar, HistogramValue]) -> None:
+    if isinstance(value, HistogramValue):
+        for bound, cum in value.buckets:
+            lab = _label_str({**labels, "le": fmt_le(bound)})
+            lines.append(f"{name}_bucket{{{lab}}} {cum}")
+        suffix = f"{{{_label_str(labels)}}}" if labels else ""
+        lines.append(f"{name}_sum{suffix} {value.sum}")
+        lines.append(f"{name}_count{suffix} {value.count}")
+    elif labels:
+        lines.append(f"{name}{{{_label_str(labels)}}} {value}")
+    else:
+        lines.append(f"{name} {value}")
 
 
 def prom_text(metrics: List[Tuple[str, str, str, Value]]) -> str:
     """Render [(name, type, help, value)] to exposition text.
 
-    ``value`` is either a scalar or a list of (labels, scalar) pairs:
+    ``value`` is a scalar, a HistogramValue, or a list of
+    (labels, scalar-or-HistogramValue) pairs:
         ("kfx_resources", "gauge", "Stored resources by kind.",
          [({"kind": "JAXJob"}, 3)])
     """
@@ -38,9 +100,162 @@ def prom_text(metrics: List[Tuple[str, str, str, Value]]) -> str:
         lines.append(f"# TYPE {name} {mtype}")
         if isinstance(value, list):
             for labels, v in value:
-                lab = ",".join(f'{k}="{_esc_label(v_)}"'
-                               for k, v_ in labels.items())
-                lines.append(f"{name}{{{lab}}} {v}")
+                _render_sample(lines, name, labels, v)
         else:
-            lines.append(f"{name} {value}")
+            _render_sample(lines, name, {}, value)
     return "\n".join(lines) + "\n"
+
+
+# -- parsing / validation ---------------------------------------------------
+
+def _parse_labels(text: str, pos: int) -> Tuple[Dict[str, str], int]:
+    """Parse ``{k="v",...}`` starting at the ``{``. Returns (labels,
+    position after the ``}``). Raises ValueError on malformation."""
+    labels: Dict[str, str] = {}
+    pos += 1  # past '{'
+    while True:
+        while pos < len(text) and text[pos] in " \t":
+            pos += 1
+        if pos < len(text) and text[pos] == "}":
+            return labels, pos + 1
+        m = _LABEL_NAME_RE.match(text, pos)
+        if m is None:
+            raise ValueError(f"bad label name at column {pos}")
+        lname = m.group(0)
+        pos = m.end()
+        if text[pos:pos + 2] != '="':
+            raise ValueError(f"expected '=\"' after label {lname!r}")
+        pos += 2
+        out: List[str] = []
+        while True:
+            if pos >= len(text):
+                raise ValueError(f"unterminated value for label {lname!r}")
+            ch = text[pos]
+            if ch == "\\":
+                esc = text[pos + 1:pos + 2]
+                if esc == "\\":
+                    out.append("\\")
+                elif esc == '"':
+                    out.append('"')
+                elif esc == "n":
+                    out.append("\n")
+                else:
+                    raise ValueError(
+                        f"invalid escape '\\{esc}' in label {lname!r}")
+                pos += 2
+            elif ch == '"':
+                pos += 1
+                break
+            elif ch == "\n":
+                raise ValueError(f"raw newline in label {lname!r}")
+            else:
+                out.append(ch)
+                pos += 1
+        labels[lname] = "".join(out)
+        # Labels must be ','-separated or the set closed — a missing
+        # comma (k="a"b="c") is exactly the malformation a real
+        # Prometheus scrape rejects, so the validator must too.
+        if pos >= len(text):
+            raise ValueError("unterminated label set")
+        if text[pos] == ",":
+            pos += 1
+        elif text[pos] != "}":
+            raise ValueError(
+                f"expected ',' or '}}' after label {lname!r}")
+
+
+def parse_sample_line(line: str) -> Tuple[str, Dict[str, str], float]:
+    """Parse one ``name{labels} value [timestamp]`` sample line.
+    Raises ValueError with a reason on any malformation."""
+    m = _NAME_RE.match(line)
+    if m is None:
+        raise ValueError("sample line must start with a metric name")
+    name = m.group(0)
+    pos = m.end()
+    # Only a label set or whitespace may follow the name — 'kfx_foo.5'
+    # must not silently parse as name 'kfx_foo' value 0.5 (a real
+    # Prometheus scrape rejects it).
+    if pos < len(line) and line[pos] not in " \t{":
+        raise ValueError(
+            f"unexpected character {line[pos]!r} after metric name "
+            f"{name!r}")
+    labels: Dict[str, str] = {}
+    if pos < len(line) and line[pos] == "{":
+        labels, pos = _parse_labels(line, pos)
+    rest = line[pos:].strip()
+    if not rest:
+        raise ValueError(f"metric {name!r} has no value")
+    parts = rest.split()
+    if len(parts) > 2:
+        raise ValueError(f"metric {name!r}: trailing garbage {rest!r}")
+    try:
+        value = float(parts[0])
+    except ValueError:
+        raise ValueError(
+            f"metric {name!r}: bad value {parts[0]!r}") from None
+    if len(parts) == 2:
+        try:
+            int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"metric {name!r}: bad timestamp {parts[1]!r}") from None
+    return name, labels, value
+
+
+def _check_comment(line: str) -> Optional[str]:
+    """Validate a ``#`` line; returns an error string or None."""
+    parts = line.split(None, 3)
+    if len(parts) >= 2 and parts[1] == "TYPE":
+        if len(parts) < 4:
+            return "TYPE line needs a metric name and a type"
+        if _NAME_RE.fullmatch(parts[2]) is None:
+            return f"TYPE line has a bad metric name {parts[2]!r}"
+        if parts[3].split()[0] not in _TYPES:
+            return f"unknown metric type {parts[3]!r}"
+    elif len(parts) >= 2 and parts[1] == "HELP":
+        if len(parts) < 3:
+            return "HELP line needs a metric name"
+        if _NAME_RE.fullmatch(parts[2]) is None:
+            return f"HELP line has a bad metric name {parts[2]!r}"
+    return None  # other comments are allowed
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Per-line format errors for an exposition document (empty list =
+    valid). This is the scrape-validation contract: anything flagged
+    here would also break a real Prometheus scrape."""
+    errors: List[str] = []
+    for n, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            err = _check_comment(line)
+            if err:
+                errors.append(f"line {n}: {err}")
+            continue
+        try:
+            parse_sample_line(line)
+        except ValueError as e:
+            errors.append(f"line {n}: {e}")
+    return errors
+
+
+def parse_prom_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Parse exposition text into {name: [(labels, value)]}. Raises
+    ValueError (with line number) on the first malformed line — the
+    strict round-trip used by the obs tests."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for n, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            err = _check_comment(line)
+            if err:
+                raise ValueError(f"line {n}: {err}")
+            continue
+        try:
+            name, labels, value = parse_sample_line(line)
+        except ValueError as e:
+            raise ValueError(f"line {n}: {e}") from None
+        out.setdefault(name, []).append((labels, value))
+    return out

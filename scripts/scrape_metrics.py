@@ -1,0 +1,88 @@
+"""Scrape-validate /metrics endpoints: fetch each URL and fail on any
+malformed exposition line (bad metric name, unescaped label, garbage
+value). CI runs the same validator in-process (tests/test_obs.py), so a
+format regression in any metric producer is caught in tier-1 before a
+real Prometheus scrape would drop the whole endpoint.
+
+Usage:
+    python scripts/scrape_metrics.py [URL ...]
+
+With no URLs, the control plane advertised by the current kfx home's
+server marker (``kfx server``) is scraped. A URL without a path gets
+``/metrics`` appended.
+"""
+
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.utils.prom import validate_exposition  # noqa: E402
+
+
+def normalize(url: str) -> str:
+    if "//" not in url:
+        url = f"http://{url}"
+    from urllib.parse import urlsplit
+
+    if not urlsplit(url).path.strip("/"):
+        url = url.rstrip("/") + "/metrics"
+    return url
+
+
+def scrape(url: str, timeout: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        if not ctype.startswith("text/plain"):
+            raise ValueError(f"unexpected Content-Type {ctype!r}")
+        return r.read().decode()
+
+
+def check_endpoint(url: str) -> int:
+    """Scrape + validate one endpoint; prints a verdict line and any
+    per-line errors. Returns the number of problems found."""
+    url = normalize(url)
+    try:
+        text = scrape(url)
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        print(f"FAIL {url}: unreachable or wrong type: {e}")
+        return 1
+    errors = validate_exposition(text)
+    samples = sum(1 for ln in text.splitlines()
+                  if ln.strip() and not ln.startswith("#"))
+    if errors:
+        print(f"FAIL {url}: {len(errors)} malformed line(s), "
+              f"{samples} sample(s)")
+        for err in errors:
+            print(f"  {err}")
+        return len(errors)
+    print(f"ok   {url}: {samples} sample(s)")
+    return 0
+
+
+def default_urls() -> list:
+    """The apiserver advertised by this home's server marker, if any."""
+    from kubeflow_tpu.apiserver import live_server_url
+    from kubeflow_tpu.controlplane import resolve_home
+
+    url = live_server_url(resolve_home(None))
+    return [url] if url else []
+
+
+def main(argv=None) -> int:
+    urls = list(argv if argv is not None else sys.argv[1:])
+    if not urls:
+        urls = default_urls()
+        if not urls:
+            print("no URLs given and no live `kfx server` marker found "
+                  "in the kfx home; pass endpoint URLs explicitly",
+                  file=sys.stderr)
+            return 2
+    failures = sum(check_endpoint(u) for u in urls)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,329 @@
+"""Observability subsystem tests: the metrics registry (concurrency,
+label escaping round-trip, histogram exposition), trace-ID propagation
+apiserver -> store -> gang env -> events, and scrape validation of the
+live /metrics endpoints (the scripts/scrape_metrics.py contract)."""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api.base import from_manifest
+from kubeflow_tpu.controlplane import ControlPlane
+from kubeflow_tpu.obs import (
+    TRACE_ANNOTATION,
+    MetricsRegistry,
+    current_trace_id,
+    set_trace_id,
+    span,
+)
+from kubeflow_tpu.utils.prom import (
+    parse_prom_text,
+    prom_text,
+    validate_exposition,
+)
+
+PY = sys.executable
+
+
+class TestRegistry:
+    def test_concurrent_increments(self):
+        reg = MetricsRegistry()
+        c = reg.counter("hits_total", "h")
+        g = reg.gauge("depth", "d")
+        h = reg.histogram("lat_seconds", "l", buckets=[0.1, 1.0])
+
+        def work():
+            for _ in range(1000):
+                c.inc(1, worker="w")
+                g.inc(1)
+                h.observe(0.05)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(worker="w") == 8000
+        assert g.value() == 8000
+        assert h.count() == 8000
+
+    def test_get_or_create_and_type_conflict(self):
+        reg = MetricsRegistry()
+        assert reg.counter("a_total") is reg.counter("a_total")
+        with pytest.raises(TypeError):
+            reg.gauge("a_total")
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().counter("a_total").inc(-1)
+
+    def test_label_escaping_roundtrip(self):
+        reg = MetricsRegistry()
+        nasty = 'we"ird\nva\\lue'
+        reg.gauge("kfx_g", "gauge with a hostile label").set(3, model=nasty)
+        text = reg.render()
+        assert validate_exposition(text) == []
+        parsed = parse_prom_text(text)
+        [(labels, value)] = parsed["kfx_g"]
+        assert labels == {"model": nasty}
+        assert value == 3
+
+    def test_histogram_exposition(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency", buckets=[0.01, 0.1, 1.0])
+        for v in (0.005, 0.05, 0.5, 0.5):
+            h.observe(v, model="m")
+        text = reg.render()
+        assert validate_exposition(text) == []
+        parsed = parse_prom_text(text)
+        buckets = {lab["le"]: v for lab, v in parsed["lat_seconds_bucket"]}
+        assert buckets == {"0.01": 1, "0.1": 2, "1": 4, "+Inf": 4}
+        assert parsed["lat_seconds_count"][0][1] == 4
+        assert abs(parsed["lat_seconds_sum"][0][1] - 1.055) < 1e-9
+
+    def test_histogram_percentile_interpolation(self):
+        h = MetricsRegistry().histogram("h", buckets=[1.0, 2.0, 4.0])
+        for v in (0.5, 1.5, 1.5, 3.0):
+            h.observe(v)
+        p50 = h.percentile(0.5)
+        assert 1.0 <= p50 <= 2.0
+        # +Inf landings clamp to the last finite bound.
+        h.observe(100.0, n=10)
+        assert h.percentile(0.99) == 4.0
+
+    def test_bulk_observe(self):
+        h = MetricsRegistry().histogram("h", buckets=[1.0])
+        h.observe(0.5, n=16)
+        assert h.count() == 16
+
+    def test_collector_runs_at_render(self):
+        reg = MetricsRegistry()
+        reg.add_collector(lambda r: r.gauge("live").set(7))
+        assert "live 7" in reg.render()
+        assert reg.snapshot()["live"]["samples"][0]["value"] == 7
+
+
+class TestExpositionValidation:
+    def test_flags_malformed_lines(self):
+        bad = ('# TYPE ok gauge\nok 1\n'
+               '1bad_name 2\n'
+               'noval\n'
+               'badval{x="y"} abc\n'
+               'nocomma{a="1"b="2"} 3\n'
+               'kfx_foo.5\n'
+               '# TYPE z wrongtype\n')
+        errors = validate_exposition(bad)
+        assert len(errors) == 6
+
+    def test_prom_text_histogram_value(self):
+        from kubeflow_tpu.utils.prom import HistogramValue
+
+        text = prom_text([
+            ("lat", "histogram", "h",
+             [({"m": "x"}, HistogramValue(
+                 [(0.1, 1), (math.inf, 2)], 0.6, 2))])])
+        assert 'lat_bucket{m="x",le="0.1"} 1' in text
+        assert 'lat_bucket{m="x",le="+Inf"} 2' in text
+        assert 'lat_sum{m="x"} 0.6' in text
+        assert 'lat_count{m="x"} 2' in text
+        assert validate_exposition(text) == []
+
+
+class TestTraceHelpers:
+    def test_thread_local_scope(self):
+        set_trace_id("")
+        assert current_trace_id() == ""
+        with span("unit", trace_id="abc123") as sp:
+            assert current_trace_id() == "abc123"
+        assert current_trace_id() == ""
+        assert sp.elapsed >= 0
+
+    def test_span_observes_histogram(self):
+        h = MetricsRegistry().histogram("span_seconds")
+        with span("unit", trace_id="t", histogram=h, phase="x"):
+            pass
+        assert h.count(phase="x") == 1
+
+
+def _env_echo_job(name):
+    return from_manifest({
+        "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"jaxReplicaSpecs": {"Worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{
+                "name": "main",
+                "command": [PY, "-c",
+                            "import os;"
+                            "print('trace_env='"
+                            "+os.environ.get('KFX_TRACE_ID','missing'))"],
+            }]}}}}}})
+
+
+class TestTracePropagation:
+    def test_apply_to_runner_env_and_events(self, tmp_path):
+        """A trace ID minted at admission must land in the stored
+        resource's metadata, in the gang member's environment (runner
+        log), and on at least one recorded event."""
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply([_env_echo_job("trace-job")])
+            job = cp.store.get("JAXJob", "trace-job")
+            trace = job.metadata.annotations.get(TRACE_ANNOTATION)
+            assert trace, "admission did not mint a trace ID"
+
+            cp.wait_for_job("JAXJob", "trace-job", timeout=90)
+            log = cp.job_logs("JAXJob", "trace-job")
+            assert f"trace_env={trace}" in log
+            assert f"trace={trace}" in log  # gang attempt header
+
+            events = cp.store.events_for("JAXJob", "default/trace-job")
+            assert any(e.trace_id == trace for e in events)
+
+            # Re-applying the unchanged manifest keeps the original ID
+            # (and the "unchanged" verb — no resourceVersion churn).
+            [(obj, verb)] = cp.apply([_env_echo_job("trace-job")])
+            assert verb == "unchanged"
+            assert obj.metadata.annotations[TRACE_ANNOTATION] == trace
+            cp.store.delete("JAXJob", "trace-job")
+
+    def test_kfx_top_and_events_show_telemetry(self, tmp_path, capsys):
+        from kubeflow_tpu.cli import KfxCLI
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            cp.apply([_env_echo_job("top-job")])
+            cp.wait_for_job("JAXJob", "top-job", timeout=90)
+            # Negative offset = tail (what top uses for huge logs).
+            text, off = cp.job_logs_from(
+                "JAXJob", "top-job", "default", "", -100)
+            full = cp.job_logs("JAXJob", "top-job")
+            assert text == full[-len(text):] and len(text) <= 100
+            assert off == len(full.encode())
+            cli = KfxCLI(cp)
+            assert cli.top() == 0
+            out = capsys.readouterr().out
+            assert "top-job" in out and "JAXJob" in out
+            assert cli.events("JAXJob", "top-job", "default") == 0
+            out = capsys.readouterr().out
+            trace = cp.store.get(
+                "JAXJob", "top-job").metadata.annotations[TRACE_ANNOTATION]
+            assert f"[trace={trace}]" in out
+            cp.store.delete("JAXJob", "top-job")
+
+
+class TestApiServerMetrics:
+    @pytest.fixture()
+    def server(self, tmp_path):
+        from kubeflow_tpu.apiserver import ApiServer
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            with ApiServer(cp, port=0) as srv:
+                yield srv
+
+    def test_scrape_validates_and_reconcile_histograms(self, server):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        import scrape_metrics
+
+        # Drive at least one reconcile so the histogram exists.
+        server.cp.apply([_env_echo_job("scrape-job")])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = server.cp.metrics.snapshot()
+            if snap.get("kfx_reconcile_duration_seconds",
+                        {}).get("samples"):
+                break
+            time.sleep(0.1)
+
+        assert scrape_metrics.main([f"{server.url}/metrics"]) == 0
+
+        with urllib.request.urlopen(f"{server.url}/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert validate_exposition(text) == []
+        assert "kfx_reconcile_duration_seconds_bucket" in text
+        assert 'kind="JAXJob"' in text
+        assert "kfx_workqueue_adds_total" in text
+
+        with urllib.request.urlopen(f"{server.url}/metrics?format=json",
+                                    timeout=10) as r:
+            m = json.loads(r.read().decode())
+        assert m["resources"].get("JAXJob") == 1
+        assert set(m["controllers"]["JAXJob"]) == {
+            "depth", "delayed", "processing", "retrying"}
+        rec = m["reconcile"].get("JAXJob")
+        assert rec and rec["count"] >= 1 and rec["p50_ms"] is not None
+        server.cp.store.delete("JAXJob", "scrape-job")
+
+    def test_trace_header_adopted(self, server):
+        body = ("apiVersion: kubeflow.org/v1\nkind: Profile\n"
+                "metadata:\n  name: tr-prof\n"
+                "spec:\n  owner:\n    name: alice\n").encode()
+        req = urllib.request.Request(f"{server.url}/apis", data=body,
+                                     method="POST")
+        req.add_header("X-Kfx-Trace-Id", "deadbeef00000001")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read().decode())
+        assert out["applied"][0]["traceId"] == "deadbeef00000001"
+        prof = server.cp.store.get("Profile", "tr-prof")
+        assert prof.metadata.annotations[TRACE_ANNOTATION] == \
+            "deadbeef00000001"
+
+
+class TestModelServerMetrics:
+    def test_latency_histogram_from_requests(self):
+        import numpy as np
+
+        from kubeflow_tpu.serving.server import ModelServer, Predictor
+
+        class Echo(Predictor):
+            name = "echo"
+            ready = True
+
+            def load(self):
+                pass
+
+            def predict(self, instances, probabilities=False):
+                return {"predictions": [0] * instances.shape[0]}
+
+        server = ModelServer(port=0)
+        server.register(Echo())
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            payload = json.dumps({"instances": [[1.0]]}).encode()
+            for _ in range(5):
+                req = urllib.request.Request(
+                    f"{base}/v1/models/echo:predict", data=payload)
+                req.add_header("X-Kfx-Trace-Id", "feedface00000001")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 200
+                    assert r.headers["X-Kfx-Trace-Id"] == \
+                        "feedface00000001"
+            with urllib.request.urlopen(f"{base}/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            assert validate_exposition(text) == []
+            assert "kfx_serving_request_seconds_bucket" in text
+            assert 'model="echo"' in text
+            parsed = parse_prom_text(text)
+            counts = [v for lab, v in
+                      parsed["kfx_serving_request_seconds_count"]
+                      if lab.get("model") == "echo"]
+            assert counts and counts[0] == 5
+            with urllib.request.urlopen(f"{base}/metrics?format=json",
+                                        timeout=10) as r:
+                m = json.loads(r.read().decode())
+            assert m["request_count"] == 5
+            assert m["latency_ms"]["echo"]["p50"] is not None
+        finally:
+            server.stop()
