@@ -318,8 +318,9 @@ def main() -> int:
         lm.update(_bench_lm(remat_policy="save_dense"))
     if have_time(300, "lm_long"):
         # Long-context config: S=2048 rides the pallas flash-attention
-        # kernel (attn_impl="auto" switches at S>=2048; measured 1.24x
-        # over the XLA dense path at this shape on the v5e).
+        # kernel (attn_impl="auto" switches at S>=1024 since round 5;
+        # measured 1.24x over the XLA dense path at this shape on the
+        # v5e).
         # save_flash_full remat (round 5): the kernel's (o, lse)
         # residuals are checkpoint-named and saved — with q/k/v/out/wo —
         # so the remat backward runs only the flash backward kernels,
@@ -878,9 +879,11 @@ def _bench_serving_load(predictor, connect, one, *, clients: int,
             "serving_batcher_max_batch": max_batch,
             # Device the top bucket (where aggregated batches land) runs
             # on — the amortization claim is only made if this says
-            # accelerator.
+            # accelerator. "unknown" when the bucket is absent from the
+            # placement map (non-bucketed predictor): silently claiming
+            # "accelerator" would fabricate the headline evidence.
             "serving_batched_placement": predictor.placement.get(
-                max_batch, "accelerator"),
+                max_batch, "unknown"),
         }
         if stragglers:
             # The wall then includes the join timeout: flag it so the

@@ -69,7 +69,7 @@ from .api.base import (
 )
 from .api.manifest import load_manifests
 from .controlplane import ControlPlane
-from .core.store import AlreadyExists, Conflict, NotFound
+from .core.store import AlreadyExists, Conflict, NotFound, StoreFault
 
 
 # Caller identity header — the kubeflow-userid analogue. The reference
@@ -286,6 +286,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code: int, msg: str) -> None:
         self._json(code, {"error": msg})
 
+    def _unavailable(self, e: Exception) -> None:
+        """A transient storage failure is the 503 contract (etcd
+        unavailable), never a 500 stack trace: the client's correct
+        move is to retry after a beat, so say exactly that."""
+        self._send(503, json.dumps(
+            {"error": f"storage temporarily unavailable: {e}"}).encode(),
+            "application/json", {"Retry-After": "1"})
+
     # -- verbs --------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (stdlib naming)
         url = urlparse(self.path)
@@ -329,6 +337,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(404, f"no route {url.path}")
         except (NotFound, KeyError) as e:
             return self._error(404, str(e.args[0] if e.args else e))
+        except StoreFault as e:
+            return self._unavailable(e)
         except Exception as e:  # never abort the connection mid-response
             return self._error(500, f"{type(e).__name__}: {e}")
 
@@ -459,6 +469,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(403, str(e))
         except NotFound as e:
             return self._error(404, str(e))
+        except StoreFault as e:
+            return self._unavailable(e)
         except (ValidationError, Conflict, AlreadyExists,
                 KeyError, ValueError) as e:
             return self._error(400, str(e))
@@ -488,6 +500,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(403, str(e))
         except (NotFound, KeyError) as e:
             return self._error(404, str(e.args[0] if e.args else e))
+        except StoreFault as e:
+            return self._unavailable(e)
         except Exception as e:
             return self._error(500, f"{type(e).__name__}: {e}")
         return self._json(200, {"deleted": f"{parts[1]}/{parts[3]}"})

@@ -85,6 +85,15 @@ class ControlPlane:
         # /metrics formats render from this single snapshot path.
         self.metrics = MetricsRegistry()
         self.metrics.add_collector(self._collect_platform_metrics)
+        # Chaos observability: injections export on this plane's
+        # /metrics (kfx_chaos_injected_total) and land in the event log
+        # stamped with the active trace ID, so a chaos run reads like
+        # any other job in `kfx events`.
+        from . import chaos
+
+        self.metrics.add_collector(chaos.collect)
+        self._chaos_listener = self._record_chaos_event
+        chaos.add_listener(self._chaos_listener)
         self._register_controllers(worker_platform)
         for ctrl in self.manager.controllers.values():
             ctrl.metrics = self.metrics
@@ -143,6 +152,9 @@ class ControlPlane:
         return self
 
     def stop(self) -> None:
+        from . import chaos
+
+        chaos.remove_listener(self._chaos_listener)
         if self._started:
             self.manager.stop()
             self._started = False
@@ -166,6 +178,15 @@ class ControlPlane:
         self.stop()
 
     # -- observability -------------------------------------------------------
+    def _record_chaos_event(self, point: str, rule, trace_id: str) -> None:
+        """Chaos-injection listener: every injection in this process
+        becomes a store event (kind=Chaos, key=<point>) carrying the
+        trace ID active at injection time."""
+        self.store.record_raw_event(
+            "Chaos", point, "Warning", "ChaosInjected",
+            f"fault injected at {point} (mode={rule.mode or 'error'})",
+            trace_id=trace_id)
+
     def _collect_platform_metrics(self, reg: MetricsRegistry) -> None:
         """Pull-time collector: project live platform state into the
         registry (SURVEY.md §5.5 Prometheus-metrics role) — per-kind

@@ -86,8 +86,16 @@ class Controller:
             return False
         # Scope the submission's trace ID onto this worker thread for
         # the duration of the reconcile, so any event recorded inside
-        # (even against a child object) carries it.
-        obs_trace.set_trace_id(obs_trace.trace_of(self.get_resource(key)))
+        # (even against a child object) carries it. The lookup is a
+        # store read — a failure there (chaos store.read, a future
+        # remote store) is the reconcile's problem to retry, never the
+        # worker thread's death: it must not escape before the
+        # try-block below, or the key would be stranded in _processing
+        # forever with no worker left to drain the queue.
+        try:
+            obs_trace.set_trace_id(obs_trace.trace_of(self.get_resource(key)))
+        except Exception:
+            obs_trace.set_trace_id("")
         t0 = time.monotonic()
         outcome = "ok"
         try:
@@ -128,8 +136,16 @@ class Controller:
         ).inc(1, kind=self.KIND, result=outcome)
 
     def run(self, stop: threading.Event) -> None:
+        # Belt-and-braces: no exception may kill a worker thread — a
+        # dead worker silently stops reconciliation for its kind for
+        # the life of the process (controller-runtime recovers panics
+        # for the same reason).
         while not stop.is_set():
-            self._process_one()
+            try:
+                self._process_one()
+            except Exception:
+                log.error("worker loop %s failed:\n%s", self.KIND,
+                          traceback.format_exc())
 
 
 class Manager:
@@ -202,7 +218,16 @@ class Manager:
                     continue
                 if now - last.get(ctrl.KIND, 0.0) >= period:
                     last[ctrl.KIND] = now
-                    for obj in self.store.list(ctrl.KIND):
+                    try:
+                        objs = self.store.list(ctrl.KIND)
+                    except Exception:
+                        # A transient store failure (chaos store.read)
+                        # must cost one resync tick, not the resync
+                        # thread for the life of the process.
+                        log.error("resync list %s failed:\n%s", ctrl.KIND,
+                                  traceback.format_exc())
+                        continue
+                    for obj in objs:
                         ctrl.queue.add(obj.key)
 
     # -- lifecycle ---------------------------------------------------------

@@ -20,6 +20,7 @@ import threading
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..api.base import ObjectMeta, Resource, from_manifest, new_uid, utcnow
+from .. import chaos
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -28,6 +29,13 @@ DELETED = "DELETED"
 
 class Conflict(Exception):
     """Stale resourceVersion on update (the 409 equivalent)."""
+
+
+class StoreFault(Exception):
+    """Transient storage-layer failure (the etcd-unavailable / 503
+    equivalent). Raised by the chaos fault points ``store.read`` /
+    ``store.write``; callers treat it as retryable — controllers via
+    rate-limited requeue, the apiserver as 503 + Retry-After."""
 
 
 class NotFound(KeyError):
@@ -146,6 +154,8 @@ class ResourceStore:
     # -- CRUD --------------------------------------------------------------
     def create(self, obj: Resource) -> Resource:
         obj.validate()
+        chaos.fail_or_delay("store.write", StoreFault,
+                            f"create {obj.KIND} {obj.key}", target=obj.KIND)
         with self._lock:
             k = self._key(obj)
             if k in self._objects:
@@ -163,6 +173,8 @@ class ResourceStore:
             return stored.deepcopy()
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        chaos.fail_or_delay("store.read", StoreFault,
+                            f"get {kind} {namespace}/{name}", target=kind)
         with self._lock:
             try:
                 return self._objects[(kind, namespace, name)].deepcopy()
@@ -179,6 +191,8 @@ class ResourceStore:
     def update(self, obj: Resource, subresource: str = "") -> Resource:
         """Full update with optimistic concurrency. ``subresource='status'``
         keeps the stored spec (mirroring the /status subresource split)."""
+        chaos.fail_or_delay("store.write", StoreFault,
+                            f"update {obj.KIND} {obj.key}", target=obj.KIND)
         with self._lock:
             k = self._key(obj)
             if k not in self._objects:
@@ -227,6 +241,8 @@ class ResourceStore:
             return self.update(merged), "configured"
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        chaos.fail_or_delay("store.write", StoreFault,
+                            f"delete {kind} {namespace}/{name}", target=kind)
         with self._lock:
             k = (kind, namespace, name)
             if k not in self._objects:
@@ -239,6 +255,8 @@ class ResourceStore:
 
     def list(self, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[Resource]:
+        chaos.fail_or_delay("store.read", StoreFault,
+                            f"list {kind}", target=kind)
         with self._lock:
             out = []
             for (k, ns, _), obj in sorted(self._objects.items()):
@@ -285,8 +303,15 @@ class ResourceStore:
 
     def record_event(self, obj: Resource, etype: str, reason: str,
                      message: str, trace_id: str = "") -> None:
-        ev = Event(obj.KIND, obj.key, etype, reason, message,
-                   trace_id=trace_id)
+        self.record_raw_event(obj.KIND, obj.key, etype, reason, message,
+                              trace_id=trace_id)
+
+    def record_raw_event(self, kind: str, key: str, etype: str, reason: str,
+                         message: str, trace_id: str = "") -> None:
+        """Record an event not tied to a live Resource object — the
+        chaos layer's injections land here (kind="Chaos", key=point) so
+        `kfx events` reads a chaos run like any other job."""
+        ev = Event(kind, key, etype, reason, message, trace_id=trace_id)
         with self._lock:
             self._events.append(ev)
             self._events_total += 1

@@ -12,6 +12,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import chaos
+
 
 class RateLimitingQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 16.0):
@@ -59,6 +61,15 @@ class RateLimitingQueue:
                 self._queue.append(key)
                 self._queued.add(key)
                 self._cond.notify()
+        # Fault point: a requeue storm — the same key scheduled again
+        # (and again, per the rule's count) through the delayed heap.
+        # De-dup + per-key backoff must absorb it; the delayed insert
+        # path bypasses add(), so a storm never feeds itself.
+        rule = chaos.draw("workqueue.requeue", target=key)
+        if rule is not None:
+            self.add_after(key, rule.delay or 0.001)
+            with self._cond:
+                self._requeues_total += 1
 
     def add_after(self, key: str, delay: float) -> None:
         if delay <= 0:

@@ -58,6 +58,9 @@ def parse_args(argv=None):
 
 def initialize_distributed() -> int:
     """Rendezvous via env. Returns process_id. Must run pre-backend-init."""
+    from kubeflow_tpu.runtime.rendezvous import apply_startup_chaos
+
+    apply_startup_chaos()
     num = int(os.environ.get("KFX_NUM_PROCESSES", "1"))
     if num <= 1:
         return 0
@@ -74,9 +77,19 @@ def initialize_distributed() -> int:
 
 def enable_compile_cache() -> None:
     """Persistent XLA compilation cache: repeat jobs (HPO trials, restarts,
-    benches) skip the 10-40s compile entirely."""
+    benches) skip the 10-40s compile entirely.
+
+    Accelerator backends only. On XLA:CPU a cache HIT of the
+    donated-buffer train step corrupts the heap (malloc_consolidate
+    aborts / segfaults — reproducibly: fresh compile runs fine, the
+    next process deserializing that entry dies), which turned every
+    checkpoint-resume into a crash loop under the chaos soak. CPU
+    compiles are ~1s here, so the cache bought nothing where it was
+    unsafe."""
     import jax
 
+    if jax.default_backend() == "cpu":
+        return
     cache_dir = os.environ.get("KFX_JAX_CACHE") or os.path.join(
         os.path.expanduser("~"), ".kfx", "jax_cache")
     try:
@@ -266,8 +279,20 @@ def main(argv=None) -> int:
                 f"step_time={dt:.4f} examples_per_sec={eps:.1f}")
             t_last = now
             last_log_step = step
-        if ckpt is not None:
-            ckpt.maybe_save(step, state)
+        if ckpt is not None and ckpt.maybe_save(step, state):
+            # Fault point: worker crash at a checkpoint boundary — the
+            # deterministic injected-kill (chaos plans schedule it by
+            # save ordinal via after/count, so a restart-resume-restart
+            # sequence replays exactly). Same durability contract as
+            # --fail-at-step: the save must be committed before dying,
+            # or resume would nondeterministically lose it.
+            from kubeflow_tpu import chaos
+
+            if chaos.draw("runner.crash", target=f"step-{step}") is not None:
+                ckpt.wait()
+                log(f"chaos_crash step={step}")
+                sys.stdout.flush()
+                os._exit(137)
 
     # Final eval on a fixed set (sharded across processes).
     eval_ds = get_dataset(args.dataset, split="eval", seed=args.seed)

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from .. import chaos
 from ..api import training as T
 from . import lifetime
 
@@ -212,6 +213,11 @@ class Gang:
         preexec = lifetime.make_child_preexec(os.getpid())
         try:
             for spec in self.specs:
+                # Fault point: member spawn failure — must take the
+                # all-or-nothing teardown path below, never half-start.
+                chaos.fail_or_delay("gang.spawn", OSError,
+                                    f"spawn {self.name}/{spec.id}",
+                                    target=spec.id)
                 env = dict(os.environ)
                 env.update(spec.env)
                 env.update(overrides.get("*", {}))
@@ -301,7 +307,24 @@ class Gang:
     def _watch_attempt(self) -> str:
         """Poll member processes until a terminal decision for this attempt."""
         chief_id = f"{self.chief_replica_type.lower()}-0"
+        # Fault point: the supervisor SIGKILLs one member mid-attempt
+        # (the `kfx kill-replica` scenario, injected). The rule's delay
+        # (default 0.25s) lets the member actually start before it
+        # dies; the draw — and with it the injection count, budget and
+        # event — happens only at kill time with a live victim in hand,
+        # so kfx_chaos_injected_total never claims a kill that a fast
+        # attempt outran. `match` scopes by gang name.
+        plan = chaos.active_plan()
+        peek = plan.rules.get("gang.kill") if plan is not None else None
+        kill_at = (time.time() + (peek.delay or 0.25)
+                   if peek is not None else None)
         while True:
+            if kill_at is not None and time.time() >= kill_at:
+                kill_at = None
+                victim = self._chaos_victim(chief_id)
+                if victim is not None and \
+                        chaos.draw("gang.kill", target=self.name) is not None:
+                    self.kill_replica(victim)
             if self._stop.is_set():
                 self._kill_all()
                 self._set_phase(KILLED, "GangDeleted", "gang deleted")
@@ -354,6 +377,16 @@ class Gang:
                                 "all replicas exited 0")
                 return SUCCEEDED
             time.sleep(0.05)
+
+    def _chaos_victim(self, chief_id: str) -> Optional[str]:
+        """Deterministic kill target: the first running non-chief
+        member (sorted), else the chief — a one-member gang still gets
+        its kill."""
+        with self._lock:
+            running = sorted(
+                rid for rid, p in self._procs.items() if p.poll() is None)
+        non_chief = [rid for rid in running if rid != chief_id]
+        return (non_chief or running or [None])[0]
 
     def _should_retry(self, exit_code: int) -> bool:
         if self.restart_policy == T.RESTART_NEVER:

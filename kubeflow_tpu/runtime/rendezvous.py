@@ -14,6 +14,7 @@ TF_CONFIG/hostfile is correct").
 from __future__ import annotations
 
 import json
+import os
 from typing import Dict, List, Tuple
 
 # Env names for the JAX-native rendezvous. The runner passes these straight
@@ -27,6 +28,20 @@ ENV_JOB_NAME = "KFX_JOB_NAME"
 ENV_JOB_NAMESPACE = "KFX_JOB_NAMESPACE"
 ENV_WORKDIR = "KFX_WORKDIR"
 ENV_CHECKPOINT_DIR = "KFX_CHECKPOINT_DIR"
+
+
+def apply_startup_chaos() -> float:
+    """Fault point ``rendezvous.delay``: a straggling worker. Runners
+    call this before ``jax.distributed.initialize`` (workers inherit
+    KFX_CHAOS through the gang env), so an injected delay exercises the
+    coordinator's tolerance for late joiners — the barrier must wait,
+    not split-brain. Returns the seconds slept."""
+    from .. import chaos
+
+    rtype = os.environ.get(ENV_REPLICA_TYPE, "")
+    index = os.environ.get(ENV_REPLICA_INDEX, "")
+    return chaos.maybe_delay("rendezvous.delay",
+                             target=f"{rtype.lower()}-{index}")
 
 
 def flatten_replicas(replica_counts: List[Tuple[str, int]]) -> List[Tuple[str, int, int]]:

@@ -19,6 +19,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import chaos
+
 # RFC 7230 §6.1: connection-scoped headers a proxy must not forward.
 _HOP_BY_HOP = frozenset({
     "connection", "keep-alive", "proxy-authenticate",
@@ -27,12 +29,26 @@ _HOP_BY_HOP = frozenset({
 
 
 class BackendSet:
-    """Round-robin over the live replica endpoints of one revision."""
+    """Round-robin over the live replica endpoints of one revision,
+    with passive health: an endpoint that fails ``EJECT_AFTER``
+    consecutive requests is ejected from rotation; after
+    ``PROBE_AFTER_S`` it goes half-open — exactly one live request
+    probes it, success readmits, failure re-ejects for another window.
+    With every endpoint ejected and none due, rotation degrades to the
+    full set (serving badly beats not serving)."""
+
+    EJECT_AFTER = 3
+    PROBE_AFTER_S = 2.0
 
     def __init__(self, endpoints: Optional[List[str]] = None):
         self._lock = threading.Lock()
         self._endpoints = list(endpoints or [])
         self._rr = itertools.count()
+        # Passive health: consecutive failures and ejection timestamps
+        # by endpoint (monotonic; an entry in _ejected means "out of
+        # rotation until its half-open probe").
+        self._fails: Dict[str, int] = {}
+        self._ejected: Dict[str, float] = {}
         # Stamped by the Router when this set serves a request; drives
         # per-revision scale-to-zero idle accounting.
         self.last_request_time: float = time.monotonic()
@@ -62,12 +78,55 @@ class BackendSet:
     def set_endpoints(self, endpoints: List[str]) -> None:
         with self._lock:
             self._endpoints = list(endpoints)
+            # Health state for endpoints that left the set must not
+            # linger — a re-added replica starts with a clean slate.
+            self._fails = {e: n for e, n in self._fails.items()
+                           if e in self._endpoints}
+            self._ejected = {e: t for e, t in self._ejected.items()
+                             if e in self._endpoints}
 
-    def pick(self) -> Optional[str]:
+    def pick(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
+        """Next endpoint, skipping ``exclude`` (the retry path's
+        already-failed backend) and ejected endpoints — except a due
+        half-open probe, which takes priority (one request buys the
+        readmission signal)."""
         with self._lock:
-            if not self._endpoints:
+            now = time.monotonic()
+            candidates = [e for e in self._endpoints if e not in exclude]
+            if not candidates:
                 return None
-            return self._endpoints[next(self._rr) % len(self._endpoints)]
+            for e in candidates:
+                ejected_at = self._ejected.get(e)
+                if ejected_at is not None and \
+                        now - ejected_at >= self.PROBE_AFTER_S:
+                    # Re-arm before releasing the probe: concurrent
+                    # picks must not all elect the same sick backend.
+                    self._ejected[e] = now
+                    return e
+            healthy = [e for e in candidates if e not in self._ejected]
+            if not healthy:
+                healthy = candidates  # total ejection: degrade, don't die
+            return healthy[next(self._rr) % len(healthy)]
+
+    def report_success(self, endpoint: str) -> None:
+        with self._lock:
+            self._fails.pop(endpoint, None)
+            self._ejected.pop(endpoint, None)
+
+    def report_failure(self, endpoint: str) -> None:
+        with self._lock:
+            if endpoint not in self._endpoints:
+                return
+            n = self._fails.get(endpoint, 0) + 1
+            self._fails[endpoint] = n
+            if n >= self.EJECT_AFTER or endpoint in self._ejected:
+                # A failed half-open probe re-ejects immediately; a
+                # fresh endpoint needs EJECT_AFTER consecutive misses.
+                self._ejected[endpoint] = time.monotonic()
+
+    def ejected_endpoints(self) -> List[str]:
+        with self._lock:
+            return sorted(self._ejected)
 
     def __len__(self) -> int:
         with self._lock:
@@ -166,15 +225,69 @@ class Router:
             return
         chosen.enter()
         try:
-            self._forward(h, backend, has_body)
+            self._forward(h, backend, chosen, has_body)
         finally:
             chosen.exit()
 
-    def _forward(self, h, backend: str, has_body: bool) -> None:
+    def _forward(self, h, backend: str, chosen: BackendSet,
+                 has_body: bool) -> None:
+        """Relay to ``backend``, reporting passive health to ``chosen``;
+        a connection failure or 5xx retries EXACTLY ONCE on a different
+        backend of the same set (predict traffic is idempotent — the
+        retry turns one sick replica into a latency blip, not an error
+        the client must handle)."""
         data = b""
         if has_body:
             length = int(h.headers.get("Content-Length", 0))
             data = h.rfile.read(length) if length else b""
+        attempt_backend = backend
+        last: Optional[Tuple[int, List[Tuple[str, str]], bytes]] = None
+        last_err: Optional[OSError] = None
+        for attempt in range(2):
+            try:
+                last = self._attempt(h, attempt_backend, data)
+                last_err = None
+            except OSError as e:
+                last, last_err = None, e
+            if last is not None and last[0] < 500:
+                chosen.report_success(attempt_backend)
+                break
+            chosen.report_failure(attempt_backend)
+            if attempt == 0:
+                alt = chosen.pick(exclude=(attempt_backend,))
+                if alt is not None and alt != attempt_backend:
+                    attempt_backend = alt
+                    continue
+            break
+        if last is not None:
+            status, headers, payload = last
+            h.send_response(status)
+            # send_response() already emitted Server/Date; don't duplicate.
+            skip = _HOP_BY_HOP | {"content-length", "server", "date"}
+            for k, v in headers:
+                if k.lower() not in skip:
+                    h.send_header(k, v)
+            h.send_header("Content-Length", str(len(payload)))
+            h.end_headers()
+            h.wfile.write(payload)
+            return
+        body = json.dumps(
+            {"error": f"backend {attempt_backend}: {last_err}"}).encode()
+        h.send_response(502)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _attempt(self, h, backend: str,
+                 data: bytes) -> Tuple[int, List[Tuple[str, str]], bytes]:
+        """One backend round trip: (status, headers, payload). Raises
+        OSError on connection-level failure (including the injected
+        ``serving.request`` fault — latency with mode=delay, else a
+        simulated connect error exercising ejection + retry)."""
+        chaos.fail_or_delay("serving.request", ConnectionRefusedError,
+                            f"injected backend failure {backend}",
+                            target=backend)
         host, _, port = backend.partition(":")
         conn = http.client.HTTPConnection(host, int(port), timeout=60)
         try:
@@ -188,23 +301,7 @@ class Router:
                 fwd[k] = f"{fwd[k]}, {v}" if k in fwd else v
             conn.request(h.command, h.path, body=data or None, headers=fwd)
             resp = conn.getresponse()
-            payload = resp.read()
-            h.send_response(resp.status)
-            # send_response() already emitted Server/Date; don't duplicate.
-            skip = _HOP_BY_HOP | {"content-length", "server", "date"}
-            for k, v in resp.getheaders():
-                if k.lower() not in skip:
-                    h.send_header(k, v)
-            h.send_header("Content-Length", str(len(payload)))
-            h.end_headers()
-            h.wfile.write(payload)
-        except OSError as e:
-            body = json.dumps({"error": f"backend {backend}: {e}"}).encode()
-            h.send_response(502)
-            h.send_header("Content-Type", "application/json")
-            h.send_header("Content-Length", str(len(body)))
-            h.end_headers()
-            h.wfile.write(body)
+            return resp.status, list(resp.getheaders()), resp.read()
         finally:
             conn.close()
 

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import chaos
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import TRACE_HEADER
 
@@ -276,6 +277,9 @@ class MicroBatcher:
         self._q: "queue.Queue[Tuple[np.ndarray, bool, queue.Queue]]" = \
             queue.Queue()
         self._stop = threading.Event()
+        # Orders enqueue against close(): once close() sets _stop under
+        # this gate, no new request can slip past the drain below.
+        self._gate = threading.Lock()
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"kfx-batcher-{i}")
@@ -334,7 +338,12 @@ class MicroBatcher:
                 f"instance shape {tuple(instances.shape[1:])} does not "
                 f"match model input {tuple(want)}")
         reply: "queue.Queue" = queue.Queue()
-        self._q.put((instances, probabilities, reply))
+        with self._gate:
+            if self._stop.is_set():
+                # A racing predict after close() must fail fast, not sit
+                # on the queue until reply_timeout_s with no worker left.
+                raise RuntimeError("batcher is closed")
+            self._q.put((instances, probabilities, reply))
         try:
             out = reply.get(timeout=self.reply_timeout_s)
         except queue.Empty:
@@ -345,7 +354,21 @@ class MicroBatcher:
         return out
 
     def close(self) -> None:
-        self._stop.set()
+        """Stop workers AND resolve every request they leave behind:
+        join the threads (none is mid-batch afterwards), then drain the
+        queue with error replies — a request that raced the shutdown
+        gets an immediate error instead of stalling its handler thread
+        until reply_timeout_s."""
+        with self._gate:
+            self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        while True:
+            try:
+                _, _, reply = self._q.get_nowait()
+            except queue.Empty:
+                break
+            reply.put(RuntimeError("batcher closed while request queued"))
 
 
 class ModelServer:
@@ -427,6 +450,10 @@ class ModelServer:
         reg.gauge("kfx_serving_models_ready",
                   "Models ready to serve.").set(
                       sum(1 for p in self.predictors.values() if p.ready))
+        # Chaos injections in THIS process (kfx_chaos_injected_total):
+        # a chaos serving run exposes its fault counts on the same
+        # /metrics a scraper already reads.
+        chaos.collect(reg)
 
     def _latency_summary(self) -> Dict[str, Dict[str, Optional[float]]]:
         """Server-reported per-model p50/p99 (ms) from the request
@@ -545,6 +572,15 @@ class ModelServer:
         if not p.ready:
             h._send(503, {"error": f"model {name!r} not ready"})
             return
+        # Fault point: in-server predict failure/latency — the flapping
+        # backend a router's passive health must eject around.
+        inj = chaos.draw("serving.predict", target=name)
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                h._send(500, {"error": f"chaos[serving.predict]: {name}"})
+                return
         try:
             length = int(h.headers.get("Content-Length", 0))
             body = json.loads(h.rfile.read(length) or b"{}")

@@ -6,10 +6,11 @@ the reference leaves this entirely to user code + PVC mounts).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
-import numpy as np
+
+from .. import chaos
 
 # orbax (via google.cloud.logging) costs ~3.4s of import time — a fifth
 # of a whole no-checkpoint HPO trial on a 1-core host. Loaded on first
@@ -25,6 +26,37 @@ def _load_orbax():
     return ocp
 
 
+# Exceptions that mean "the stored tree does not match the target
+# structure" — the legacy-layout negotiation signal. Anything else a
+# restore raises is treated as the step being unreadable (truncated
+# file, bad metadata, I/O failure) and drives the fallback path.
+_STRUCTURAL_ERRORS = (ValueError, KeyError, TypeError)
+
+QUARANTINE_PREFIX = "quarantine-"
+
+
+def corrupt_step_dir(directory: str, step: int) -> int:
+    """Simulate a partial/corrupted checkpoint write: truncate every
+    regular file under the step's directory to half its size (and empty
+    the small ones). The chaos ``checkpoint.save`` point calls this
+    right after a committed save — the worst realistic torn write,
+    because the step still *looks* finalized to the manager. Returns the
+    number of files damaged. Also used directly by tests."""
+    step_dir = os.path.join(os.path.abspath(directory), str(step))
+    damaged = 0
+    for root, _, files in os.walk(step_dir):
+        for fname in files:
+            path = os.path.join(root, fname)
+            try:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(size // 2)
+                damaged += 1
+            except OSError:
+                continue
+    return damaged
+
+
 class Checkpointer:
     """Thin wrapper over an orbax CheckpointManager.
 
@@ -33,6 +65,11 @@ class Checkpointer:
     multi-process runs: orbax coordinates writers through the
     jax.distributed client, so all processes call save()/restore()
     collectively on a shared filesystem.
+
+    Restore is corruption-tolerant: an unreadable newest step is
+    quarantined (renamed aside, preserved for forensics) and the next
+    older retained step restores instead — a torn write during a crash
+    must cost at most ``save_every`` steps, never the whole run.
     """
 
     def __init__(self, directory: str, save_every: int = 100, keep: int = 2,
@@ -41,11 +78,23 @@ class Checkpointer:
         self.directory = os.path.abspath(directory)
         self.save_every = save_every
         os.makedirs(self.directory, exist_ok=True)
-        options = ocp.CheckpointManagerOptions(
+        # Async saving is only safe when the pre-write snapshot is a
+        # REAL copy. On an accelerator the device->host transfer is
+        # one; on the CPU backend the "device" buffer IS the host
+        # buffer, so the async writer serializes the very memory the
+        # train step's donated-buffer update (loop.py donate_argnums)
+        # is overwriting in place — committing a torn checkpoint that
+        # still looks finalized (found by the chaos soak: the resumed
+        # process segfaulted on the garbage state). Force sync writes
+        # there; the save latency only exists where the race does.
+        if async_save and jax.default_backend() == "cpu":
+            async_save = False
+        self._options = ocp.CheckpointManagerOptions(
             max_to_keep=keep,
             enable_async_checkpointing=async_save,
         )
-        self.manager = ocp.CheckpointManager(self.directory, options=options)
+        self.manager = ocp.CheckpointManager(self.directory,
+                                             options=self._options)
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
@@ -54,12 +103,50 @@ class Checkpointer:
         if not force and (self.save_every <= 0 or step % self.save_every != 0):
             return False
         self.manager.save(step, args=ocp.args.StandardSave(state))
+        # Fault point: corrupt THIS save after it commits (a torn write
+        # that still looks finalized). Wait first — damaging a write
+        # still in flight would race the async committer, not model a
+        # crash after commit.
+        if chaos.draw("checkpoint.save", target=f"step-{step}") is not None:
+            self.manager.wait_until_finished()
+            n = corrupt_step_dir(self.directory, step)
+            print(f"chaos_corrupt_checkpoint step={step} files={n}",
+                  flush=True)
         return True
+
+    def _reload_manager(self) -> None:
+        """Rebuild the manager so its cached step listing agrees with
+        the disk after a quarantine rename (rotation and latest_step
+        must never resurrect a renamed step)."""
+        self.manager.close()
+        self.manager = ocp.CheckpointManager(self.directory,
+                                             options=self._options)
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        """Move an unreadable step aside instead of deleting it: the
+        bytes stay for forensics, the keep-rotation stops counting it,
+        and latest_step() can no longer elect it. A step corrupted
+        AGAIN after a resume re-saved it gets a numbered suffix — every
+        quarantine keeps its bytes."""
+        src = os.path.join(self.directory, str(step))
+        dst = os.path.join(self.directory, f"{QUARANTINE_PREFIX}{step}")
+        n = 2
+        while os.path.isdir(dst):
+            dst = os.path.join(self.directory,
+                               f"{QUARANTINE_PREFIX}{step}-{n}")
+            n += 1
+        try:
+            os.rename(src, dst)
+        except OSError:
+            # Multi-process restore: another process already moved it.
+            pass
+        print(f"checkpoint_quarantined step={step} reason={reason} "
+              f"dir={dst}", flush=True)
 
     def restore_latest(self, target: Any,
                        legacy_layouts: Any = ()) -> Optional[Any]:
-        """Restore the newest checkpoint into the structure of ``target``
-        (an abstract or concrete state pytree).
+        """Restore the newest readable checkpoint into the structure of
+        ``target`` (an abstract or concrete state pytree).
 
         ``legacy_layouts`` is a sequence of ``(name, legacy_target,
         upgrade)`` triples tried in order when the stored tree does not
@@ -68,35 +155,73 @@ class Checkpointer:
         the legacy pytree onto the current layout, so old progress is
         migrated instead of silently discarded.
 
-        Returns None if there is no checkpoint, or if no layout matches
-        — degrading to a fresh start keeps the job runnable, and the
-        printed reason keeps the degradation observable."""
-        step = self.manager.latest_step()
-        if step is None:
+        Failure policy, newest step first:
+          * a step that restores under some layout wins; any NEWER step
+            that failed is quarantined (provably worse than a working
+            alternative — rename preserves its bytes);
+          * every step fails structurally (tree-shape mismatch on all
+            layouts) -> None, degrade to a fresh start with the reason
+            printed — the pre-existing incompatible-layout contract;
+          * otherwise (I/O-flavored failures and no readable step) the
+            last error propagates: silently retraining from step 0 on a
+            recoverable store hiccup would let the keep-rotation delete
+            good checkpoints.
+        """
+        chaos.fail_or_delay("checkpoint.restore", OSError,
+                            f"restore from {self.directory}")
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
             return None
-        candidates = [("current", target, None)]
+        candidates: List[Tuple[str, Any, Any]] = [("current", target, None)]
         candidates += [tuple(entry) for entry in legacy_layouts]
-        tried = []
-        for name, tgt, upgrade in candidates:
-            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, tgt)
-            try:
-                restored = self.manager.restore(
-                    step, args=ocp.args.StandardRestore(abstract))
-            except (ValueError, KeyError, TypeError) as e:
-                # Tree-shape/-structure mismatches only. I/O errors
-                # (stale NFS handle, object-store hiccup) propagate:
-                # silently retraining from step 0 on a recoverable error
-                # would let the keep-rotation delete good checkpoints.
-                tried.append(f"{name}:{type(e).__name__}")
-                continue
-            if upgrade is not None:
-                print(f"checkpoint_migrated step={step} layout={name}",
-                      flush=True)
-                restored = upgrade(restored)
-            return restored
-        print(f"checkpoint_restore_incompatible step={step} "
-              f"tried=[{', '.join(tried)}] — starting fresh", flush=True)
-        return None
+        failed: List[Tuple[int, str]] = []  # (step, reason) newest-first
+        all_structural = True
+        last_error: Optional[BaseException] = None
+        for step in steps:
+            tried = []
+            step_structural = True
+            restored = upgrade = None
+            hit = False
+            for name, tgt, upgrade in candidates:
+                abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, tgt)
+                try:
+                    restored = self.manager.restore(
+                        step, args=ocp.args.StandardRestore(abstract))
+                    hit = True
+                    break
+                except _STRUCTURAL_ERRORS as e:
+                    tried.append(f"{name}:{type(e).__name__}")
+                    last_error = e
+                except Exception as e:  # unreadable: torn write, I/O
+                    tried.append(f"{name}:{type(e).__name__}")
+                    last_error = e
+                    step_structural = False
+                    break
+            if hit:
+                for bad_step, reason in failed:
+                    self._quarantine(bad_step, reason)
+                if failed:
+                    self._reload_manager()
+                if upgrade is not None:
+                    print(f"checkpoint_migrated step={step} layout={name}",
+                          flush=True)
+                    restored = upgrade(restored)
+                return restored
+            failed.append((step, ", ".join(tried)))
+            all_structural = all_structural and step_structural
+            print(f"checkpoint_unreadable step={step} "
+                  f"tried=[{', '.join(tried)}] — trying older step",
+                  flush=True)
+        if all_structural:
+            print(f"checkpoint_restore_incompatible "
+                  f"steps={[s for s, _ in failed]} — starting fresh",
+                  flush=True)
+            return None
+        raise RuntimeError(
+            f"no retained checkpoint in {self.directory} is readable "
+            f"(steps {[s for s, _ in failed]}); refusing to restart from "
+            f"step 0 on what may be a recoverable storage error"
+        ) from last_error
 
     def wait(self) -> None:
         self.manager.wait_until_finished()

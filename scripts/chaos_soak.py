@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Seeded end-to-end chaos soak: prove the recovery machinery recovers.
+
+Two legs, both deterministic under --seed:
+
+  training  a gang-supervised JAXJob runs to its target step through
+            injected worker crashes AND a corrupted latest checkpoint —
+            asserting the resume came from the older retained step
+            (quarantine + fallback), never step 0;
+  serving   a router in front of two model servers sustains >= 99%
+            request success while one backend fails every request
+            (passive health ejects it; each failed try retries once on
+            the healthy backend), then readmits the backend after the
+            half-open probe window once the fault lifts.
+
+Exit 0 iff both legs hold. Run from the repo root:
+
+    python scripts/chaos_soak.py            # full soak
+    python scripts/chaos_soak.py --steps 40 --requests 120   # quicker
+
+Injections are visible as kfx_chaos_injected_total{point} on the
+control plane's /metrics and as kind=Chaos events (docs/chaos.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def run_training_leg(steps: int, seed: int, home: str) -> dict:
+    """JAXJob to `steps` through two injected crashes + one corrupted
+    checkpoint. Deterministic: faults are scheduled by save ordinal
+    (after/count) against a shared state file, so the restart sequence
+    replays exactly for a given seed/spec."""
+    from kubeflow_tpu.api import training as T
+    from kubeflow_tpu.api.base import from_manifest
+    from kubeflow_tpu.controlplane import ControlPlane
+
+    state = os.path.join(home, "chaos-state.json")
+    spec = (f"seed={seed};state={state};"
+            "runner.crash:after=1,count=2;"
+            "checkpoint.save:mode=corrupt,after=1,count=1")
+    job = from_manifest({
+        "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+        "metadata": {"name": "chaos-soak", "namespace": "default"},
+        "spec": {"jaxReplicaSpecs": {"Worker": {
+            "replicas": 1, "restartPolicy": "OnFailure",
+            "template": {"spec": {"containers": [{
+                "name": "main",
+                "command": [sys.executable, "-m",
+                            "kubeflow_tpu.runners.jax_runner",
+                            "--model=mlp", "--dataset=mnist",
+                            f"--steps={steps}", "--batch-size=64",
+                            "--log-every=10", "--checkpoint-every=10",
+                            "--keep-checkpoints=2"],
+                "env": [{"name": "KFX_CHAOS", "value": spec},
+                        {"name": "PYTHONPATH", "value": REPO_ROOT}],
+            }]}},
+        }}, "runPolicy": {"backoffLimit": 5}}})
+    with ControlPlane(home=home, worker_platform="cpu") as cp:
+        cp.apply([job])
+        final = cp.wait_for_job("JAXJob", "chaos-soak", timeout=600)
+        log = cp.job_logs("JAXJob", "chaos-soak")
+        metrics = cp.metrics.render()
+    ok = (final.has_condition(T.JOB_SUCCEEDED)
+          and "chaos_corrupt_checkpoint step=20" in log
+          and "checkpoint_quarantined step=20" in log
+          and "resumed_from_checkpoint step=10" in log
+          and f"train_done steps={steps}" in log)
+    return {
+        "ok": ok,
+        "succeeded": final.has_condition(T.JOB_SUCCEEDED),
+        "restarts": final.status.get("restartCount", 0),
+        "resumed_from_older_step": "resumed_from_checkpoint step=10" in log,
+        "quarantined_corrupt_latest":
+            "checkpoint_quarantined step=20" in log,
+        "controlplane_metrics_has_chaos":
+            "kfx_chaos_injected_total" in metrics,
+    }
+
+
+class _EchoPredictor:
+    """Minimal in-process predictor: the serving leg stresses the
+    ROUTER's failure path (chaos injects at its serving.request hop),
+    not a model."""
+
+    ready = True
+
+    def __init__(self, name: str, tag: str):
+        self.name = name
+        self.tag = tag
+
+    def load(self) -> None:
+        pass
+
+    def predict(self, instances, probabilities=False):
+        return {"predictions": [self.tag] * instances.shape[0]}
+
+
+def run_serving_leg(requests: int, seed: int) -> dict:
+    """>= 99% success through a backend failing 100% of its requests,
+    then readmission after the fault lifts."""
+    import time
+
+    from kubeflow_tpu import chaos
+    from kubeflow_tpu.serving.router import Router
+    from kubeflow_tpu.serving.server import ModelServer
+
+    s1 = ModelServer(port=0)
+    s1.register(_EchoPredictor("m", "good"))
+    s1.start()
+    s2 = ModelServer(port=0)
+    s2.register(_EchoPredictor("m", "flappy"))
+    s2.start()
+    flappy = f"127.0.0.1:{s2.port}"
+    router = Router().start()
+    router.default.set_endpoints([f"127.0.0.1:{s1.port}", flappy])
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/v1/models/m:predict",
+            json.dumps({"instances": [[0.0]]}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())["predictions"][0]
+
+    chaos.install(chaos.parse_spec(
+        f"seed={seed};serving.request:match={flappy}"))
+    ok = 0
+    try:
+        for _ in range(requests):
+            try:
+                post()
+                ok += 1
+            except urllib.error.HTTPError:
+                pass
+        rate = ok / max(requests, 1)
+        ejected = router.default.ejected_endpoints()
+        # Lift the fault; the half-open probe must readmit the backend.
+        chaos.install(None)
+        time.sleep(router.default.PROBE_AFTER_S + 0.2)
+        tags = {post() for _ in range(40)}
+        injected = chaos.injected_counts().get("serving.request", 0)
+    finally:
+        chaos.reset()
+        router.stop()
+        s1.stop()
+        s2.stop()
+    return {
+        "ok": rate >= 0.99 and "flappy" in tags,
+        "success_rate": round(rate, 4),
+        "ejected_during_fault": ejected,
+        "readmitted_after_fault": "flappy" in tags,
+        "injections": injected,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="kfx chaos soak")
+    p.add_argument("--steps", type=int, default=60,
+                   help="JAXJob target step for the training leg")
+    p.add_argument("--requests", type=int, default=300,
+                   help="request count for the serving leg")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--home", default="",
+                   help="control-plane home (default: fresh temp dir)")
+    args = p.parse_args(argv)
+
+    home = args.home or tempfile.mkdtemp(prefix="kfx-chaos-soak-")
+    results = {"training": run_training_leg(args.steps, args.seed, home),
+               "serving": run_serving_leg(args.requests, args.seed)}
+    results["ok"] = all(r["ok"] for r in results.values())
+    print(json.dumps(results, indent=1))
+    return 0 if results["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

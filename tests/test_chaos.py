@@ -1,0 +1,497 @@
+"""Chaos subsystem tests: deterministic fault injection + the failure-
+path hardening it exists to regression-test.
+
+Layers covered (mirrors docs/chaos.md's fault-point catalog):
+  * plan/spec semantics — grammar, seeding, count/after caps, the
+    cross-process state file;
+  * store faults -> apiserver 503 + Retry-After (never a stack trace);
+  * workqueue requeue storms absorbed by de-dup;
+  * gang spawn failure (all-or-nothing) and supervisor member kill
+    (whole-gang restart);
+  * router passive health: ejection, single retry, half-open readmit;
+  * the seeded tier-1 smoke: a JAXJob survives a worker crash at a
+    corrupted latest checkpoint by quarantining it and resuming from
+    the older retained step — plus the slow full soak (scripts/
+    chaos_soak.py) with two crashes and a >= 99%-success serving leg.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import chaos
+
+PY = sys.executable
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(url, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestPlan:
+    def test_spec_grammar(self):
+        plan = chaos.parse_spec(
+            "seed=42;state=/tmp/x.json;"
+            "store.read:p=0.25,count=3,after=2,delay=0.1,mode=delay;"
+            "gang.kill;serving.request:match=127.0.0.1:9")
+        assert plan.seed == 42
+        assert plan.state_path == "/tmp/x.json"
+        r = plan.rules["store.read"]
+        assert (r.p, r.count, r.after, r.delay, r.mode) == \
+            (0.25, 3, 2, 0.1, "delay")
+        assert plan.rules["gang.kill"].p == 1.0
+        assert plan.rules["serving.request"].match == "127.0.0.1:9"
+
+    def test_spec_rejects_typos(self):
+        # A typo'd spec silently running with no faults would fake a
+        # passing chaos run.
+        with pytest.raises(ValueError):
+            chaos.parse_spec("store.read:probability=0.5")
+        with pytest.raises(ValueError):
+            chaos.parse_spec("sed=42")
+        # Unknown fault-point names too: "checkpoint.sav" would
+        # otherwise inject nothing and let a soak pass vacuously.
+        with pytest.raises(ValueError):
+            chaos.parse_spec("checkpoint.sav:mode=corrupt")
+
+    def test_same_seed_same_decisions(self):
+        mk = lambda: chaos.parse_spec("seed=9;store.read:p=0.4")
+        p1, p2 = mk(), mk()
+        seq1 = [bool(p1.draw("store.read")) for _ in range(32)]
+        seq2 = [bool(p2.draw("store.read")) for _ in range(32)]
+        assert seq1 == seq2
+        assert any(seq1) and not all(seq1)  # p=0.4 actually both ways
+
+    def test_per_point_streams_are_independent(self):
+        # Interleaving draws at OTHER points must not shift a point's
+        # own decision sequence.
+        p1 = chaos.parse_spec("seed=5;store.read:p=0.5;store.write:p=0.5")
+        seq1 = []
+        for _ in range(16):
+            seq1.append(bool(p1.draw("store.read")))
+            p1.draw("store.write")
+        p2 = chaos.parse_spec("seed=5;store.read:p=0.5;store.write:p=0.5")
+        seq2 = [bool(p2.draw("store.read")) for _ in range(16)]
+        assert seq1 == seq2
+
+    def test_after_and_count(self):
+        plan = chaos.parse_spec("runner.crash:after=2,count=2")
+        got = [bool(plan.draw("runner.crash")) for _ in range(6)]
+        assert got == [False, False, True, True, False, False]
+
+    def test_match_does_not_consume_draws(self):
+        plan = chaos.parse_spec("gang.spawn:count=1,match=bad")
+        assert plan.draw("gang.spawn", target="good-0") is None
+        assert plan.draw("gang.spawn", target="bad-1") is not None
+        assert plan.injected_counts() == {"gang.spawn": 1}
+
+    def test_state_file_shares_budget(self, tmp_path):
+        spec = f"seed=3;state={tmp_path}/s.json;runner.crash:count=2"
+        p1 = chaos.parse_spec(spec)
+        assert [bool(p1.draw("runner.crash")) for _ in range(3)] == \
+            [True, True, False]
+        # A "restarted process" (fresh plan, same state) sees the spent
+        # budget — no third injection.
+        p2 = chaos.parse_spec(spec)
+        assert [bool(p2.draw("runner.crash")) for _ in range(3)] == \
+            [False] * 3
+        assert p2.injected_counts() == {"runner.crash": 2}
+
+    def test_env_spec_activates_and_counts(self, monkeypatch):
+        monkeypatch.setenv("KFX_CHAOS", "rendezvous.delay:count=1,delay=0")
+        assert chaos.draw("rendezvous.delay") is not None
+        assert chaos.draw("rendezvous.delay") is None
+        assert chaos.injected_counts() == {"rendezvous.delay": 1}
+        from kubeflow_tpu.obs.metrics import default_registry
+
+        counter = default_registry().counter("kfx_chaos_injected_total")
+        assert counter.value(point="rendezvous.delay") >= 1
+
+
+class TestStoreFaults:
+    def test_read_fault_raises_store_fault(self):
+        from kubeflow_tpu.core.store import ResourceStore, StoreFault
+
+        chaos.install(chaos.parse_spec("store.read:count=1"))
+        store = ResourceStore()
+        with pytest.raises(StoreFault):
+            store.get("JAXJob", "x")
+        # Budget spent: the store is healthy again (NotFound, not fault).
+        with pytest.raises(KeyError):
+            store.get("JAXJob", "x")
+
+    def test_apiserver_answers_503_with_retry_after(self, tmp_path):
+        from kubeflow_tpu.apiserver import ApiServer
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        plane = ControlPlane(home=str(tmp_path / "home"))
+        server = ApiServer(plane, port=0)
+        server.start()
+        try:
+            chaos.install(chaos.parse_spec("store.read:count=1"))
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{server.url}/apis/jaxjob", timeout=10)
+            assert e.value.code == 503
+            assert e.value.headers.get("Retry-After") == "1"
+            body = json.loads(e.value.read())
+            assert "storage temporarily unavailable" in body["error"]
+            # The retry the header promised actually works.
+            with urllib.request.urlopen(
+                    f"{server.url}/apis/jaxjob", timeout=10) as r:
+                assert r.status == 200
+        finally:
+            server.stop()
+            plane.stop()
+
+    def test_store_fault_lands_in_events_and_metrics(self, tmp_path):
+        from kubeflow_tpu.controlplane import ControlPlane
+        from kubeflow_tpu.core.store import StoreFault
+
+        plane = ControlPlane(home=str(tmp_path / "home"))
+        try:
+            chaos.install(chaos.parse_spec("store.read:count=1"))
+            with pytest.raises(StoreFault):
+                plane.store.get("JAXJob", "x")
+            evs = plane.store.events_for("Chaos", "store.read")
+            assert evs and evs[0].reason == "ChaosInjected"
+            text = plane.metrics.render()
+            assert 'kfx_chaos_injected_total{point="store.read"} 1' in text
+        finally:
+            plane.stop()
+
+
+class TestControllerResilience:
+    def test_worker_threads_survive_store_faults(self, tmp_path):
+        """A store fault during reconcile (or the pre-reconcile trace
+        lookup) must cost a rate-limited requeue, never the worker
+        thread — a dead worker strands its key in `processing` and
+        silently stops reconciliation for that kind forever."""
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        with ControlPlane(home=str(tmp_path / "home")) as cp:
+            ctrl = cp.manager.controllers["JAXJob"]
+            chaos.install(chaos.parse_spec("store.read:count=8"))
+            ctrl.queue.add("default/ghost")
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                s = ctrl.queue.stats()
+                if chaos.injected_counts().get("store.read", 0) >= 8 \
+                        and s["processing"] == 0 and len(ctrl.queue) == 0:
+                    break
+                time.sleep(0.05)
+            chaos.install(None)
+            s = ctrl.queue.stats()
+            assert s["processing"] == 0, s  # key not stranded
+            # The worker is still alive: a healthy key gets processed.
+            ctrl.queue.add("default/ghost2")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                s = ctrl.queue.stats()
+                if s["depth"] == 0 and s["processing"] == 0 and \
+                        len(ctrl.queue) == 0:
+                    break
+                time.sleep(0.05)
+            assert s["processing"] == 0 and s["depth"] == 0, s
+
+
+class TestWorkqueueStorm:
+    def test_requeue_storm_is_deduplicated(self):
+        from kubeflow_tpu.core.workqueue import RateLimitingQueue
+
+        chaos.install(chaos.parse_spec("workqueue.requeue:count=20"))
+        q = RateLimitingQueue()
+        # Every add also storms (p=1) until the 20-injection budget is
+        # spent: 20 spurious extra deliveries of the same key.
+        for _ in range(25):
+            q.add("ns/a")
+        assert q.counters()["requeues"] >= 20
+        # De-dup must absorb the storm: bounded deliveries, then empty.
+        seen = 0
+        while True:
+            key = q.get(timeout=0.2)
+            if key is None:
+                break
+            assert key == "ns/a"
+            seen += 1
+            q.done(key)
+        assert 1 <= seen <= 21  # never amplified past one per delivery
+        assert len(q) == 0
+        assert q.stats()["depth"] == 0
+
+
+class TestGangChaos:
+    def test_spawn_fault_is_all_or_nothing(self, tmp_path):
+        from kubeflow_tpu.runtime import gang as G
+
+        chaos.install(chaos.parse_spec("gang.spawn:count=1,match=worker-1"))
+        g = G.Gang(
+            "spawnfail",
+            [G.ProcessSpec("Worker", i, [PY, "-c", "pass"])
+             for i in range(2)],
+            str(tmp_path), restart_policy="Never")
+        g.start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if g.status().phase == G.FAILED:
+                break
+            time.sleep(0.05)
+        st = g.status()
+        g.delete()
+        assert st.phase == G.FAILED
+        assert st.reason == "SpawnFailed"
+        # worker-0 spawned first, then worker-1's injected spawn failure
+        # must have torn it down: no member may survive a half-start.
+        assert all(r.state == G.FAILED for r in st.replicas.values())
+
+    def test_injected_kill_restarts_whole_gang(self, tmp_path):
+        from kubeflow_tpu.runtime import gang as G
+
+        chaos.install(chaos.parse_spec("gang.kill:count=1,delay=0.2"))
+        g = G.Gang(
+            "killme",
+            [G.ProcessSpec("Worker", i,
+                           [PY, "-c", "import time; time.sleep(1.0)"])
+             for i in range(2)],
+            str(tmp_path), restart_policy="OnFailure", backoff_limit=3)
+        g.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if g.status().phase in (G.SUCCEEDED, G.FAILED):
+                break
+            time.sleep(0.05)
+        st = g.status()
+        g.delete()
+        assert st.phase == G.SUCCEEDED, (st.phase, st.reason, st.message)
+        assert st.restart_count == 1
+        assert chaos.injected_counts().get("gang.kill") == 1
+
+
+class _Backend(threading.Thread):
+    """Tiny real HTTP backend tagging its responses."""
+
+    def __init__(self, tag):
+        super().__init__(daemon=True)
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        tag_ = tag
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = json.dumps({"predictions": [tag_]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+
+    def run(self):
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestRouterPassiveHealth:
+    def test_flapping_backend_ejected_retried_readmitted(self):
+        """The seeded router-flap smoke: one backend fails 100% of its
+        requests; client success stays >= 99% (ejection + one retry on
+        the healthy backend), the sick backend is readmitted by the
+        half-open probe once it recovers."""
+        from kubeflow_tpu.serving.router import Router
+
+        good, flappy = _Backend("good"), _Backend("flappy")
+        good.start()
+        flappy.start()
+        bad_ep = f"127.0.0.1:{flappy.port}"
+        router = Router().start()
+        router.default.set_endpoints(
+            [f"127.0.0.1:{good.port}", bad_ep])
+        chaos.install(chaos.parse_spec(
+            f"seed=1;serving.request:match={bad_ep}"))
+        try:
+            ok = 0
+            n = 100
+            for _ in range(n):
+                try:
+                    status, body = _post(
+                        f"http://127.0.0.1:{router.port}"
+                        f"/v1/models/m:predict", {"instances": [[0.0]]})
+                    assert body["predictions"] == ["good"]
+                    ok += 1
+                except urllib.error.HTTPError:
+                    pass
+            assert ok / n >= 0.99, f"success rate {ok}/{n}"
+            assert router.default.ejected_endpoints() == [bad_ep]
+            # Injection counter covers exactly the requests that reached
+            # the sick backend (first strikes + half-open probes), not
+            # one per client request.
+            assert chaos.injected_counts()["serving.request"] < n // 2
+            # Recovery: lift the fault; the next half-open probe readmits.
+            chaos.install(None)
+            time.sleep(router.default.PROBE_AFTER_S + 0.1)
+            tags = set()
+            for _ in range(30):
+                _, body = _post(
+                    f"http://127.0.0.1:{router.port}/v1/models/m:predict",
+                    {"instances": [[0.0]]})
+                tags.add(body["predictions"][0])
+            assert tags == {"good", "flappy"}
+            assert router.default.ejected_endpoints() == []
+        finally:
+            router.stop()
+            good.stop()
+            flappy.stop()
+
+    def test_all_backends_ejected_degrades_to_rotation(self):
+        from kubeflow_tpu.serving.router import BackendSet
+
+        s = BackendSet(["a:1", "b:2"])
+        for ep in ("a:1", "b:2"):
+            for _ in range(BackendSet.EJECT_AFTER):
+                s.report_failure(ep)
+        assert set(s.ejected_endpoints()) == {"a:1", "b:2"}
+        # Everything is sick and no probe is due: still serve.
+        assert s.pick() in ("a:1", "b:2")
+
+    def test_latency_injection_mode_delay(self):
+        chaos.install(chaos.parse_spec(
+            "serving.request:mode=delay,delay=0.05,count=1"))
+        t0 = time.monotonic()
+        chaos.fail_or_delay("serving.request", OSError, "x", target="any")
+        assert time.monotonic() - t0 >= 0.05  # slept, did not raise
+
+
+class TestRendezvousDelay:
+    def test_startup_delay_injected(self, monkeypatch):
+        from kubeflow_tpu.runtime.rendezvous import apply_startup_chaos
+
+        monkeypatch.setenv("KFX_REPLICA_TYPE", "Worker")
+        monkeypatch.setenv("KFX_REPLICA_INDEX", "1")
+        chaos.install(chaos.parse_spec(
+            "rendezvous.delay:delay=0.05,match=worker-1"))
+        assert apply_startup_chaos() >= 0.05
+        assert apply_startup_chaos() >= 0.05  # no count cap: every start
+        monkeypatch.setenv("KFX_REPLICA_INDEX", "0")
+        assert apply_startup_chaos() == 0.0  # match filter
+
+
+class TestChaosSmoke:
+    """The fast seeded smoke (tier-1): one injected worker crash ON a
+    corrupted latest checkpoint; the gang restart must resume from the
+    older retained step and still finish the job."""
+
+    def test_jaxjob_survives_crash_on_corrupt_checkpoint(self, tmp_path):
+        from kubeflow_tpu.api import training as T
+        from kubeflow_tpu.api.base import from_manifest
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        state = str(tmp_path / "chaos.json")
+        spec = (f"seed=7;state={state};"
+                "runner.crash:after=1,count=1;"
+                "checkpoint.save:mode=corrupt,after=1,count=1")
+        job = from_manifest({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "smoke", "namespace": "default"},
+            "spec": {"jaxReplicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "OnFailure",
+                "template": {"spec": {"containers": [{
+                    "name": "main",
+                    "command": [PY, "-m",
+                                "kubeflow_tpu.runners.jax_runner",
+                                "--model=mlp", "--dataset=mnist",
+                                "--steps=40", "--batch-size=64",
+                                "--log-every=10", "--checkpoint-every=10",
+                                "--keep-checkpoints=2"],
+                    "env": [{"name": "KFX_CHAOS", "value": spec},
+                            {"name": "PYTHONPATH", "value": REPO_ROOT}],
+                }]}},
+            }}, "runPolicy": {"backoffLimit": 3}}})
+        with ControlPlane(home=str(tmp_path / "home"),
+                          worker_platform="cpu") as cp:
+            cp.apply([job])
+            final = cp.wait_for_job("JAXJob", "smoke", timeout=180)
+            log = cp.job_logs("JAXJob", "smoke")
+        assert final.has_condition(T.JOB_SUCCEEDED), log[-2000:]
+        assert final.status["restartCount"] == 1
+        # The deterministic story: save 20 corrupted, crash at 20,
+        # restart quarantines it and resumes from 10 — never step 0.
+        assert "chaos_corrupt_checkpoint step=20" in log
+        assert "chaos_crash step=20" in log
+        assert "checkpoint_quarantined step=20" in log
+        assert "resumed_from_checkpoint step=10" in log
+        assert "train_done steps=40" in log
+
+    def test_gang_kill_visible_in_plane_metrics_and_events(self, tmp_path):
+        """Operator-side injection: a supervisor-killed member restarts
+        the gang, and the injection is readable on the plane's /metrics
+        and event log — a chaos run reads like any other job."""
+        from kubeflow_tpu.api import training as T
+        from kubeflow_tpu.api.base import from_manifest
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        chaos.install(chaos.parse_spec("gang.kill:count=1,delay=0.2"))
+        job = from_manifest({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "killed", "namespace": "default"},
+            "spec": {"jaxReplicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "OnFailure",
+                "template": {"spec": {"containers": [{
+                    "name": "main",
+                    "command": [PY, "-c", "import time; time.sleep(1.0)"],
+                }]}},
+            }}, "runPolicy": {"backoffLimit": 3}}})
+        with ControlPlane(home=str(tmp_path / "home"),
+                          worker_platform="cpu") as cp:
+            cp.apply([job])
+            final = cp.wait_for_job("JAXJob", "killed", timeout=60)
+            text = cp.metrics.render()
+            evs = cp.store.events_for("Chaos", "gang.kill")
+        assert final.has_condition(T.JOB_SUCCEEDED)
+        assert final.status["restartCount"] == 1
+        assert 'kfx_chaos_injected_total{point="gang.kill"} 1' in text
+        assert evs and evs[0].reason == "ChaosInjected"
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_full_soak(self, tmp_path):
+        """The acceptance soak: two worker crashes + corrupted latest
+        checkpoint on the training leg, >= 99% success through a
+        flapping backend on the serving leg."""
+        sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+        try:
+            import chaos_soak
+        finally:
+            sys.path.pop(0)
+        rc = chaos_soak.main(["--steps", "60", "--requests", "300",
+                              "--home", str(tmp_path / "soak")])
+        assert rc == 0

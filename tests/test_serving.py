@@ -353,6 +353,56 @@ class TestMicroBatcher:
         assert all(r is not None and len(r[1]["predictions"]) == r[0]
                    for r in results), results
 
+    def test_close_joins_workers_and_drains_queue(self):
+        """close() must resolve every outstanding request: the in-flight
+        batch gets its reply, a queued request behind it gets an
+        immediate error, and a racing predict() after close fails fast —
+        none of them may stall until reply_timeout_s (round-5 advisor
+        finding)."""
+        import threading
+        import time
+
+        from kubeflow_tpu.serving.server import MicroBatcher, Predictor
+
+        class Slow(Predictor):
+            name = "slow"
+            ready = True
+
+            def load(self):
+                pass
+
+            def predict(self, instances, probabilities=False):
+                time.sleep(0.3)
+                return {"predictions": [0] * instances.shape[0]}
+
+        batcher = MicroBatcher(Slow(), max_batch_size=1,
+                               max_latency_ms=1.0, reply_timeout_s=60.0)
+        outcomes = {}
+
+        def hit(tag):
+            try:
+                outcomes[tag] = batcher.predict(
+                    np.zeros((1, 2), np.float32))
+            except Exception as e:
+                outcomes[tag] = e
+
+        t1 = threading.Thread(target=hit, args=("inflight",))
+        t1.start()
+        time.sleep(0.1)  # worker is inside the slow predict
+        t2 = threading.Thread(target=hit, args=("queued",))
+        t2.start()
+        time.sleep(0.1)  # second request is parked on the queue
+        t0 = time.monotonic()
+        batcher.close()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10, "close/drain stalled toward reply_timeout_s"
+        assert outcomes["inflight"] == {"predictions": [0]}
+        assert isinstance(outcomes["queued"], RuntimeError)
+        with pytest.raises(RuntimeError):
+            batcher.predict(np.zeros((1, 2), np.float32))
+
     def test_non_pow2_max_batch_is_a_bucket(self, export_dir):
         from kubeflow_tpu.serving.server import JaxPredictor
 

@@ -280,6 +280,76 @@ class TestCheckpoint:
         assert "checkpoint_restore_incompatible" in capfd.readouterr().out
         ckpt.close()
 
+    def test_corrupt_latest_falls_back_to_older_retained_step(
+            self, tmp_path, capfd):
+        """keep=2 retains an older good step precisely so a torn write
+        of the newest can't kill the job: restore must quarantine the
+        corrupt latest (observably, preserving its bytes) and resume
+        from the previous retained step — never step 0."""
+        import jax
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import Checkpointer, TrainLoop
+        from kubeflow_tpu.training.checkpoint import corrupt_step_dir
+
+        ds = get_dataset("mnist")
+        loop = TrainLoop(get_model("mlp"), learning_rate=1e-3)
+        state = loop.init_state(ds.shape)
+        ckpt = Checkpointer(str(tmp_path / "ck"), save_every=1, keep=2)
+        it = ds.batches(64, steps=2)
+        state, *_ = loop.train_step(state, *next(it))
+        ckpt.maybe_save(1, state, force=True)
+        good_params = jax.tree.leaves(jax.device_get(state.params))
+        state, *_ = loop.train_step(state, *next(it))
+        ckpt.maybe_save(2, state, force=True)
+        ckpt.wait()
+        assert corrupt_step_dir(str(tmp_path / "ck"), 2) > 0
+
+        restored = ckpt.restore_latest(loop.init_state(ds.shape))
+        assert restored is not None
+        assert int(restored.step) == 1  # the older retained step
+        b = jax.tree.leaves(jax.device_get(restored.params))
+        assert all(np.allclose(x, y) for x, y in zip(good_params, b))
+        out = capfd.readouterr().out
+        assert "checkpoint_unreadable step=2" in out
+        assert "checkpoint_quarantined step=2" in out
+        # Quarantine preserves the bad bytes for forensics and removes
+        # the step from election: rotation continues cleanly.
+        assert (tmp_path / "ck" / "quarantine-2").is_dir()
+        assert not (tmp_path / "ck" / "2").exists()
+        assert ckpt.latest_step() == 1
+        ckpt.maybe_save(3, state, force=True)
+        ckpt.wait()
+        assert sorted(ckpt.manager.all_steps()) == [1, 3]
+        ckpt.close()
+
+    def test_chaos_save_corruption_point(self, tmp_path, capfd):
+        """The checkpoint.save fault point corrupts the just-committed
+        save in place — the deterministic seed for the restore-fallback
+        path above."""
+        from kubeflow_tpu import chaos
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import Checkpointer, TrainLoop
+
+        ds = get_dataset("mnist")
+        loop = TrainLoop(get_model("mlp"), learning_rate=1e-3)
+        state = loop.init_state(ds.shape)
+        chaos.reset()
+        chaos.install(chaos.parse_spec(
+            "checkpoint.save:mode=corrupt,after=1,count=1"))
+        try:
+            ckpt = Checkpointer(str(tmp_path / "ck"), save_every=1, keep=2)
+            ckpt.maybe_save(1, state, force=True)   # draw 0: skipped
+            ckpt.maybe_save(2, state, force=True)   # draw 1: corrupted
+            ckpt.wait()
+            assert "chaos_corrupt_checkpoint step=2" in \
+                capfd.readouterr().out
+            restored = ckpt.restore_latest(loop.init_state(ds.shape))
+            assert restored is not None
+            assert (tmp_path / "ck" / "quarantine-2").is_dir()
+            ckpt.close()
+        finally:
+            chaos.reset()
+
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
