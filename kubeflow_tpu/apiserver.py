@@ -362,7 +362,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {"events": [
                 {"timestamp": e.timestamp, "type": e.type,
                  "reason": e.reason, "message": e.message,
-                 "traceId": e.trace_id} for e in evs]})
+                 "traceId": e.trace_id, "spanId": e.span_id}
+                for e in evs]})
         if len(parts) == 4 and parts[3] == "logs":
             ns, name = parts[1], parts[2]
             replica = (q.get("replica") or [""])[0]

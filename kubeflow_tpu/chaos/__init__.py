@@ -323,14 +323,15 @@ def active_plan() -> Optional[ChaosPlan]:
         return _env_plan
 
 
-def add_listener(fn: Callable[[str, Rule, str], None]) -> None:
-    """Register ``fn(point, rule, trace_id)`` called on every injection
-    in this process (the control plane records a store event here)."""
+def add_listener(fn: Callable[[str, Rule, str, str], None]) -> None:
+    """Register ``fn(point, rule, trace_id, span_id)`` called on every
+    injection in this process (the control plane records a store event
+    here, pinned to the span the injection happened inside)."""
     with _lock:
         _listeners.append(fn)
 
 
-def remove_listener(fn: Callable[[str, Rule, str], None]) -> None:
+def remove_listener(fn: Callable[[str, Rule, str, str], None]) -> None:
     with _lock:
         if fn in _listeners:
             _listeners.remove(fn)
@@ -359,11 +360,13 @@ def _record(point: str, rule: Rule) -> None:
         "kfx_chaos_injected_total",
         "Chaos fault injections by fault point.").inc(1, point=point)
     trace = obs_trace.current_trace_id()
+    span = obs_trace.current_span_id()
     print(f"chaos_inject point={point} n={n} mode={rule.mode or 'error'}"
-          + (f" trace={trace}" if trace else ""), flush=True)
+          + (f" trace={trace}" if trace else "")
+          + (f" span={span}" if span else ""), flush=True)
     for fn in listeners:
         try:
-            fn(point, rule, trace)
+            fn(point, rule, trace, span)
         except Exception:
             pass  # observers never break the injected path
 

@@ -11,8 +11,8 @@ Two modes:
   thin HTTP clients (the kubectl model). Implemented in
   kubeflow_tpu.apiserver.
 
-Verbs: apply, run, get, describe, delete, logs, events, kill-replica,
-server, version.
+Verbs: apply, run, get, describe, delete, logs, events, trace,
+kill-replica, server, version.
 """
 
 from __future__ import annotations
@@ -223,6 +223,52 @@ class KfxCLI:
             print(f"{e.timestamp} {e.type} {e.reason}: {e.message}{trace}")
         return 0
 
+    def trace(self, kind: str, name: str, namespace: str,
+              fmt: str = "ascii", output: str = "") -> int:
+        """Cross-process timeline reconstruction (`kfx trace <job>`):
+        merge the span logs of the control plane and every gang replica
+        for this job's trace ID into one tree; render an ASCII
+        waterfall with the critical path, or Chrome trace JSON
+        (--format=chrome) loadable in Perfetto / chrome://tracing."""
+        from .obs import timeline
+        from .obs.trace import SPANS_DIRNAME, trace_of
+
+        cls = resource_class(kind)
+        job = self.cp.store.get(cls.KIND, name, namespace)
+        trace_id = trace_of(job)
+        if not trace_id:
+            print(f"error: {cls.KIND} {namespace}/{name} carries no "
+                  f"trace annotation (applied before tracing existed?)",
+                  file=sys.stderr)
+            return 1
+        import glob
+
+        gkey = f"{cls.KIND.lower()}/{namespace}/{name}"
+        # Every place this home's processes write span logs: the plane
+        # itself, this job's gang replicas, and all serving revisions
+        # (a request trace crosses router -> model server there).
+        dirs = [os.path.join(self.cp.home, SPANS_DIRNAME),
+                os.path.join(self.cp.gangs.workdir_for(gkey),
+                             SPANS_DIRNAME)]
+        dirs += sorted(glob.glob(os.path.join(
+            self.cp.home, "serving", "*", SPANS_DIRNAME)))
+        spans = timeline.load_spans(timeline.span_files(dirs), trace_id)
+        if not spans:
+            print(f"error: no spans recorded for trace {trace_id} "
+                  f"(searched {', '.join(dirs)})", file=sys.stderr)
+            return 1
+        if fmt == "chrome":
+            text = json.dumps(timeline.chrome_trace(spans), indent=1)
+        else:
+            text = timeline.render_waterfall(spans)
+        if output:
+            with open(output, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {output} ({len(spans)} spans)")
+        else:
+            print(text)
+        return 0
+
     def top(self) -> int:
         """Live training telemetry (the `kubectl top` analogue): latest
         step/loss/throughput per training job, parsed from each chief
@@ -388,6 +434,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("kind")
     sp.add_argument("name")
 
+    sp = sub.add_parser(
+        "trace", help="cross-process span waterfall for a submission "
+                      "(merged from the plane's and replicas' span logs)")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    sp.add_argument("--format", choices=["ascii", "chrome"],
+                    default="ascii",
+                    help="chrome = Perfetto-loadable trace-event JSON")
+    sp.add_argument("-o", "--output", default="",
+                    help="write to a file instead of stdout")
+
     sub.add_parser("top", help="live training telemetry (latest step/"
                                "loss/throughput per job)")
 
@@ -472,6 +529,17 @@ def _main(argv: Optional[List[str]] = None) -> int:
                      "events", "top")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
+    if os.environ.get("KFX_SERVER") and args.cmd == "trace":
+        # Falling through to a local passive plane would diagnose "not
+        # found" against the LOCAL home while the job lives on the
+        # server — a misleading answer. Span files are host-local; run
+        # the verb where the server's home is.
+        print(f"error: `kfx trace` reads span files from the server's "
+              f"home on its own host and is not supported in "
+              f"KFX_SERVER client mode; run it on the host of "
+              f"{os.environ['KFX_SERVER']} (unset KFX_SERVER there)",
+              file=sys.stderr)
+        return 1
     if args.cmd == "server":
         try:
             from .apiserver import serve_forever
@@ -509,7 +577,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # finished/ownerless gang case is the only one left after the routing
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
-                           "delete", "kill-replica", "top")
+                           "delete", "kill-replica", "top", "trace")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -563,6 +631,9 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return cli.logs(args.kind, args.name, args.namespace, args.replica)
         if args.cmd == "events":
             return cli.events(args.kind, args.name, args.namespace)
+        if args.cmd == "trace":
+            return cli.trace(args.kind, args.name, args.namespace,
+                             args.format, args.output)
         if args.cmd == "top":
             return cli.top()
         if args.cmd == "kill-replica":

@@ -79,12 +79,20 @@ class ControlPlane:
         self.store = ResourceStore(journal_path=journal_path)
         self.gangs = GangManager(os.path.join(self.home, "gangs"))
         self.manager = Manager(self.store)
+        # This process's span log (obs.trace): admission + reconcile +
+        # gang-spawn spans land in <home>/spans/plane-<pid>.jsonl, where
+        # `kfx trace <job>` merges them with the replicas' logs.
+        obs_trace.set_span_sink(
+            os.path.join(self.home, obs_trace.SPANS_DIRNAME), "plane")
         # One registry per plane: reconcile histograms recorded live by
         # the controllers, plus pull-time collectors for state that
         # lives elsewhere (store counts, workqueue depths). Both
         # /metrics formats render from this single snapshot path.
         self.metrics = MetricsRegistry()
         self.metrics.add_collector(self._collect_platform_metrics)
+        # kfx_spans_recorded_total{component}: /metrics proof that span
+        # tracing is flowing in this process.
+        self.metrics.add_collector(obs_trace.collect)
         # Chaos observability: injections export on this plane's
         # /metrics (kfx_chaos_injected_total) and land in the event log
         # stamped with the active trace ID, so a chaos run reads like
@@ -178,14 +186,16 @@ class ControlPlane:
         self.stop()
 
     # -- observability -------------------------------------------------------
-    def _record_chaos_event(self, point: str, rule, trace_id: str) -> None:
+    def _record_chaos_event(self, point: str, rule, trace_id: str,
+                            span_id: str = "") -> None:
         """Chaos-injection listener: every injection in this process
         becomes a store event (kind=Chaos, key=<point>) carrying the
-        trace ID active at injection time."""
+        trace AND span active at injection time — so the injection
+        lands at the right node of the `kfx trace` waterfall."""
         self.store.record_raw_event(
             "Chaos", point, "Warning", "ChaosInjected",
             f"fault injected at {point} (mode={rule.mode or 'error'})",
-            trace_id=trace_id)
+            trace_id=trace_id, span_id=span_id)
 
     def _collect_platform_metrics(self, reg: MetricsRegistry) -> None:
         """Pull-time collector: project live platform state into the
@@ -228,21 +238,39 @@ class ControlPlane:
         # caller's, e.g. the apiserver's X-Kfx-Trace-Id): every new
         # object in the batch shares it, so a job and the resources it
         # arrived with join on one correlation ID. Stored on metadata,
-        # it rides through reconciles into gang envs and events.
+        # it rides through reconciles into gang envs and events. The
+        # admission span is the ROOT of the submission's trace tree —
+        # its ID is annotated onto each new object so reconcile spans
+        # (and everything under them) parent to it.
         trace_id = trace_id or obs_trace.new_trace_id()
         out = []
-        for obj in resources:
-            obj.validate()
-            # Re-applies keep the live object's ID so an unchanged
-            # manifest stays "unchanged" (no resourceVersion churn).
-            existing = self.store.try_get(obj.KIND, obj.name, obj.namespace)
-            inherited = obs_trace.trace_of(existing)
-            if inherited and not obs_trace.trace_of(obj):
-                obj.metadata.annotations[obs_trace.TRACE_ANNOTATION] = \
-                    inherited
-            else:
-                obs_trace.ensure_trace(obj, trace_id)
-            out.append(self.store.apply(obj))
+        with obs_trace.span("admission", trace_id=trace_id,
+                            objects=str(len(resources))) as sp:
+            for obj in resources:
+                obj.validate()
+                # Re-applies keep the live object's IDs so an unchanged
+                # manifest stays "unchanged" (no resourceVersion churn).
+                existing = self.store.try_get(obj.KIND, obj.name,
+                                              obj.namespace)
+                inherited = obs_trace.trace_of(existing)
+                if inherited and not obs_trace.trace_of(obj):
+                    obj.metadata.annotations[obs_trace.TRACE_ANNOTATION] = \
+                        inherited
+                else:
+                    obs_trace.ensure_trace(obj, trace_id)
+                inherited_span = obs_trace.span_of(existing)
+                if inherited_span:
+                    obj.metadata.annotations[obs_trace.SPAN_ANNOTATION] = \
+                        inherited_span
+                elif obs_trace.trace_of(obj) == trace_id:
+                    # Only stamp the admission span onto objects whose
+                    # effective trace IS this admission's trace: a
+                    # pre-span-era re-apply keeps its old trace ID, and
+                    # parenting its reconciles to a span from another
+                    # trace would orphan them in `kfx trace`.
+                    obj.metadata.annotations.setdefault(
+                        obs_trace.SPAN_ANNOTATION, sp.span_id)
+                out.append(self.store.apply(obj))
         return out
 
     def apply_file(self, path: str) -> List[Tuple[Resource, str]]:

@@ -60,10 +60,12 @@ class Controller:
                      message: str) -> None:
         # Events carry the submission's trace ID (resource annotation,
         # falling back to the reconcile-scoped thread-local) so `kfx
-        # events` can join a job's whole story on one correlation ID.
+        # events` can join a job's whole story on one correlation ID —
+        # plus the active span, pinning the event to a waterfall node.
         trace_id = obs_trace.trace_of(obj) or obs_trace.current_trace_id()
         self.store.record_event(obj, etype, reason, message,
-                                trace_id=trace_id)
+                                trace_id=trace_id,
+                                span_id=obs_trace.current_span_id())
         log.info("%s %s: %s %s: %s", self.KIND, obj.key, etype, reason, message)
 
     # -- the reconcile contract -------------------------------------------
@@ -84,18 +86,27 @@ class Controller:
         key = self.queue.get(timeout=0.2)
         if key is None:
             return False
-        # Scope the submission's trace ID onto this worker thread for
-        # the duration of the reconcile, so any event recorded inside
-        # (even against a child object) carries it. The lookup is a
-        # store read — a failure there (chaos store.read, a future
+        # Scope a reconcile SPAN (carrying the submission's trace ID)
+        # onto this worker thread, so any event recorded inside (even
+        # against a child object) carries the trace, and any span
+        # opened inside — the gang-spawn factory runs on this thread —
+        # parents to this reconcile. The reconcile span itself parents
+        # to the admission span annotated on the resource. The lookup
+        # is a store read — a failure there (chaos store.read, a future
         # remote store) is the reconcile's problem to retry, never the
         # worker thread's death: it must not escape before the
         # try-block below, or the key would be stranded in _processing
         # forever with no worker left to drain the queue.
+        trace_id = admission_span = ""
         try:
-            obs_trace.set_trace_id(obs_trace.trace_of(self.get_resource(key)))
+            obj = self.get_resource(key)
+            trace_id = obs_trace.trace_of(obj)
+            admission_span = obs_trace.span_of(obj)
         except Exception:
-            obs_trace.set_trace_id("")
+            pass
+        sp = obs_trace.start_span("reconcile", trace_id=trace_id,
+                                  parent_id=admission_span,
+                                  kind=self.KIND, key=key)
         t0 = time.monotonic()
         outcome = "ok"
         try:
@@ -118,6 +129,9 @@ class Controller:
                 self.queue.add_after(key, result.requeue_after)
         finally:
             self._record_reconcile(time.monotonic() - t0, outcome)
+            sp.attrs["result"] = outcome
+            obs_trace.finish_span(
+                sp, status="error" if outcome == "error" else "ok")
             obs_trace.set_trace_id("")
             self.queue.done(key)
         return True

@@ -61,13 +61,16 @@ class Event:
     """A k8s Event equivalent: recorded against an involved object.
     ``trace_id`` is the submission's correlation ID (obs.trace) when
     the recorder knew it — what lets `kfx events` join a job's story
-    across admission, reconciles and gang launches."""
+    across admission, reconciles and gang launches — and ``span_id``
+    the span active at record time, so an event (e.g. a chaos
+    injection) lands at the right node of the `kfx trace` waterfall."""
 
     __slots__ = ("timestamp", "type", "reason", "message", "kind", "key",
-                 "trace_id")
+                 "trace_id", "span_id")
 
     def __init__(self, kind: str, key: str, etype: str, reason: str, message: str,
-                 timestamp: Optional[str] = None, trace_id: str = ""):
+                 timestamp: Optional[str] = None, trace_id: str = "",
+                 span_id: str = ""):
         self.timestamp = timestamp or utcnow()
         self.type = etype  # "Normal" | "Warning"
         self.reason = reason
@@ -75,6 +78,7 @@ class Event:
         self.kind = kind
         self.key = key
         self.trace_id = trace_id
+        self.span_id = span_id
 
     def to_dict(self) -> Dict[str, str]:
         d = {"timestamp": self.timestamp, "type": self.type,
@@ -82,6 +86,8 @@ class Event:
              "kind": self.kind, "key": self.key}
         if self.trace_id:
             d["traceId"] = self.trace_id
+        if self.span_id:
+            d["spanId"] = self.span_id
         return d
 
 
@@ -108,12 +114,13 @@ class ResourceStore:
         conn.execute(
             "CREATE TABLE IF NOT EXISTS events ("
             " ts TEXT, kind TEXT, key TEXT, type TEXT, reason TEXT,"
-            " message TEXT, trace TEXT)")
-        # Pre-trace journals lack the trace column; upgrade in place.
-        try:
-            conn.execute("ALTER TABLE events ADD COLUMN trace TEXT")
-        except sqlite3.OperationalError:
-            pass  # column already there
+            " message TEXT, trace TEXT, span TEXT)")
+        # Pre-trace/pre-span journals lack the columns; upgrade in place.
+        for col in ("trace", "span"):
+            try:
+                conn.execute(f"ALTER TABLE events ADD COLUMN {col} TEXT")
+            except sqlite3.OperationalError:
+                pass  # column already there
         conn.commit()
         self._journal = conn
         # Recover prior state.
@@ -302,16 +309,19 @@ class ResourceStore:
             return self._events_total
 
     def record_event(self, obj: Resource, etype: str, reason: str,
-                     message: str, trace_id: str = "") -> None:
+                     message: str, trace_id: str = "",
+                     span_id: str = "") -> None:
         self.record_raw_event(obj.KIND, obj.key, etype, reason, message,
-                              trace_id=trace_id)
+                              trace_id=trace_id, span_id=span_id)
 
     def record_raw_event(self, kind: str, key: str, etype: str, reason: str,
-                         message: str, trace_id: str = "") -> None:
+                         message: str, trace_id: str = "",
+                         span_id: str = "") -> None:
         """Record an event not tied to a live Resource object — the
         chaos layer's injections land here (kind="Chaos", key=point) so
         `kfx events` reads a chaos run like any other job."""
-        ev = Event(kind, key, etype, reason, message, trace_id=trace_id)
+        ev = Event(kind, key, etype, reason, message, trace_id=trace_id,
+                   span_id=span_id)
         with self._lock:
             self._events.append(ev)
             self._events_total += 1
@@ -320,9 +330,10 @@ class ResourceStore:
         if self._journal is not None:
             with self._journal_lock:
                 self._journal.execute(
-                    "INSERT INTO events VALUES (?,?,?,?,?,?,?)",
+                    "INSERT INTO events (ts, kind, key, type, reason,"
+                    " message, trace, span) VALUES (?,?,?,?,?,?,?,?)",
                     (ev.timestamp, ev.kind, ev.key, ev.type, ev.reason,
-                     ev.message, ev.trace_id))
+                     ev.message, ev.trace_id, ev.span_id))
                 self._journal.commit()
 
     def events_for(self, kind: str, key: str) -> List[Event]:

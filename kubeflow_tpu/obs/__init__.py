@@ -1,9 +1,12 @@
-"""kfx observability: metrics registry + trace-ID propagation.
+"""kfx observability: metrics registry + distributed span tracing.
 
 ``obs.metrics`` is the process-wide instrument registry every /metrics
-endpoint renders; ``obs.trace`` carries one correlation ID from
-apiserver admission through reconciles, gang environments and serving
-request logs. See docs/observability.md.
+endpoint renders; ``obs.trace`` carries one correlation ID — and a
+Dapper-style span tree — from apiserver admission through reconciles,
+gang environments, runner step windows and serving requests, appending
+finished spans to per-process JSONL logs; ``obs.timeline`` merges those
+logs back into one trace tree for `kfx trace`. See
+docs/observability.md.
 """
 
 from .metrics import (  # noqa: F401
@@ -15,13 +18,24 @@ from .metrics import (  # noqa: F401
     default_registry,
 )
 from .trace import (  # noqa: F401
+    SPAN_ANNOTATION,
+    SPAN_ENV,
+    SPAN_HEADER,
+    SPANS_DIRNAME,
     TRACE_ANNOTATION,
     TRACE_ENV,
     TRACE_HEADER,
+    Span,
+    current_span_id,
     current_trace_id,
     ensure_trace,
+    finish_span,
     new_trace_id,
+    record_span,
+    set_span_sink,
     set_trace_id,
     span,
+    span_of,
+    start_span,
     trace_of,
 )

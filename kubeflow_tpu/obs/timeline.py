@@ -1,0 +1,278 @@
+"""Timeline reconstruction: merge per-process span logs into one trace.
+
+The write side (``obs.trace``) leaves ``<component>-<pid>.jsonl`` files
+under each process's ``spans/`` directory — the control plane's home,
+every gang replica's workdir, each serving revision's workdir. This
+module is the read side: load them, filter to one trace ID, rebuild the
+Dapper-style span tree across processes, compute the critical path, and
+render either an ASCII waterfall (`kfx trace <job>`) or Chrome
+trace-event JSON (`--format=chrome`, loadable in Perfetto /
+chrome://tracing — the same shape TensorBoard's trace viewer consumes).
+"""
+
+from __future__ import annotations
+
+import bisect
+import glob
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Required span-record fields and their types (the on-disk schema
+# scripts/scrape_metrics.py --spans validates).
+_REQUIRED = {"name": str, "trace": str, "span": str, "parent": str,
+             "ts": (int, float), "dur": (int, float), "status": str}
+
+
+def validate_span_record(rec) -> List[str]:
+    """Schema errors for one decoded span record ([] = valid)."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    errors = []
+    for field, typ in _REQUIRED.items():
+        if field not in rec:
+            errors.append(f"missing field {field!r}")
+        elif not isinstance(rec[field], typ):
+            errors.append(f"field {field!r} has type "
+                          f"{type(rec[field]).__name__}")
+    if isinstance(rec.get("dur"), (int, float)) and rec["dur"] < 0:
+        errors.append("negative dur")
+    if isinstance(rec.get("ts"), (int, float)) and rec["ts"] <= 0:
+        errors.append("non-positive ts")
+    if rec.get("status") not in (None, "ok", "error"):
+        errors.append(f"status {rec.get('status')!r} not ok|error")
+    if "attrs" in rec and not isinstance(rec["attrs"], dict):
+        errors.append("attrs is not an object")
+    return errors
+
+
+def validate_span_file(path: str) -> List[str]:
+    """Per-line schema errors for a span JSONL file ([] = valid)."""
+    errors = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {i}: not JSON: {e}")
+                continue
+            for err in validate_span_record(rec):
+                errors.append(f"line {i}: {err}")
+    return errors
+
+
+def span_files(directories: Iterable[str]) -> List[str]:
+    """Every span JSONL file under the given ``spans/`` directories."""
+    out = []
+    for d in directories:
+        out.extend(sorted(glob.glob(os.path.join(d, "*.jsonl"))))
+    return out
+
+
+def load_spans(paths: Iterable[str],
+               trace_id: Optional[str] = None) -> List[Dict]:
+    """Decode span records from files, optionally filtered to one trace,
+    sorted by start time. Malformed lines are skipped (a crashed writer
+    may leave a torn last line; the rest of the timeline still loads)."""
+    spans = []
+    for path in paths:
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if validate_span_record(rec):
+                    continue
+                if trace_id and rec["trace"] != trace_id:
+                    continue
+                spans.append(rec)
+    spans.sort(key=lambda r: (r["ts"], r["ts"] + r["dur"]))
+    return spans
+
+
+# -- tree reconstruction ------------------------------------------------------
+
+def build_tree(spans: List[Dict]) -> List[Dict]:
+    """Attach ``children`` lists (sorted by start) and return the roots:
+    spans whose parent is empty or was never recorded (a parent in a
+    process that died before flushing still leaves its subtree
+    renderable)."""
+    by_id = {rec["span"]: rec for rec in spans}
+    roots = []
+    for rec in spans:
+        rec.setdefault("children", [])
+    for rec in spans:
+        parent = by_id.get(rec["parent"]) if rec["parent"] else None
+        if parent is not None and parent is not rec:
+            parent["children"].append(rec)
+        else:
+            roots.append(rec)
+    for rec in spans:
+        rec["children"].sort(key=lambda r: r["ts"])
+    return roots
+
+
+def trace_bounds(spans: List[Dict]) -> Tuple[float, float]:
+    t0 = min(r["ts"] for r in spans)
+    t1 = max(r["ts"] + r["dur"] for r in spans)
+    return t0, max(t1, t0)
+
+
+def critical_path(spans: List[Dict]) -> Tuple[List[Dict], float, float]:
+    """(path, covered_seconds, wall_seconds): the backward greedy chain
+    through the trace — start from the span that ends last, then
+    repeatedly take the span that starts before the chain head and ends
+    latest. Each hop's contribution is clipped at the previous hop's
+    start, so overlapping spans never double-count; uncovered gaps
+    (queueing, scheduler latency) subtract from coverage. The returned
+    path is in time order.
+
+    O(n log n): spans sorted by start + a prefix argmax-by-end table.
+    Every hop moves the cursor to the picked span's start, so the next
+    search is over a strictly shorter ts-sorted prefix — already-picked
+    spans fall out of the prefix by construction."""
+    if not spans:
+        return [], 0.0, 0.0
+    t0, t1 = trace_bounds(spans)
+    wall = t1 - t0
+    ordered = sorted(spans, key=lambda r: r["ts"])
+    # prefix_best[i] = index (into ordered) of the latest-ending span
+    # among ordered[:i+1], ties broken toward the later start.
+    prefix_best: List[int] = []
+    for i, rec in enumerate(ordered):
+        if not prefix_best:
+            prefix_best.append(0)
+            continue
+        b = ordered[prefix_best[-1]]
+        better = (rec["ts"] + rec["dur"], rec["ts"]) >= \
+            (b["ts"] + b["dur"], b["ts"])
+        prefix_best.append(i if better else prefix_best[-1])
+    starts = [r["ts"] for r in ordered]
+    path: List[Dict] = []
+    covered = 0.0
+    cursor = t1
+    while True:
+        k = bisect.bisect_left(starts, cursor)  # spans with ts < cursor
+        if k <= 0:
+            break
+        best = ordered[prefix_best[k - 1]]
+        end = min(best["ts"] + best["dur"], cursor)
+        if end > best["ts"]:
+            covered += end - best["ts"]
+        path.append(best)
+        cursor = best["ts"]
+    path.reverse()
+    return path, covered, wall
+
+
+# -- ASCII waterfall ----------------------------------------------------------
+
+def _fmt_dur(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1000:.1f}ms"
+
+
+def render_waterfall(spans: List[Dict], width: int = 100) -> str:
+    """The `kfx trace` view: one line per span in tree order — process,
+    name, a bar positioned on the shared time axis, duration. Critical-
+    path spans are marked ``*``; error spans ``!``."""
+    if not spans:
+        return "no spans"
+    t0, t1 = trace_bounds(spans)
+    wall = max(t1 - t0, 1e-9)
+    path, covered, _ = critical_path(spans)
+    on_path = {id(r) for r in path}
+    procs = []
+    for rec in spans:
+        p = rec.get("proc", "?")
+        if p not in procs:
+            procs.append(p)
+
+    roots = build_tree(spans)
+    depths: Dict[int, int] = {}
+
+    def _mark_depth(rec, depth):
+        depths[id(rec)] = depth
+        for child in rec.get("children", []):
+            _mark_depth(child, depth + 1)
+
+    for root in roots:
+        _mark_depth(root, 0)
+    label_w = min(max(len(rec.get("proc", "?")) + 1 + len(rec["name"])
+                      + 2 * depths.get(id(rec), 0)
+                      for rec in spans) + 3, 46)
+    bar_w = max(width - label_w - 12, 20)
+    lines = [f"trace {spans[0]['trace']}  wall={_fmt_dur(wall)}  "
+             f"spans={len(spans)}  processes={len(procs)} "
+             f"({', '.join(procs)})"]
+    lines.append(f"critical path: {_fmt_dur(covered)} covered "
+                 f"({100.0 * covered / wall:.0f}% of wall clock, "
+                 f"{len(path)} spans)")
+    lines.append("-" * (label_w + bar_w + 10))
+
+    def emit(rec, depth):
+        start = int((rec["ts"] - t0) / wall * bar_w)
+        length = max(int(rec["dur"] / wall * bar_w), 1)
+        start = min(start, bar_w - 1)
+        length = min(length, bar_w - start)
+        mark = "!" if rec["status"] == "error" else \
+            ("*" if id(rec) in on_path else " ")
+        label = f"{rec.get('proc', '?')} {'  ' * depth}{rec['name']}"
+        if len(label) > label_w - 1:
+            label = label[:label_w - 2] + "…"
+        bar = " " * start + "█" * length
+        lines.append(f"{label:<{label_w}}{mark}|{bar:<{bar_w}}| "
+                     f"{_fmt_dur(rec['dur'])}")
+        for child in rec.get("children", []):
+            emit(child, depth + 1)
+
+    for root in roots:
+        emit(root, 0)
+    lines.append("")
+    lines.append("critical path (time order, segments >= 1% of wall):")
+    shown = [r for r in path if r["dur"] >= 0.01 * wall]
+    for rec in shown:
+        lines.append(f"  {_fmt_dur(rec['dur']):>9}  "
+                     f"({100.0 * rec['dur'] / wall:4.1f}%)  "
+                     f"{rec.get('proc', '?')}/{rec['name']}")
+    if len(shown) < len(path):
+        lines.append(f"  … plus {len(path) - len(shown)} shorter spans")
+    return "\n".join(lines)
+
+
+# -- Chrome trace-event export ------------------------------------------------
+
+def chrome_trace(spans: List[Dict]) -> Dict:
+    """Chrome trace JSON (the catapult trace-event format, "X" complete
+    events with microsecond ts/dur) — loadable in Perfetto and
+    chrome://tracing. Each source process becomes a trace pid with a
+    process_name metadata event; events are sorted by ts."""
+    events = []
+    procs: Dict[str, int] = {}
+    for rec in sorted(spans, key=lambda r: r["ts"]):
+        proc = rec.get("proc", "?")
+        pid = procs.setdefault(proc, len(procs) + 1)
+        args = {"trace": rec["trace"], "span": rec["span"],
+                "parent": rec["parent"], "status": rec["status"]}
+        args.update(rec.get("attrs") or {})
+        events.append({
+            "name": rec["name"], "ph": "X", "cat": "kfx",
+            "ts": int(rec["ts"] * 1e6), "dur": int(rec["dur"] * 1e6),
+            "pid": pid, "tid": rec.get("pid", pid),
+            "args": args,
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": proc}} for proc, pid in procs.items()]
+    return {"displayTimeUnit": "ms", "traceEvents": meta + events}

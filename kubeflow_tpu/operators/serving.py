@@ -85,6 +85,10 @@ class _Revision:
             from ..runtime.gang import expand_k8s_refs
 
             env = inject_pythonpath(dict(os.environ))
+            # Span env BEFORE the container's own: a stale inherited
+            # KFX_WORKDIR/KFX_COMPONENT must not misroute this
+            # replica's span log, but an explicit container env wins.
+            self._span_env(env)
             for e in self.container.get("env") or []:
                 env[str(e.get("name"))] = str(e.get("value"))
             env["KFX_PORT"] = env["PORT"] = str(port)
@@ -134,12 +138,22 @@ class _Revision:
                          f"--baseline={self.graph.get('baseline', 0.0)}"]
         os.makedirs(self.workdir, exist_ok=True)
         env = inject_pythonpath(dict(os.environ))
+        self._span_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
                                 stderr=subprocess.STDOUT)
         logf.close()
         self.replicas.append(_Replica(proc=proc, port=port))
+
+    def _span_env(self, env: dict) -> None:
+        """Point the replica's span log (obs.trace auto-sink) at this
+        revision's workdir, labelled by revision + replica ordinal —
+        the model-server leg of the `kfx trace` timeline. Assigned
+        unconditionally: a value inherited from the operator's own
+        environment is stale, never authoritative."""
+        env["KFX_WORKDIR"] = self.workdir
+        env["KFX_COMPONENT"] = f"{self.name}-{len(self.replicas)}"
 
     def reap_and_respawn(self, want: int) -> None:
         """Keep `want` replicas alive; dead ones are replaced individually."""

@@ -179,7 +179,7 @@ class TrainingControllerBase(Controller):
             # chief's wins as it decides success anyway).
             chief = job.chief_replica_type()
             rp = job.replica_specs()[chief].restart_policy
-            from ..obs.trace import trace_of
+            from ..obs.trace import current_span_id, trace_of
 
             return G.Gang(
                 name=job.name,
@@ -194,6 +194,10 @@ class TrainingControllerBase(Controller):
                 on_change=lambda g: ctrl.queue.add(key),
                 restart_env_hook=env_hook,
                 trace_id=trace_of(job),
+                # The factory runs on the reconcile worker thread, so
+                # the open span here is the creating reconcile — the
+                # node every gang.spawn attempt hangs under.
+                parent_span_id=current_span_id(),
             )
 
         return self.gangs.ensure(gkey, factory)

@@ -25,6 +25,11 @@ import os
 import sys
 import time
 
+# Wall-clock anchor for the runner.init span: captured at module import
+# (before the heavy jax import in main), so the span covers interpreter
+# + backend startup the spawn span's end otherwise leaves unaccounted.
+_PROC_START = time.time()
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="kfx JAX training runner")
@@ -102,60 +107,79 @@ def enable_compile_cache() -> None:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    from kubeflow_tpu.obs import trace as obs_trace
     from kubeflow_tpu.runtime.lifetime import install_parent_watch
 
     install_parent_watch()
-    initialize_distributed()
+    # runner.init: interpreter start -> backend ready (rendezvous, jax
+    # import, XLA client, model/state init, checkpoint restore — the
+    # Checkpointer constructor pays the multi-second orbax import, so
+    # it belongs inside, not as a waterfall gap). Backdated to
+    # _PROC_START so the timeline shows the real distance between spawn
+    # and first step; the context manager emits it status=error when a
+    # startup failure unwinds, so a failed attempt's trace still shows
+    # where its init died.
+    with obs_trace.span("runner.init", ts=_PROC_START) as init_sp:
+        with obs_trace.span("rendezvous.wait") as rdv_sp:
+            rdv_sp.attrs["processes"] = os.environ.get(
+                "KFX_NUM_PROCESSES", "1")
+            initialize_distributed()
 
-    import jax  # after distributed init
+        import jax  # after distributed init
 
-    enable_compile_cache()
+        enable_compile_cache()
 
-    from kubeflow_tpu.profiling import maybe_start_profiler_server
+        from kubeflow_tpu.profiling import maybe_start_profiler_server
 
-    maybe_start_profiler_server()
+        maybe_start_profiler_server()
 
-    from kubeflow_tpu.data import get_dataset
-    from kubeflow_tpu.models import get_model
-    from kubeflow_tpu.training import Checkpointer, TrainLoop
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.training import Checkpointer, TrainLoop
 
-    rank = jax.process_index()
-    world = jax.process_count()
-    is_chief = rank == 0
+        rank = jax.process_index()
+        world = jax.process_count()
+        is_chief = rank == 0
 
-    def log(msg: str) -> None:
-        # All ranks print (per-replica logs); collector reads the chief's.
-        print(msg, flush=True)
+        def log(msg: str) -> None:
+            # All ranks print (per-replica logs); collector reads the
+            # chief's.
+            print(msg, flush=True)
 
-    # The gang exports the submission's trace ID (obs.trace); echoing it
-    # makes this log joinable with `kfx events` on one correlation ID.
-    trace_id = os.environ.get("KFX_TRACE_ID", "")
-    log(f"runner_start model={args.model} dataset={args.dataset} "
-        f"rank={rank} world={world} devices={jax.device_count()} "
-        f"platform={jax.devices()[0].platform}"
-        + (f" trace={trace_id}" if trace_id else ""))
+        # The gang exports the submission's trace ID (obs.trace);
+        # echoing it makes this log joinable with `kfx events` on one
+        # correlation ID.
+        trace_id = os.environ.get("KFX_TRACE_ID", "")
+        log(f"runner_start model={args.model} dataset={args.dataset} "
+            f"rank={rank} world={world} devices={jax.device_count()} "
+            f"platform={jax.devices()[0].platform}"
+            + (f" trace={trace_id}" if trace_id else ""))
 
-    dataset = get_dataset(args.dataset, split="train", seed=args.seed)
-    model = get_model(args.model, num_classes=dataset.num_classes)
-    loop = TrainLoop(model, learning_rate=args.learning_rate,
-                     optimizer=args.optimizer, weight_decay=args.weight_decay,
-                     seed=args.seed)
-    state = loop.init_state(dataset.shape)
+        dataset = get_dataset(args.dataset, split="train", seed=args.seed)
+        model = get_model(args.model, num_classes=dataset.num_classes)
+        loop = TrainLoop(model, learning_rate=args.learning_rate,
+                         optimizer=args.optimizer,
+                         weight_decay=args.weight_decay, seed=args.seed)
+        state = loop.init_state(dataset.shape)
+        init_sp.attrs.update(model=args.model, rank=str(rank),
+                             world=str(world),
+                             platform=jax.devices()[0].platform)
 
-    ckpt = None
-    start_step = 0
-    ckpt_dir = os.environ.get("KFX_CHECKPOINT_DIR", "")
-    if ckpt_dir and not args.no_checkpoint:
-        ckpt = Checkpointer(ckpt_dir, save_every=args.checkpoint_every,
-                            keep=args.keep_checkpoints)
-        restored = ckpt.restore_latest(
-            state, legacy_layouts=loop.legacy_checkpoint_layouts(state))
-        if restored is not None:
-            # CLI hyperparams override the checkpointed ones (the
-            # checkpoint carries lr in opt_state via inject_hyperparams).
-            state = loop.reapply_hyperparams(restored)
-            start_step = int(jax.device_get(state.step))
-            log(f"resumed_from_checkpoint step={start_step}")
+        ckpt = None
+        start_step = 0
+        ckpt_dir = os.environ.get("KFX_CHECKPOINT_DIR", "")
+        if ckpt_dir and not args.no_checkpoint:
+            ckpt = Checkpointer(ckpt_dir, save_every=args.checkpoint_every,
+                                keep=args.keep_checkpoints)
+            restored = ckpt.restore_latest(
+                state, legacy_layouts=loop.legacy_checkpoint_layouts(state))
+            if restored is not None:
+                # CLI hyperparams override the checkpointed ones (the
+                # checkpoint carries lr in opt_state via
+                # inject_hyperparams).
+                state = loop.reapply_hyperparams(restored)
+                start_step = int(jax.device_get(state.step))
+                log(f"resumed_from_checkpoint step={start_step}")
 
     t_start = time.time()
     t_last = t_start
@@ -240,6 +264,15 @@ def main(argv=None) -> int:
     if not device_capable:
         _threading.Thread(target=_prefetch, daemon=True).start()
     chunks = _plan_chunks() if device_capable else None
+    # Span bookkeeping: the FIRST dispatch (which pays the XLA compile
+    # — also after a checkpoint resume: the jit cache is per-process
+    # and the persistent cache is gated off on CPU) becomes an
+    # `xla.compile` span; each log window after it becomes a
+    # `train.window` span — the waterfall's answer to "where did the
+    # steps go" without a span per step.
+    compile_recorded = False
+    win_start = time.time()
+    win_step0 = start_step
     while step < args.steps:
         if step == args.fail_at_step:
             if ckpt is not None:
@@ -253,6 +286,7 @@ def main(argv=None) -> int:
         if device_capable:
             s, k = next(chunks)
             assert s == step, f"chunk desync: {s} != {step}"
+            t_dispatch = time.time()
             state, loss, acc = loop.train_steps_device(
                 state, batch_fn, args.batch_size, s, k)
         else:
@@ -261,12 +295,22 @@ def main(argv=None) -> int:
                 raise RuntimeError("input prefetch thread failed") from got
             s, k, (images, labels) = got
             assert s == step, f"prefetch desync: {s} != {step}"
+            # Timed AFTER the queue get: the first chunk's prefetch wait
+            # is input-pipeline latency, and the xla.compile span below
+            # must not absorb it.
+            t_dispatch = time.time()
             if k <= 1:
                 state, loss, acc = loop.train_step(state, images, labels)
             else:
                 state, loss, acc = loop.train_steps(state, images, labels)
         step += k
         now = time.time()
+        if not compile_recorded:
+            obs_trace.record_span("xla.compile", t_dispatch,
+                                  now - t_dispatch, start_step=str(s),
+                                  steps=str(k), model=args.model)
+            compile_recorded = True
+            win_start, win_step0 = now, step
         if step % args.log_every == 0 or step == args.steps:
             # Divide by the steps actually elapsed since the last log —
             # the final partial interval (steps not a multiple of
@@ -279,6 +323,12 @@ def main(argv=None) -> int:
                 f"step_time={dt:.4f} examples_per_sec={eps:.1f}")
             t_last = now
             last_log_step = step
+            if step > win_step0:
+                obs_trace.record_span(
+                    "train.window", win_start, now - win_start,
+                    start_step=str(win_step0), end_step=str(step),
+                    examples_per_sec=f"{eps:.1f}")
+            win_start, win_step0 = now, step
         if ckpt is not None and ckpt.maybe_save(step, state):
             # Fault point: worker crash at a checkpoint boundary — the
             # deterministic injected-kill (chaos plans schedule it by
@@ -295,10 +345,11 @@ def main(argv=None) -> int:
                 os._exit(137)
 
     # Final eval on a fixed set (sharded across processes).
-    eval_ds = get_dataset(args.dataset, split="eval", seed=args.seed)
-    images, labels = eval_ds.eval_arrays(args.eval_samples)
-    shard = slice(rank, None, world)
-    metrics = loop.evaluate(state, images[shard], labels[shard])
+    with obs_trace.span("runner.eval", samples=str(args.eval_samples)):
+        eval_ds = get_dataset(args.dataset, split="eval", seed=args.seed)
+        images, labels = eval_ds.eval_arrays(args.eval_samples)
+        shard = slice(rank, None, world)
+        metrics = loop.evaluate(state, images[shard], labels[shard])
     wall = time.time() - t_start
     log(f"train_done steps={args.steps} wall_seconds={wall:.2f}")
     log(f"loss={metrics['loss']:.6f}")
@@ -310,8 +361,10 @@ def main(argv=None) -> int:
 
     if args.export_dir and is_chief:
         from kubeflow_tpu.serving.export import export_params
-        export_params(args.export_dir, args.model, dataset.shape,
-                      dataset.num_classes, state)
+
+        with obs_trace.span("runner.export", dir=args.export_dir):
+            export_params(args.export_dir, args.model, dataset.shape,
+                          dataset.num_classes, state)
         log(f"exported_model dir={args.export_dir}")
     return 0
 

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 from .. import chaos
 from ..api import training as T
+from ..obs import trace as obs_trace
 from . import lifetime
 
 PENDING = "Pending"
@@ -126,6 +127,7 @@ class Gang:
         restart_env_hook: Optional[
             Callable[[int], Dict[str, Dict[str, str]]]] = None,
         trace_id: str = "",
+        parent_span_id: str = "",
     ):
         self.name = name
         self.specs = specs
@@ -140,7 +142,12 @@ class Gang:
         # Submission correlation ID (obs.trace): exported to every
         # member as KFX_TRACE_ID and stamped on the log attempt header,
         # so runner output joins the control plane's events on one ID.
+        # parent_span_id is the reconcile span that created this gang;
+        # each attempt's gang.spawn span hangs under it, and members
+        # inherit the spawn span via KFX_SPAN_ID so their own spans
+        # join the same trace tree across the process boundary.
         self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
         # Called with the attempt number before each (re)launch; returns
         # env overrides keyed by replica id — used to re-allocate
         # rendezvous ports so a restart (or a port-collision crash) always
@@ -211,6 +218,14 @@ class Gang:
             overrides = self.restart_env_hook(attempt) or {}
         launched: Dict[str, subprocess.Popen] = {}
         preexec = lifetime.make_child_preexec(os.getpid())
+        # One gang.spawn span per attempt: runs on the supervisor
+        # thread, so trace/parent come from the gang's stored context,
+        # not thread-locals. Members inherit its ID (KFX_SPAN_ID) so
+        # every runner span lands under this node of the trace tree.
+        spawn_sp = obs_trace.start_span(
+            "gang.spawn", trace_id=self.trace_id,
+            parent_id=self.parent_span_id, gang=self.name,
+            attempt=str(attempt), members=str(len(self.specs)))
         try:
             for spec in self.specs:
                 # Fault point: member spawn failure — must take the
@@ -225,6 +240,11 @@ class Gang:
                 env[lifetime.PARENT_FD_ENV] = str(self._keepalive_r)
                 if self.trace_id:
                     env.setdefault("KFX_TRACE_ID", self.trace_id)
+                env[obs_trace.SPAN_ENV] = spawn_sp.span_id
+                if obs_trace.COMPONENT_ENV not in spec.env:
+                    # The replica id labels the member's span log (a
+                    # stale inherited value must not win over it).
+                    env[obs_trace.COMPONENT_ENV] = spec.id
                 argv = [expand_k8s_refs(a, env) for a in spec.argv]
                 logf = open(self.log_path(spec.id), "ab")
                 trace_tag = f" trace={self.trace_id}" if self.trace_id else ""
@@ -240,6 +260,7 @@ class Gang:
                 logf.close()  # child holds the fd
                 launched[spec.id] = p
         except Exception as e:  # spawn failure -> tear down the partial gang
+            obs_trace.finish_span(spawn_sp, status="error")
             for p in launched.values():
                 _terminate(p, self.GRACE_SECONDS)
             with self._lock:
@@ -247,6 +268,7 @@ class Gang:
                     self._status.replicas[rid] = ReplicaStatus(state=FAILED)
                 self._status.message = f"spawn failed: {e}"
             return False
+        obs_trace.finish_span(spawn_sp)
         now = time.time()
         with self._lock:
             self._procs = launched

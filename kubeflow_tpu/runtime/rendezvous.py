@@ -35,13 +35,23 @@ def apply_startup_chaos() -> float:
     call this before ``jax.distributed.initialize`` (workers inherit
     KFX_CHAOS through the gang env), so an injected delay exercises the
     coordinator's tolerance for late joiners — the barrier must wait,
-    not split-brain. Returns the seconds slept."""
+    not split-brain. Returns the seconds slept. An injected sleep is
+    recorded as a ``rendezvous.chaos`` span so the straggler shows up
+    on the `kfx trace` waterfall exactly where the gap is."""
+    import time
+
     from .. import chaos
+    from ..obs import trace as obs_trace
 
     rtype = os.environ.get(ENV_REPLICA_TYPE, "")
     index = os.environ.get(ENV_REPLICA_INDEX, "")
-    return chaos.maybe_delay("rendezvous.delay",
-                             target=f"{rtype.lower()}-{index}")
+    t0 = time.time()
+    slept = chaos.maybe_delay("rendezvous.delay",
+                              target=f"{rtype.lower()}-{index}")
+    if slept > 0:
+        obs_trace.record_span("rendezvous.chaos", t0, slept,
+                              replica=f"{rtype.lower()}-{index}")
+    return slept
 
 
 def flatten_replicas(replica_counts: List[Tuple[str, int]]) -> List[Tuple[str, int, int]]:

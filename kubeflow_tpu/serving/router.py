@@ -20,6 +20,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import chaos
+from ..obs import trace as obs_trace
+from ..obs.trace import SPAN_HEADER, TRACE_HEADER
 
 # RFC 7230 §6.1: connection-scoped headers a proxy must not forward.
 _HOP_BY_HOP = frozenset({
@@ -235,7 +237,10 @@ class Router:
         a connection failure or 5xx retries EXACTLY ONCE on a different
         backend of the same set (predict traffic is idempotent — the
         retry turns one sick replica into a latency blip, not an error
-        the client must handle)."""
+        the client must handle). The whole relay runs under a
+        router.dispatch span adopting the caller's trace/span headers;
+        its ID is forwarded as X-Kfx-Span-Id so the model server's
+        serving.predict span parents to this hop."""
         data = b""
         if has_body:
             length = int(h.headers.get("Content-Length", 0))
@@ -243,22 +248,31 @@ class Router:
         attempt_backend = backend
         last: Optional[Tuple[int, List[Tuple[str, str]], bytes]] = None
         last_err: Optional[OSError] = None
-        for attempt in range(2):
-            try:
-                last = self._attempt(h, attempt_backend, data)
-                last_err = None
-            except OSError as e:
-                last, last_err = None, e
-            if last is not None and last[0] < 500:
-                chosen.report_success(attempt_backend)
+        sp = obs_trace.start_span(
+            "router.dispatch", trace_id=h.headers.get(TRACE_HEADER, ""),
+            parent_id=h.headers.get(SPAN_HEADER, ""), backend=backend)
+        try:
+            for attempt in range(2):
+                try:
+                    last = self._attempt(h, attempt_backend, data,
+                                         span_id=sp.span_id)
+                    last_err = None
+                except OSError as e:
+                    last, last_err = None, e
+                if last is not None and last[0] < 500:
+                    chosen.report_success(attempt_backend)
+                    break
+                chosen.report_failure(attempt_backend)
+                if attempt == 0:
+                    alt = chosen.pick(exclude=(attempt_backend,))
+                    if alt is not None and alt != attempt_backend:
+                        attempt_backend = alt
+                        sp.attrs["retried_on"] = alt
+                        continue
                 break
-            chosen.report_failure(attempt_backend)
-            if attempt == 0:
-                alt = chosen.pick(exclude=(attempt_backend,))
-                if alt is not None and alt != attempt_backend:
-                    attempt_backend = alt
-                    continue
-            break
+        finally:
+            ok = last is not None and last[0] < 500
+            obs_trace.finish_span(sp, status="ok" if ok else "error")
         if last is not None:
             status, headers, payload = last
             h.send_response(status)
@@ -279,8 +293,8 @@ class Router:
         h.end_headers()
         h.wfile.write(body)
 
-    def _attempt(self, h, backend: str,
-                 data: bytes) -> Tuple[int, List[Tuple[str, str]], bytes]:
+    def _attempt(self, h, backend: str, data: bytes, span_id: str = ""
+                 ) -> Tuple[int, List[Tuple[str, str]], bytes]:
         """One backend round trip: (status, headers, payload). Raises
         OSError on connection-level failure (including the injected
         ``serving.request`` fault — latency with mode=delay, else a
@@ -299,6 +313,9 @@ class Router:
                     continue
                 # RFC 7230 §3.2.2: repeated fields combine comma-joined.
                 fwd[k] = f"{fwd[k]}, {v}" if k in fwd else v
+            if span_id:
+                # The backend parents to THIS hop, not to our caller.
+                fwd[SPAN_HEADER] = span_id
             conn.request(h.command, h.path, body=data or None, headers=fwd)
             resp = conn.getresponse()
             return resp.status, list(resp.getheaders()), resp.read()

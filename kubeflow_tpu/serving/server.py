@@ -34,8 +34,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import chaos
+from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry
-from ..obs.trace import TRACE_HEADER
+from ..obs.trace import SPAN_HEADER, TRACE_HEADER
 
 request_log = logging.getLogger("kfx.serving")
 
@@ -274,7 +275,12 @@ class MicroBatcher:
         self.max_batch_size = max_batch_size
         self.max_latency_s = max_latency_ms / 1000.0
         self.reply_timeout_s = reply_timeout_s
-        self._q: "queue.Queue[Tuple[np.ndarray, bool, queue.Queue]]" = \
+        # Queue entries carry the submitting request's (trace, span)
+        # context: the batcher executes on ITS worker thread, where
+        # current_trace_id() would otherwise be empty — predictions (and
+        # chaos draws, and the flush span) must still correlate to the
+        # requests that triggered them.
+        self._q: "queue.Queue[Tuple[np.ndarray, bool, queue.Queue, str, str]]" = \
             queue.Queue()
         self._stop = threading.Event()
         # Orders enqueue against close(): once close() sets _stop under
@@ -309,16 +315,24 @@ class MicroBatcher:
             # The whole per-batch body is inside the try: a bad request
             # (e.g. mismatched instance shapes failing the concatenate)
             # must reply an error to every caller in the batch, never kill
-            # the batcher thread.
+            # the batcher thread. The flush runs under a batcher.flush
+            # span restored from the OLDEST request's captured context
+            # (the one whose latency deadline forced the flush), so the
+            # device dispatch lands in that request's trace tree and
+            # current_trace_id() is correct inside predict.
             try:
                 want_probs = any(b[1] for b in batch)
                 stacked = np.concatenate([b[0] for b in batch], 0)
-                result = self.predictor.predict(stacked,
-                                                probabilities=want_probs)
+                with obs_trace.span("batcher.flush", trace_id=first[3],
+                                    parent_id=first[4],
+                                    requests=str(len(batch)),
+                                    instances=str(stacked.shape[0])):
+                    result = self.predictor.predict(
+                        stacked, probabilities=want_probs)
                 preds = result["predictions"]
                 probs = result.get("probabilities")
                 off = 0
-                for arr, wp, reply in batch:
+                for arr, wp, reply, _, _ in batch:
                     n = arr.shape[0]
                     out = {"predictions": preds[off:off + n]}
                     if wp and probs is not None:
@@ -326,7 +340,7 @@ class MicroBatcher:
                     reply.put(out)
                     off += n
             except Exception as e:  # propagate per-request
-                for _, _, reply in batch:
+                for _, _, reply, _, _ in batch:
                     reply.put(e)
 
     def predict(self, instances: np.ndarray,
@@ -343,7 +357,11 @@ class MicroBatcher:
                 # A racing predict after close() must fail fast, not sit
                 # on the queue until reply_timeout_s with no worker left.
                 raise RuntimeError("batcher is closed")
-            self._q.put((instances, probabilities, reply))
+            # Capture the caller's trace context here, on the request
+            # thread — the worker thread restores it around execution.
+            self._q.put((instances, probabilities, reply,
+                         obs_trace.current_trace_id(),
+                         obs_trace.current_span_id()))
         try:
             out = reply.get(timeout=self.reply_timeout_s)
         except queue.Empty:
@@ -365,7 +383,7 @@ class MicroBatcher:
             t.join(timeout=5.0)
         while True:
             try:
-                _, _, reply = self._q.get_nowait()
+                reply = self._q.get_nowait()[2]
             except queue.Empty:
                 break
             reply.put(RuntimeError("batcher closed while request queued"))
@@ -416,6 +434,10 @@ class ModelServer:
                 if trace:
                     # Echo the caller's correlation ID (obs.trace flow).
                     self.send_header(TRACE_HEADER, trace)
+                span_id = getattr(self, "_span_id", "")
+                if span_id:
+                    # This request's span, so callers can parent to it.
+                    self.send_header(SPAN_HEADER, span_id)
                 self.end_headers()
                 self.wfile.write(body)
                 self._last_code = code
@@ -452,8 +474,10 @@ class ModelServer:
                       sum(1 for p in self.predictors.values() if p.ready))
         # Chaos injections in THIS process (kfx_chaos_injected_total):
         # a chaos serving run exposes its fault counts on the same
-        # /metrics a scraper already reads.
+        # /metrics a scraper already reads. Ditto span-log writes
+        # (kfx_spans_recorded_total) — proof request tracing is flowing.
         chaos.collect(reg)
+        obs_trace.collect(reg)
 
     def _latency_summary(self) -> Dict[str, Dict[str, Optional[float]]]:
         """Server-reported per-model p50/p99 (ms) from the request
@@ -551,18 +575,40 @@ class ModelServer:
         h._last_code = 0
         if path.startswith("/v1/models/") and path.endswith(":generate"):
             name = path[len("/v1/models/"):-len(":generate")]
+            sp = self._request_span(h, "serving.generate", name)
             try:
                 return self._handle_generate(h, name)
             finally:
                 self._finish_request(h, name, "generate", t0)
+                self._finish_span(h, sp)
         if not (path.startswith("/v1/models/") and path.endswith(":predict")):
             h._send(404, {"error": f"no route {path}"})
             return
         name = path[len("/v1/models/"):-len(":predict")]
+        sp = self._request_span(h, "serving.predict", name)
         try:
             self._handle_predict(h, name)
         finally:
             self._finish_request(h, name, "predict", t0)
+            self._finish_span(h, sp)
+
+    @staticmethod
+    def _request_span(h, name: str, model: str):
+        """Open the request's span, adopting the caller's trace/span
+        headers (the router forwards its dispatch span) so this hop
+        joins the caller's trace tree across the HTTP boundary."""
+        sp = obs_trace.start_span(
+            name, trace_id=h.headers.get(TRACE_HEADER, ""),
+            parent_id=h.headers.get(SPAN_HEADER, ""), model=model)
+        h._span_id = sp.span_id  # echoed back by _send_text
+        return sp
+
+    @staticmethod
+    def _finish_span(h, sp) -> None:
+        code = getattr(h, "_last_code", 0)
+        obs_trace.finish_span(
+            sp, status="ok" if 200 <= code < 400 else "error")
+        h._span_id = ""
 
     def _handle_predict(self, h, name: str) -> None:
         p = self.predictors.get(name)

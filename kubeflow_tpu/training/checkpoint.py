@@ -11,6 +11,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 
 from .. import chaos
+from ..obs import trace as obs_trace
 
 # orbax (via google.cloud.logging) costs ~3.4s of import time — a fifth
 # of a whole no-checkpoint HPO trial on a 1-core host. Loaded on first
@@ -102,16 +103,18 @@ class Checkpointer:
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         if not force and (self.save_every <= 0 or step % self.save_every != 0):
             return False
-        self.manager.save(step, args=ocp.args.StandardSave(state))
-        # Fault point: corrupt THIS save after it commits (a torn write
-        # that still looks finalized). Wait first — damaging a write
-        # still in flight would race the async committer, not model a
-        # crash after commit.
-        if chaos.draw("checkpoint.save", target=f"step-{step}") is not None:
-            self.manager.wait_until_finished()
-            n = corrupt_step_dir(self.directory, step)
-            print(f"chaos_corrupt_checkpoint step={step} files={n}",
-                  flush=True)
+        with obs_trace.span("checkpoint.save", step=str(step)):
+            self.manager.save(step, args=ocp.args.StandardSave(state))
+            # Fault point: corrupt THIS save after it commits (a torn
+            # write that still looks finalized). Wait first — damaging a
+            # write still in flight would race the async committer, not
+            # model a crash after commit.
+            if chaos.draw("checkpoint.save",
+                          target=f"step-{step}") is not None:
+                self.manager.wait_until_finished()
+                n = corrupt_step_dir(self.directory, step)
+                print(f"chaos_corrupt_checkpoint step={step} files={n}",
+                      flush=True)
         return True
 
     def _reload_manager(self) -> None:
@@ -167,6 +170,11 @@ class Checkpointer:
             recoverable store hiccup would let the keep-rotation delete
             good checkpoints.
         """
+        with obs_trace.span("checkpoint.restore") as restore_sp:
+            return self._restore_latest(restore_sp, target, legacy_layouts)
+
+    def _restore_latest(self, restore_sp, target: Any,
+                        legacy_layouts: Any = ()) -> Optional[Any]:
         chaos.fail_or_delay("checkpoint.restore", OSError,
                             f"restore from {self.directory}")
         steps = sorted(self.manager.all_steps(), reverse=True)
@@ -206,6 +214,8 @@ class Checkpointer:
                     print(f"checkpoint_migrated step={step} layout={name}",
                           flush=True)
                     restored = upgrade(restored)
+                restore_sp.attrs.update(step=str(step),
+                                        quarantined=str(len(failed)))
                 return restored
             failed.append((step, ", ".join(tried)))
             all_structural = all_structural and step_structural

@@ -98,15 +98,25 @@ class TrainLoop:
 
     # -- state -------------------------------------------------------------
     def init_state(self, sample_shape: Tuple[int, ...]) -> TrainState:
-        rng = jax.random.PRNGKey(self.seed)
-        dummy = jnp.zeros((1,) + tuple(sample_shape), jnp.float32)
-        variables = self.model.init(rng, dummy, train=False)
-        params = variables["params"]
-        batch_stats = variables.get("batch_stats", {})
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                           batch_stats=batch_stats,
-                           opt_state=self.tx.init(params))
-        return jax.device_put(state, self.repl)
+        def init() -> TrainState:
+            rng = jax.random.PRNGKey(self.seed)
+            dummy = jnp.zeros((1,) + tuple(sample_shape), jnp.float32)
+            variables = self.model.init(rng, dummy, train=False)
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              batch_stats=batch_stats,
+                              opt_state=self.tx.init(params))
+
+        # Materialize the state already replicated (out_shardings), not
+        # via a host-side device_put: putting UNCOMMITTED host arrays
+        # onto a cross-process sharding makes jax broadcast-and-assert
+        # every leaf across hosts (multihost_utils.assert_equal) — a
+        # gloo storm right after rendezvous that intermittently dies
+        # with mismatched-message errors. Inside jit every process
+        # computes the identical state deterministically and no
+        # cross-host traffic happens at all.
+        return jax.jit(init, out_shardings=self.repl)()
 
     def reapply_hyperparams(self, state: TrainState) -> TrainState:
         """Re-assert THIS loop's configured hyperparams over a restored

@@ -1,15 +1,18 @@
-"""Scrape-validate /metrics endpoints: fetch each URL and fail on any
-malformed exposition line (bad metric name, unescaped label, garbage
-value). CI runs the same validator in-process (tests/test_obs.py), so a
-format regression in any metric producer is caught in tier-1 before a
-real Prometheus scrape would drop the whole endpoint.
+"""Scrape-validate observability surfaces: /metrics endpoints and span
+logs. For each URL, fetch and fail on any malformed exposition line
+(bad metric name, unescaped label, garbage value); for each ``--spans``
+argument (a span JSONL file, or a ``spans/`` directory of them),
+validate every record against the obs.timeline schema. CI runs the same
+validators in-process (tests/test_obs.py, tests/test_trace.py), so a
+format regression in any producer is caught in tier-1 before a real
+Prometheus scrape — or a `kfx trace` reconstruction — would drop it.
 
 Usage:
-    python scripts/scrape_metrics.py [URL ...]
+    python scripts/scrape_metrics.py [URL ...] [--spans PATH ...]
 
-With no URLs, the control plane advertised by the current kfx home's
-server marker (``kfx server``) is scraped. A URL without a path gets
-``/metrics`` appended.
+With no URLs and no --spans, the control plane advertised by the
+current kfx home's server marker (``kfx server``) is scraped. A URL
+without a path gets ``/metrics`` appended.
 """
 
 import os
@@ -62,6 +65,50 @@ def check_endpoint(url: str) -> int:
     return 0
 
 
+def check_span_log(path: str) -> int:
+    """Validate one span JSONL file (or every ``*.jsonl`` in a
+    directory) against the obs.timeline record schema; prints a verdict
+    per file. Returns the number of problems found."""
+    import json
+
+    from kubeflow_tpu.obs.timeline import span_files, validate_span_record
+
+    paths = span_files([path]) if os.path.isdir(path) else [path]
+    if not paths:
+        print(f"FAIL {path}: no span files")
+        return 1
+    problems = 0
+    for p in paths:
+        errors, records = [], 0
+        try:
+            with open(p) as f:
+                for i, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    records += 1
+                    try:
+                        rec = json.loads(line)
+                    except ValueError as e:
+                        errors.append(f"line {i}: not JSON: {e}")
+                        continue
+                    errors += [f"line {i}: {err}"
+                               for err in validate_span_record(rec)]
+        except OSError as e:
+            print(f"FAIL {p}: unreadable: {e}")
+            problems += 1
+            continue
+        if errors:
+            print(f"FAIL {p}: {len(errors)} malformed record(s), "
+                  f"{records} record(s)")
+            for err in errors:
+                print(f"  {err}")
+            problems += len(errors)
+        else:
+            print(f"ok   {p}: {records} span record(s)")
+    return problems
+
+
 def default_urls() -> list:
     """The apiserver advertised by this home's server marker, if any."""
     from kubeflow_tpu.apiserver import live_server_url
@@ -72,8 +119,21 @@ def default_urls() -> list:
 
 
 def main(argv=None) -> int:
-    urls = list(argv if argv is not None else sys.argv[1:])
-    if not urls:
+    args = list(argv if argv is not None else sys.argv[1:])
+    urls, span_paths = [], []
+    i = 0
+    while i < len(args):
+        if args[i] == "--spans":
+            if i + 1 >= len(args):
+                print("--spans needs a file or directory",
+                      file=sys.stderr)
+                return 2
+            span_paths.append(args[i + 1])
+            i += 2
+        else:
+            urls.append(args[i])
+            i += 1
+    if not urls and not span_paths:
         urls = default_urls()
         if not urls:
             print("no URLs given and no live `kfx server` marker found "
@@ -81,6 +141,7 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     failures = sum(check_endpoint(u) for u in urls)
+    failures += sum(check_span_log(p) for p in span_paths)
     return 1 if failures else 0
 
 

@@ -164,14 +164,22 @@ class TestStoreFaults:
     def test_store_fault_lands_in_events_and_metrics(self, tmp_path):
         from kubeflow_tpu.controlplane import ControlPlane
         from kubeflow_tpu.core.store import StoreFault
+        from kubeflow_tpu.obs import trace as obs_trace
 
         plane = ControlPlane(home=str(tmp_path / "home"))
         try:
             chaos.install(chaos.parse_spec("store.read:count=1"))
+            # Inject inside an open span: the recorded Chaos event must
+            # carry BOTH the trace and that span's ID, so the injection
+            # lands at the right node of the `kfx trace` waterfall.
             with pytest.raises(StoreFault):
-                plane.store.get("JAXJob", "x")
+                with obs_trace.span("unit.op", trace_id="aced0123") as sp:
+                    plane.store.get("JAXJob", "x")
             evs = plane.store.events_for("Chaos", "store.read")
             assert evs and evs[0].reason == "ChaosInjected"
+            assert evs[0].trace_id == "aced0123"
+            assert evs[0].span_id == sp.span_id
+            assert evs[0].to_dict()["spanId"] == sp.span_id
             text = plane.metrics.render()
             assert 'kfx_chaos_injected_total{point="store.read"} 1' in text
         finally:
