@@ -221,6 +221,14 @@ def metrics_json(snapshot: dict) -> dict:
         "gangs": scalar("kfx_gangs"),
         "events": scalar("kfx_events_total"),
         "reconcile": reconcile,
+        # Gang-scheduler capacity/queue state (sched/): what remote
+        # `kfx top` / `kfx queue` render as the slice summary.
+        "sched": {
+            "capacity": scalar("kfx_sched_capacity_chips"),
+            "reserved": scalar("kfx_sched_reserved_chips"),
+            "queued": sum(s["value"]
+                          for s in samples("kfx_sched_queue_depth")),
+        },
     }
 
 
@@ -1095,6 +1103,11 @@ class Client:
 
     def events(self, kind: str, namespace: str, name: str) -> List[dict]:
         return self._json(f"/apis/{kind}/{namespace}/{name}/events")["events"]
+
+    def metrics_json(self) -> dict:
+        """The /metrics?format=json snapshot (incl. the ``sched``
+        capacity/queue block the CLI summary line renders)."""
+        return self._json("/metrics?format=json")
 
 
 SERVER_MARKER = "server.json"

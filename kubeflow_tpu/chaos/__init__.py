@@ -17,6 +17,8 @@ tested property: sites across the stack declare *fault points* —
     serving.predict     in-server predict failure   (serving/server.py)
     runner.crash        worker self-crash at a      (runners/jax_runner.py)
                         checkpoint boundary
+    sched.preempt       scheduler preemption fails  (sched/scheduler.py)
+                        to land (cycle aborts)
 
 — and a *plan* decides, deterministically, which evaluations inject.
 
@@ -80,6 +82,7 @@ KNOWN_POINTS = frozenset({
     "store.read", "store.write", "workqueue.requeue",
     "checkpoint.save", "checkpoint.restore",
     "serving.request", "serving.predict", "runner.crash",
+    "sched.preempt",
 })
 
 

@@ -274,7 +274,12 @@ class KfxCLI:
         step/loss/throughput per training job, parsed from each chief
         log with the same stdout-metric contract the HPO collector uses
         (SURVEY.md §5.5) — so `kfx top`, Katib observations and the
-        runner all agree on one number."""
+        runner all agree on one number. Headed by the gang scheduler's
+        capacity/queue summary."""
+        running, queued = _slice_state(_store_jobs(self.cp))
+        print(_capacity_summary(self.cp.sched.capacity,
+                                sum(r.chips for r in running),
+                                len(queued)))
         rows = []
         for kind in _training_kinds():
             for job in self.cp.store.list(kind):
@@ -288,6 +293,18 @@ class KfxCLI:
                 rows.append([job.name, kind, job.namespace,
                              _job_state(job)] + _telemetry_cells(text))
         return _print_top(rows)
+
+    def queue(self) -> int:
+        """Gang-scheduler view (`kfx queue`): slice capacity, the gangs
+        holding chips, and the priority-ordered wait queue — derived
+        from the store (conditions + annotations the scheduler writes),
+        so it reads identically against a live plane, a passive CLI
+        plane, or a journal-recovered home."""
+        running, queued = _slice_state(_store_jobs(self.cp))
+        print(_capacity_summary(self.cp.sched.capacity,
+                                sum(r.chips for r in running),
+                                len(queued)))
+        return _print_queue(running, queued)
 
     def profile(self, kind: str, name: str, namespace: str, replica: str,
                 duration_ms: int, logdir: str) -> int:
@@ -340,6 +357,84 @@ class KfxCLI:
             return 0
         print(f"replica {replica} not running", file=sys.stderr)
         return 1
+
+
+class _SliceRow:
+    """One job's scheduler-relevant state for `kfx queue` / `kfx top`."""
+
+    __slots__ = ("name", "kind", "namespace", "priority", "chips", "state",
+                 "detail", "created")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+def _slice_state(jobs) -> "Tuple[List[_SliceRow], List[_SliceRow]]":
+    """(running, queued) slice rows derived from ``jobs`` — an iterable
+    of (kind, Resource): gangs that hold chips vs jobs waiting (queued
+    on capacity/quota, or preempted and waiting to resume). Queued rows
+    come back in scheduler order — priority desc, fair share (namespace
+    holding fewer running chips first), then submission age."""
+    from .api.base import get_condition
+    from .sched import PREEMPTED_ANNOTATION, job_priority
+
+    running, queued = [], []
+    for kind, job in jobs:
+        if job.is_finished():
+            continue
+        row = _SliceRow(
+            name=job.name, kind=kind, namespace=job.namespace,
+            priority=job_priority(job), chips=job.total_replicas(),
+            created=job.metadata.creation_timestamp, detail="")
+        preempted = PREEMPTED_ANNOTATION in job.metadata.annotations
+        if job.has_condition("Queued"):
+            cond = get_condition(job.conditions, "Queued")
+            row.state = "Queued"
+            row.detail = (cond.message if cond else "") or \
+                (cond.reason if cond else "")
+            queued.append(row)
+        elif job.run_policy().suspend or job.has_condition("Suspended"):
+            if preempted:
+                row.state = "Preempted"
+                row.detail = "resumes from checkpoint when capacity frees"
+                queued.append(row)
+            # User-suspended jobs hold no chips and wait for the user,
+            # not the scheduler — they are not part of this view.
+        else:
+            row.state = "Running"
+            running.append(row)
+    used: dict = {}
+    for r in running:
+        used[r.namespace] = used.get(r.namespace, 0) + r.chips
+    queued.sort(key=lambda r: (-r.priority, used.get(r.namespace, 0),
+                               r.created or ""))
+    return running, queued
+
+
+def _print_queue(running, queued) -> int:
+    """The `kfx queue` table body, shared by the local and remote
+    paths (which differ only in where the job rows come from)."""
+    rows = [[r.name, r.kind, r.namespace, str(r.priority),
+             str(r.chips), r.state, r.detail] for r in running + queued]
+    if not rows:
+        print("no active or queued training jobs")
+        return 0
+    _print_table(rows, ["NAME", "KIND", "NAMESPACE", "PRIO", "CHIPS",
+                        "STATE", "DETAIL"])
+    return 0
+
+
+def _store_jobs(cp):
+    for kind in _training_kinds():
+        for job in cp.store.list(kind):
+            yield kind, job
+
+
+def _capacity_summary(capacity: int, reserved: int, queued: int) -> str:
+    free = max(capacity - reserved, 0)
+    return (f"slice: capacity={capacity} chips  reserved={reserved}  "
+            f"free={free}  queued={queued}")
 
 
 def _training_kinds() -> List[str]:
@@ -448,6 +543,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("top", help="live training telemetry (latest step/"
                                "loss/throughput per job)")
 
+    sub.add_parser("queue", help="gang-scheduler state: slice capacity, "
+                                 "running gangs, and the priority-"
+                                 "ordered wait queue")
+
     sp = sub.add_parser("kill-replica", help="fault injection: kill a replica")
     sp.add_argument("kind")
     sp.add_argument("name")
@@ -526,7 +625,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
             print(p)
         return 0
     _REMOTE_VERBS = ("apply", "run", "get", "describe", "delete", "logs",
-                     "events", "top")
+                     "events", "top", "queue")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
     if os.environ.get("KFX_SERVER") and args.cmd == "trace":
@@ -577,7 +676,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # finished/ownerless gang case is the only one left after the routing
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
-                           "delete", "kill-replica", "top", "trace")
+                           "delete", "kill-replica", "top", "trace",
+                           "queue")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -636,6 +736,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
                              args.format, args.output)
         if args.cmd == "top":
             return cli.top()
+        if args.cmd == "queue":
+            return cli.queue()
         if args.cmd == "kill-replica":
             return cli.kill_replica(args.kind, args.name, args.namespace,
                                     args.replica)
@@ -846,6 +948,7 @@ def _remote_dispatch(client, args) -> int:
     if args.cmd == "top":
         from .apiserver import ApiError
 
+        print(_remote_capacity_summary(client))
         rows = []
         for kind in _training_kinds():
             for o in client.list(kind):
@@ -859,7 +962,35 @@ def _remote_dispatch(client, args) -> int:
                 rows.append([name, kind, ns, _dict_state(o)]
                             + _telemetry_cells(text))
         return _print_top(rows)
+    if args.cmd == "queue":
+        print(_remote_capacity_summary(client))
+        running, queued = _slice_state(_remote_jobs(client))
+        return _print_queue(running, queued)
     raise AssertionError(f"unhandled remote cmd {args.cmd}")
+
+
+def _remote_jobs(client):
+    """(kind, Resource) pairs rebuilt from the server's dicts, so the
+    remote `kfx queue` shares the local view's derivation exactly."""
+    from .api.base import from_manifest
+
+    for kind in _training_kinds():
+        for o in client.list(kind):
+            try:
+                yield kind, from_manifest(o)
+            except Exception:
+                continue
+
+
+def _remote_capacity_summary(client) -> str:
+    try:
+        sched = client.metrics_json().get("sched") or {}
+    except Exception:
+        sched = {}
+    capacity = int(sched.get("capacity") or 0)
+    reserved = int(sched.get("reserved") or 0)
+    return _capacity_summary(capacity, reserved,
+                             int(sched.get("queued") or 0))
 
 
 def _remote_wait(client, applied: List[dict], timeout: float,

@@ -102,6 +102,16 @@ class ControlPlane:
         self.metrics.add_collector(chaos.collect)
         self._chaos_listener = self._record_chaos_event
         chaos.add_listener(self._chaos_listener)
+        # The cluster gang scheduler (sched/): the single admission point
+        # between the workload controllers and gang.spawn. Capacity is
+        # discovered from the gang runtime; queue/preemption metrics land
+        # in this plane's registry.
+        from .sched import Scheduler
+
+        self.sched = Scheduler(self.store,
+                               capacity=self.gangs.slice_capacity(),
+                               metrics=self.metrics)
+        self.metrics.add_collector(self.sched.collect)
         self._register_controllers(worker_platform)
         for ctrl in self.manager.controllers.values():
             ctrl.metrics = self.metrics
@@ -151,6 +161,13 @@ class ControlPlane:
         for ctrl in self.manager.controllers.values():
             if hasattr(ctrl, "admission"):
                 ctrl.admission = admission
+        # Route every training-job kind (incl. HPO trial gangs, which
+        # are training jobs) through the gang scheduler, and let it wake
+        # queued keys event-driven when capacity frees.
+        for ctrl in self.manager.controllers.values():
+            if hasattr(ctrl, "scheduler"):
+                ctrl.scheduler = self.sched
+                self.sched.register_waker(ctrl.KIND, ctrl.queue.add)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ControlPlane":

@@ -30,6 +30,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
+# Installs the jax API-drift shims (jax.shard_map / set_mesh /
+# get_abstract_mesh) this module reaches lazily below.
+from ..parallel import mesh as _mesh_compat  # noqa: F401
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:

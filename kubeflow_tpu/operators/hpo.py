@@ -417,9 +417,14 @@ class ExperimentController(Controller):
         early = [t for t in trials
                  if t.has_condition(K.TRIAL_EARLY_STOPPED)]
 
+        # Trials whose gang is waiting in the cluster scheduler's queue
+        # (slice full / quota): they count against parallelTrialCount —
+        # the experiment must not flood the queue — and surface in
+        # status so a stalled-looking sweep reads as "queued", not hung.
+        queued = [t for t in running if self._trial_job_queued(t)]
         best = self._best(exp, succeeded)
         self._update_exp_status(exp, trials, running, succeeded, failed,
-                                early, best)
+                                early, queued, best)
 
         # Terminal checks.
         goal = exp.objective_goal()
@@ -607,8 +612,16 @@ class ExperimentController(Controller):
                     exp, "Normal", "TrialEarlyStopped",
                     f"{t.name}: {metric}={live} below median")
 
+    def _trial_job_queued(self, trial) -> bool:
+        """True when the trial's underlying training job is waiting in
+        the gang scheduler's queue (Queued condition) rather than
+        actually training."""
+        assert isinstance(trial, K.Trial)
+        job = self.trial_ctrl._job_for(trial)
+        return job is not None and job.has_condition("Queued")
+
     def _update_exp_status(self, exp, trials, running, succeeded, failed,
-                           early, best) -> None:
+                           early, queued, best) -> None:
         fresh = self.get_resource(exp.key)
         if fresh is None:
             return
@@ -618,6 +631,7 @@ class ExperimentController(Controller):
             "trialsSucceeded": len(succeeded),
             "trialsFailed": len(failed),
             "trialsEarlyStopped": len(early),
+            "trialsQueued": len(queued),
         }
         if best is not None:
             status["currentOptimalTrial"] = {

@@ -66,7 +66,14 @@ class PlatformAdmission:
     # -- quota (profile-controller / ResourceQuota parity) ------------------
     def check_job(self, job: TrainingJob) -> Optional[str]:
         """Return a denial reason if starting `job` would exceed the
-        namespace Profile's quota, else None."""
+        namespace Profile's quota, else None.
+
+        In a full control plane the profile's ``count/jobs`` /
+        ``count/replicas`` caps are enforced by the cluster scheduler
+        (sched.Scheduler._quota_blocked_locked) against its own admitted
+        set — one gate, no check/spawn race between controllers. This
+        store-counting path remains for standalone controllers wired
+        with admission but no scheduler."""
         profile = self.store.try_get("Profile", job.namespace)
         if not isinstance(profile, Profile):
             return None

@@ -37,6 +37,7 @@ from ..api import training as T
 from ..api.base import Resource, utcnow
 from ..core.controller import Controller, Result
 from ..core.store import ResourceStore
+from ..obs import trace as obs_trace
 from ..runtime import gang as G
 from ..runtime import rendezvous as rdv
 from ..utils.net import free_port
@@ -77,6 +78,10 @@ class TrainingControllerBase(Controller):
         # Set by the control plane when the platform operators are present:
         # quota admission + PodDefault injection (operators/platform.py).
         self.admission = None
+        # Set by the control plane: the cluster gang scheduler (sched/).
+        # Every gang creation routes through it; queued jobs are woken
+        # event-driven when capacity frees (no quota busy-poll).
+        self.scheduler = None
 
     # -- gang bookkeeping ---------------------------------------------------
     def _gang_key(self, key: str) -> str:
@@ -84,6 +89,8 @@ class TrainingControllerBase(Controller):
 
     def on_delete(self, obj: Resource) -> None:
         self.gangs.delete(self._gang_key(obj.key))
+        if self.scheduler is not None:
+            self.scheduler.release(self.KIND, obj.name, obj.namespace)
 
     # -- per-kind contract --------------------------------------------------
     def build_specs(self, job: T.TrainingJob, workdir: str) -> Tuple[
@@ -104,6 +111,9 @@ class TrainingControllerBase(Controller):
         job = self.get_resource(key)
         if job is None:
             self.gangs.delete(self._gang_key(key))
+            if self.scheduler is not None:
+                ns, _, name = key.partition("/")
+                self.scheduler.release(self.KIND, name, ns)
             return None
         assert isinstance(job, T.TrainingJob)
         policy = job.run_policy()
@@ -114,9 +124,17 @@ class TrainingControllerBase(Controller):
                 self.gangs.delete(gkey)
                 self.record_event(job, "Normal", "JobSuspended",
                                   "gang terminated (spec.runPolicy.suspend)")
+            # A scheduler-preempted job stays queued for auto-resume;
+            # a user-suspended one leaves the scheduler (its chips free
+            # either way — this is what makes suspend the preemption
+            # primitive).
+            kept = self.scheduler.on_suspended(job) \
+                if self.scheduler is not None else False
             if not job.has_condition(T.JOB_SUSPENDED):
+                msg = ("preempted; resumes from its latest checkpoint "
+                       "when capacity frees") if kept else "job is suspended"
                 job.set_condition(T.JOB_SUSPENDED, "True", "JobSuspended",
-                                  "job is suspended")
+                                  msg)
                 job.set_condition(T.JOB_RUNNING, "False", "JobSuspended", "")
                 self._update_status(job)
             return None
@@ -128,36 +146,71 @@ class TrainingControllerBase(Controller):
 
         if job.is_finished():
             self.gangs.forget(gkey)
+            if self.scheduler is not None:
+                self.scheduler.release(self.KIND, job.name, job.namespace)
             return self._gc_after_ttl(job, policy)
 
         gang = self.gangs.get(gkey)
         if gang is None:
-            if self.admission is not None:
-                denial = self.admission.check_job(job)
-                if denial:
-                    # Quota-exceeded jobs queue (the reference's pod
-                    # creation is rejected by ResourceQuota and the job
-                    # controller retries); they start when capacity frees.
-                    if self._set_if_changed(job, T.JOB_QUEUED, "True",
-                                            "QuotaExceeded", denial):
-                        self._update_status(job)
-                        self.record_event(job, "Warning", "QuotaExceeded",
-                                          denial)
-                    return Result(requeue=True, requeue_after=1.0)
-            if job.has_condition(T.JOB_QUEUED):
-                job.set_condition(T.JOB_QUEUED, "False", "QuotaFreed",
-                                  "capacity available")
-                self._update_status(job)
+            queued = self._admission_gate(job)
+            if queued is not None:
+                reason, message = queued
+                if self._set_if_changed(job, T.JOB_QUEUED, "True",
+                                        reason, message):
+                    self._update_status(job)
+                    self.record_event(job, "Warning", reason, message)
+                if self.scheduler is not None:
+                    # Event-driven: the scheduler wakes this key when
+                    # its turn comes — no requeue busy-poll.
+                    return None
+                # Legacy quota fallback (no scheduler wired): retry.
+                return Result(requeue=True, requeue_after=1.0)
             gang = self._create_gang(job, gkey, policy)
-            if not job.has_condition(T.JOB_CREATED):
-                job.set_condition(T.JOB_CREATED, "True", "JobCreated",
-                                  f"gang of {job.total_replicas()} created")
-                job.status.setdefault("startTime", utcnow())
-                self._update_status(job)
-                self.record_event(job, "Normal", "JobCreated",
-                                  f"created gang of {job.total_replicas()} "
-                                  f"process(es)")
+        if not job.has_condition(T.JOB_CREATED):
+            # One status write for Queued-clear + Created + startTime:
+            # split writes conflict on resourceVersion and the retry
+            # used to skip this block once the gang existed, losing
+            # startTime for any job that had waited in the queue.
+            if job.has_condition(T.JOB_QUEUED):
+                job.set_condition(T.JOB_QUEUED, "False", "Admitted",
+                                  "capacity available")
+            job.set_condition(T.JOB_CREATED, "True", "JobCreated",
+                              f"gang of {job.total_replicas()} created")
+            job.status.setdefault("startTime", utcnow())
+            self._update_status(job)
+            self.record_event(job, "Normal", "JobCreated",
+                              f"created gang of {job.total_replicas()} "
+                              f"process(es)")
+        elif job.has_condition(T.JOB_QUEUED):
+            job.set_condition(T.JOB_QUEUED, "False", "Admitted",
+                              "capacity available")
+            self._update_status(job)
         self._sync_status(job, gang)
+        return None
+
+    def _admission_gate(self, job: T.TrainingJob
+                        ) -> Optional[Tuple[str, str]]:
+        """The single admission point before gang.spawn: ask the cluster
+        scheduler for the job's full replica set (all-or-nothing).
+        Returns None when admitted, else ``(reason, message)`` for the
+        Queued condition. Without a scheduler (standalone controllers)
+        the legacy profile-quota check applies."""
+        if self.scheduler is not None:
+            from ..sched import job_priority
+
+            # The sched.admit span sits between this job's reconcile
+            # and its gang.spawn in the `kfx trace` waterfall.
+            with obs_trace.span("sched.admit", kind=self.KIND,
+                                job=job.key,
+                                chips=str(job.total_replicas()),
+                                priority=str(job_priority(job))) as sp:
+                admitted, reason, message = self.scheduler.try_admit(job)
+                sp.attrs["admitted"] = "true" if admitted else "false"
+            return None if admitted else (reason, message)
+        if self.admission is not None:
+            denial = self.admission.check_job(job)
+            if denial:
+                return "QuotaExceeded", denial
         return None
 
     def _create_gang(self, job: T.TrainingJob, gkey: str,

@@ -487,6 +487,16 @@ class GangManager:
         self._lock = threading.Lock()
         self._gangs: Dict[str, Gang] = {}
 
+    def slice_capacity(self) -> int:
+        """Total chips of the emulated slice this runtime launches gangs
+        onto (one replica process == one chip) — the capacity model the
+        cluster scheduler (sched/) admits against. Discovery order:
+        KFX_SLICE_CHIPS, the virtual-mesh XLA device-count flag, host
+        cores with a generous floor."""
+        from ..sched import slice_capacity
+
+        return slice_capacity()
+
     def get(self, key: str) -> Optional[Gang]:
         with self._lock:
             return self._gangs.get(key)

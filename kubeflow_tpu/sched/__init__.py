@@ -1,0 +1,23 @@
+"""Cluster gang scheduler: capacity model, priority queues, preemption.
+
+The volcano/Kueue-shaped layer the reference platform gets from
+kube-batch PodGroups (SURVEY.md §1/§3): all-or-nothing gang admission
+against a capacity model of the slice, per-namespace priority-ordered
+FIFO queues with fair-share tie-breaking and small-job backfill, and
+priority preemption built on ``runPolicy.suspend`` — the victim
+checkpoints, frees its chips, and resumes from its latest step when
+capacity returns (Borg/Gandiva's suspend-and-resume primitive).
+"""
+
+from .scheduler import (
+    PREEMPTED_ANNOTATION,
+    PRIORITY_ANNOTATION,
+    Scheduler,
+    job_priority,
+    slice_capacity,
+)
+
+__all__ = [
+    "Scheduler", "slice_capacity", "job_priority",
+    "PREEMPTED_ANNOTATION", "PRIORITY_ANNOTATION",
+]

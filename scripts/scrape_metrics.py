@@ -8,17 +8,23 @@ format regression in any producer is caught in tier-1 before a real
 Prometheus scrape — or a `kfx trace` reconstruction — would drop it.
 
 Usage:
-    python scripts/scrape_metrics.py [URL ...] [--spans PATH ...]
+    python scripts/scrape_metrics.py [URL ...] [--spans PATH ...] \
+        [--require FAMILY ...]
 
 With no URLs and no --spans, the control plane advertised by the
 current kfx home's server marker (``kfx server``) is scraped. A URL
-without a path gets ``/metrics`` appended.
+without a path gets ``/metrics`` appended. ``--require`` (repeatable)
+fails the scrape unless the named metric family has at least one
+sample on some scraped endpoint — how CI pins the scheduler families
+(``kfx_sched_queue_seconds``, ``kfx_sched_admitted_total``, ...) to
+the plane's exposition output.
 """
 
 import os
 import sys
 import urllib.error
 import urllib.request
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -43,9 +49,12 @@ def scrape(url: str, timeout: float = 10.0) -> str:
         return r.read().decode()
 
 
-def check_endpoint(url: str) -> int:
+def check_endpoint(url: str, seen_families: Optional[set] = None) -> int:
     """Scrape + validate one endpoint; prints a verdict line and any
-    per-line errors. Returns the number of problems found."""
+    per-line errors. Returns the number of problems found. Families
+    with at least one sample are added to ``seen_families`` (the
+    ``--require`` bookkeeping; histogram series fold back onto their
+    base family name)."""
     url = normalize(url)
     try:
         text = scrape(url)
@@ -53,8 +62,18 @@ def check_endpoint(url: str) -> int:
         print(f"FAIL {url}: unreachable or wrong type: {e}")
         return 1
     errors = validate_exposition(text)
-    samples = sum(1 for ln in text.splitlines()
-                  if ln.strip() and not ln.startswith("#"))
+    samples = 0
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        samples += 1
+        if seen_families is not None:
+            name = ln.split("{", 1)[0].split(" ", 1)[0]
+            seen_families.add(name)
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    seen_families.add(name[:-len(suffix)])
     if errors:
         print(f"FAIL {url}: {len(errors)} malformed line(s), "
               f"{samples} sample(s)")
@@ -120,7 +139,7 @@ def default_urls() -> list:
 
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
-    urls, span_paths = [], []
+    urls, span_paths, required = [], [], []
     i = 0
     while i < len(args):
         if args[i] == "--spans":
@@ -129,6 +148,13 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             span_paths.append(args[i + 1])
+            i += 2
+        elif args[i] == "--require":
+            if i + 1 >= len(args):
+                print("--require needs a metric family name",
+                      file=sys.stderr)
+                return 2
+            required.append(args[i + 1])
             i += 2
         else:
             urls.append(args[i])
@@ -140,8 +166,16 @@ def main(argv=None) -> int:
                   "in the kfx home; pass endpoint URLs explicitly",
                   file=sys.stderr)
             return 2
-    failures = sum(check_endpoint(u) for u in urls)
+    seen: set = set()
+    failures = sum(check_endpoint(u, seen) for u in urls)
     failures += sum(check_span_log(p) for p in span_paths)
+    for family in required:
+        if family in seen:
+            print(f"ok   required family {family} present")
+        else:
+            print(f"FAIL required family {family}: no samples on any "
+                  f"scraped endpoint")
+            failures += 1
     return 1 if failures else 0
 
 
