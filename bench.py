@@ -373,6 +373,14 @@ def main() -> int:
         lm.update(_bench_lm_decode(preset="base", batch=8, prompt_len=512,
                                    max_new=64, max_seq_len=640,
                                    prefix="lm_decode_base_"))
+    if have_time(300, "lm_engine"):
+        # Continuous batching (serving/engine.py): aggregate decode
+        # throughput with 8 CONCURRENT single-prompt clients vs the
+        # same 8 requests serialized run-to-completion — the serving
+        # regime where the one-shot path collapses to ~1/B of the
+        # batched number and the slotted engine gets it back.
+        guard.section("lm_engine")
+        lm.update(_bench_lm_engine())
     lm.update(guard.finish())
     if skipped:
         # A missing metric key must read as "budget cut this section",
@@ -417,6 +425,7 @@ def main() -> int:
         "lm_mfu", "lm_best_mfu", "lm_long_mfu", "lm_long_tokens_per_s",
         "resnet50_mfu", "resnet50_best_mfu", "resnet50_images_per_s",
         "lm_decode_base_tokens_per_s", "lm_decode_b16_tokens_per_s",
+        "lm_engine_concurrent_tokens_per_s", "lm_engine_speedup",
         "cpu_count", "host_speed_score", "load_avg_max",
         "contaminated_sections", "sections_skipped_for_budget",
         "bench_wall_s")
@@ -586,6 +595,65 @@ def _bench_lm_decode(preset: str = "small", batch: int = 4,
         }
     except Exception as e:  # secondary metric must not sink the bench
         return {prefix + "error": str(e)[:200]}
+
+
+def _bench_lm_engine(preset: str = "small", clients: int = 8,
+                     prompt_len: int = 64, max_new: int = 64,
+                     max_seq_len: int = 512, chunk: int = 8,
+                     prefix: str = "lm_engine_") -> dict:
+    """Continuous-batching serving throughput: ``clients`` concurrent
+    single-prompt requests through the slotted DecodeEngine vs the same
+    requests serialized through the one-shot LMGenerator (today's
+    run-to-completion serving behavior). Both paths pre-warmed; greedy,
+    so the outputs are byte-identical and the comparison is pure
+    scheduling."""
+    eng = None
+    try:
+        import numpy as np
+
+        import jax
+
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.models.transformer import (
+            TransformerLM, preset_config)
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg = preset_config(preset, max_seq_len=max_seq_len)
+        rng = np.random.default_rng(0)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
+        gen = LMGenerator(cfg, params)
+        eng = DecodeEngine(cfg, params, n_slots=clients,
+                           chunk_tokens=chunk,
+                           request_timeout_s=600.0)
+        prompts = [list(rng.integers(0, cfg.vocab_size, prompt_len))
+                   for _ in range(clients)]
+        gen.generate([prompts[0]], max_new_tokens=max_new)  # warm
+        eng.generate([prompts[0]], max_new_tokens=max_new)  # warm
+        t0 = time.perf_counter()
+        for p in prompts:
+            gen.generate([p], max_new_tokens=max_new)
+        serial_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        eng.generate(prompts, max_new_tokens=max_new)
+        engine_dt = time.perf_counter() - t0
+        total = clients * max_new
+        return {
+            prefix + "model": preset,
+            prefix + "clients": clients,
+            prefix + "new_tokens": max_new,
+            prefix + "chunk_tokens": chunk,
+            prefix + "serial_tokens_per_s": round(total / serial_dt, 1),
+            prefix + "concurrent_tokens_per_s":
+                round(total / engine_dt, 1),
+            prefix + "speedup": round(serial_dt / engine_dt, 2),
+        }
+    except Exception as e:  # secondary metric must not sink the bench
+        return {prefix + "error": str(e)[:200]}
+    finally:
+        if eng is not None:
+            eng.close()
 
 
 def _resnet50_point(ds, batch: int, steps: int, *, cost_analysis: bool,

@@ -15,6 +15,8 @@ tested property: sites across the stack declare *fault points* —
     checkpoint.restore  restore read error          (training/checkpoint.py)
     serving.request     router->backend failure     (serving/router.py)
     serving.predict     in-server predict failure   (serving/server.py)
+    engine.admit        LM decode-engine admission  (serving/engine.py)
+                        failure/latency
     runner.crash        worker self-crash at a      (runners/jax_runner.py)
                         checkpoint boundary
     sched.preempt       scheduler preemption fails  (sched/scheduler.py)
@@ -81,8 +83,8 @@ KNOWN_POINTS = frozenset({
     "gang.spawn", "gang.kill", "rendezvous.delay",
     "store.read", "store.write", "workqueue.requeue",
     "checkpoint.save", "checkpoint.restore",
-    "serving.request", "serving.predict", "runner.crash",
-    "sched.preempt",
+    "serving.request", "serving.predict", "engine.admit",
+    "runner.crash", "sched.preempt",
 })
 
 

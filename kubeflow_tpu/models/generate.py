@@ -14,14 +14,36 @@ optional top-k, all inside the compiled loop.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .transformer import TransformerConfig, TransformerLM
+
+
+def pow2_bucket(n: int, cap: int) -> int:
+    """The prompt/length compile-bucket policy (powers of two from 8,
+    capped): ONE implementation, shared by the one-shot LMGenerator and
+    the serving DecodeEngine — if the policies diverged, the engine's
+    greedy outputs could stop matching the parity oracle's compiles."""
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def decode_config(cfg: TransformerConfig,
+                  max_len: Optional[int] = None) -> TransformerConfig:
+    """The serving-time decode variant of a train config: KV caches on,
+    single-chip XLA attention (the decode step is one token — flash and
+    the parallelism knobs are training-shape machinery). Shared by the
+    one-shot LMGenerator and the continuous-batching DecodeEngine so
+    the parity oracle and the engine compile the SAME model."""
+    return dataclasses.replace(
+        cfg, decode=True, remat=False, sp=False, cp=1, attn_impl="xla",
+        max_seq_len=max_len or cfg.max_seq_len)
 
 
 def _sample(logits: jnp.ndarray, rng, temperature, top_k) -> jnp.ndarray:
@@ -52,9 +74,7 @@ class LMGenerator:
 
     def __init__(self, cfg: TransformerConfig, params,
                  max_len: Optional[int] = None):
-        self.cfg = dataclasses.replace(
-            cfg, decode=True, remat=False, sp=False, cp=1, attn_impl="xla",
-            max_seq_len=max_len or cfg.max_seq_len)
+        self.cfg = decode_config(cfg, max_len)
         import jax as _jax
 
         # Device-commit once: params arrive as host numpy from the
@@ -63,7 +83,9 @@ class LMGenerator:
         # full tree (~1.9G at base) through the device link.
         self.params = _jax.device_put(params)
         self.model = TransformerLM(self.cfg)
-        self._compiled: Dict[Tuple[int, int, int, float, int], any] = {}
+        # Keyed (batch, prompt bucket, max_new bucket) — the sampling
+        # knobs are TRACED arguments, never part of the compile key.
+        self._compiled: Dict[Tuple[int, int, int], Callable[..., Any]] = {}
 
     # -- the compiled path --------------------------------------------------
     def _generate_fn(self, prompt_pad: int, max_new: int):
@@ -110,12 +132,7 @@ class LMGenerator:
         return run
 
     # -- public -------------------------------------------------------------
-    @staticmethod
-    def _bucket(n: int, cap: int) -> int:
-        b = 8
-        while b < n:
-            b *= 2
-        return min(b, cap)
+    _bucket = staticmethod(pow2_bucket)
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,

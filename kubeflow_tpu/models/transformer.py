@@ -297,9 +297,19 @@ class Attention(nn.Module):
 
     def _decode_attend(self, q, k, v, positions):
         """KV-cache attention: write the S new (already-roped) K/V rows
-        at the cache cursor, attend Q against every valid cached slot.
-        Per-slot validity is the cached position id (-1 = empty/pad), so
-        left- or right-padded prompts both stay exact."""
+        at each row's cache cursor, attend Q against every valid cached
+        slot. Per-slot validity is the cached position id (-1 =
+        empty/pad), so left- or right-padded prompts both stay exact.
+
+        The cursor is PER BATCH ROW ([B], not a shared scalar): the
+        serving engine (serving/engine.py) runs one cache row per
+        request slot, and slots prefill/retire independently, so row
+        cursors diverge. Writes are row-indexed scatters; out-of-bounds
+        updates (an idle slot whose cursor marched past L between
+        admissions) are dropped by XLA's scatter semantics, and a
+        prefill overwrites the whole row anyway. The one-shot generate
+        path keeps every cursor equal, where the scatter degenerates to
+        the old dynamic_update_slice."""
         cfg = self.cfg
         B, S, H, D = q.shape
         L = cfg.max_seq_len
@@ -310,14 +320,13 @@ class Attention(nn.Module):
         cpos = self.variable("cache", "cached_pos",
                              lambda: jnp.full((B, L), -1, jnp.int32))
         cur = self.variable("cache", "cache_index",
-                            lambda: jnp.zeros((), jnp.int32))
-        i = cur.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, i, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, i, 0, 0))
-        cpos.value = jax.lax.dynamic_update_slice(cpos.value, positions,
-                                                  (0, i))
+                            lambda: jnp.zeros((B,), jnp.int32))
+        i = cur.value  # [B]
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]          # [B, 1]
+        at = i[:, None] + jnp.arange(S, dtype=jnp.int32)[None]  # [B, S]
+        ck.value = ck.value.at[rows, at].set(k.astype(cfg.dtype))
+        cv.value = cv.value.at[rows, at].set(v.astype(cfg.dtype))
+        cpos.value = cpos.value.at[rows, at].set(positions)
         cur.value = i + S
 
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value)  # [B,H,S,L]

@@ -1,0 +1,627 @@
+"""Continuous-batching LM decode engine: slotted KV cache + in-flight
+admission (Orca-style iteration-level scheduling; vLLM's block manager
+reduced to the TPU-friendly fixed-shape case).
+
+The one-shot path (models/generate.LMGenerator) is run-to-completion:
+each request owns the whole device for its prefill + scan decode, so
+concurrent single-prompt traffic serializes and aggregate throughput
+collapses to ~1/B of the batched number. This engine owns a fixed-shape
+slotted cache — ``n_slots`` independent KV rows of ``max_seq_len``
+each — and a persistent decode loop on a dedicated thread. Exactly two
+compiled functions replace the per-request monolith:
+
+  * ``prefill_into_slot(params, cache, logbuf, tokens, slot, true_len)``
+    — one compile per prompt bucket; runs the prompt through the model
+    with a fresh single-row cache and writes that row (K/V, positions,
+    cursor) plus the last real token's logits into the shared state at
+    ``slot``;
+  * ``decode_chunk(params, cache, logbuf, ...slot state...)`` — ONE
+    compile total; advances *every active slot* by ``chunk_tokens``
+    tokens in a single ``lax.scan`` dispatch (preserving the
+    one-dispatch-per-k-tokens property the tunneled-accelerator comment
+    in models/generate.py demands), with per-slot position ids,
+    per-slot RNG streams, per-slot sampling knobs, active-slot masking
+    and per-slot stop-token / length early-retirement.
+
+Requests are admitted into free slots at chunk boundaries and retire
+independently, so a 64-token request never blocks an 8-token one; a
+full house queues (bounded — overflow raises ``EngineOverloaded``,
+which the model server answers with 503 + Retry-After).
+
+Exactness: attention masks by cached *position id* (-1 = empty), never
+by cache location, and a prefill overwrites its entire slot row — so
+slot reuse cannot leak KV between requests and greedy decode is
+byte-identical to the one-shot oracle (asserted in tests/test_engine.py;
+``KFX_LM_ENGINE=0`` keeps the oracle serving for A/B).
+
+Observability: ``kfx_lm_slot_occupancy`` / ``kfx_lm_queue_wait_seconds``
+(+ slots/queue-depth gauges, chunk counter) land on the hosting model
+server's /metrics; each admission stamps an ``engine.admit`` span and
+each dispatch an ``engine.chunk`` span into the request's trace tree.
+Chaos point ``engine.admit`` fails or delays admissions (docs/chaos.md).
+
+jax is imported lazily (inside methods): server.py imports this module
+for ``EngineOverloaded`` on its own import path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import chaos
+from ..obs import trace as obs_trace
+from ..obs.metrics import MetricsRegistry, default_registry
+
+# Admission wait buckets (seconds): a healthy engine admits within one
+# chunk (sub-ms..ms on tiny models, tens of ms on big ones); the tail
+# is queueing behind a full house.
+QUEUE_WAIT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission queue full — the bounded-queueing replacement for the
+    old hard ``max_batch_size`` rejection. The server maps this to
+    503 + Retry-After (shed load, don't 400 a well-formed request)."""
+
+
+class Request:
+    """One in-flight generation: token budget, sampling knobs, and a
+    completion event the submitting thread waits on."""
+
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
+                 "stop", "bucket", "tokens", "error", "t_enqueue",
+                 "t_done", "trace_id", "span_id", "_event")
+
+    def __init__(self, prompt: List[int], max_new: int, temperature: float,
+                 top_k: int, seed: int, stop: int, bucket: int):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.seed = seed
+        self.stop = stop              # -1 = no stop token
+        self.bucket = bucket          # prompt pad bucket (cache budget)
+        self.tokens: List[int] = []   # generated ids, filled by the loop
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = time.monotonic()
+        self.t_done = 0.0
+        # Captured on the submitting thread so the engine thread's
+        # admit/chunk spans join the request's trace tree (the same
+        # contract MicroBatcher uses for batcher.flush).
+        self.trace_id = obs_trace.current_trace_id()
+        self.span_id = obs_trace.current_span_id()
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self.error = error
+        self.t_done = time.monotonic()
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"engine did not complete the request within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+class DecodeEngine:
+    """Owns the slotted cache, the compiled prefill/decode functions and
+    the decode-loop thread. One instance per served LM."""
+
+    def __init__(self, cfg, params, n_slots: int = 8,
+                 chunk_tokens: int = 8, max_queue: Optional[int] = None,
+                 name: str = "model",
+                 registry: Union[MetricsRegistry,
+                                 Callable[[], MetricsRegistry],
+                                 None] = None,
+                 request_timeout_s: float = 50.0):
+        import jax
+
+        from ..models.generate import decode_config
+        from ..models.transformer import TransformerLM
+
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if chunk_tokens < 1:
+            raise ValueError("chunk_tokens must be >= 1")
+        self.cfg = decode_config(cfg)
+        self.name = name
+        self.n_slots = n_slots
+        self.chunk_tokens = chunk_tokens
+        self.max_queue = max_queue if max_queue is not None else 4 * n_slots
+        # Below the router's 60s backend timeout: a queue-starved
+        # request fails with a clean engine error, never a router 502.
+        self.request_timeout_s = request_timeout_s
+        self._registry = registry
+        self.model = TransformerLM(self.cfg)
+        self.params = jax.device_put(params)
+        # Donating the carried device state (cache + logits buffer)
+        # makes each chunk update in place on accelerators; on the CPU
+        # backend donation is unsupported noise, skip it.
+        self._donate = jax.default_backend() != "cpu"
+
+        L = self.cfg.max_seq_len
+        self.prompt_buckets: List[int] = []
+        b = 8
+        while b <= max(8, L // 2):
+            self.prompt_buckets.append(min(b, L))
+            b *= 2
+
+        # -- device state (touched only by the loop thread after start)
+        self._cache = self._init_cache()
+        self._logbuf = self._init_logbuf()
+        # -- host slot state (numpy mirrors round-tripped per chunk)
+        B = n_slots
+        self._pos = np.zeros((B,), np.int32)       # next decode position
+        self._active = np.zeros((B,), np.bool_)
+        self._produced = np.zeros((B,), np.int32)
+        self._rngs = np.zeros((B, 2), np.uint32)
+        self._temp = np.zeros((B,), np.float32)
+        self._topk = np.zeros((B,), np.int32)
+        self._stop = np.full((B,), -1, np.int32)
+        self._max_new = np.zeros((B,), np.int32)
+        self._slots: List[Optional[Request]] = [None] * B
+
+        # -- compiled executables (AOT, so a background warm populates
+        # the same table the admission path reads — no jit-cache games)
+        self._exec_lock = threading.Lock()
+        self._prefill_exec: Dict[int, Any] = {}
+        self._decode_exec: Any = None
+
+        self._cond = threading.Condition()
+        self._queue: "deque[Request]" = deque()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"kfx-engine-{name}")
+        self._thread.start()
+        self._touch_gauges()
+
+    # -- metrics -------------------------------------------------------------
+    def _reg(self) -> MetricsRegistry:
+        r = self._registry
+        if callable(r):
+            return r()
+        return r if r is not None else default_registry()
+
+    def _touch_gauges(self) -> None:
+        reg = self._reg()
+        reg.gauge("kfx_lm_slots",
+                  "Decode-engine KV-cache slots.").set(
+                      self.n_slots, model=self.name)
+        reg.gauge("kfx_lm_slot_occupancy",
+                  "Decode-engine slots currently generating.").set(
+                      int(self._active_count()), model=self.name)
+        reg.gauge("kfx_lm_queue_depth",
+                  "Requests waiting for a decode-engine slot.").set(
+                      len(self._queue), model=self.name)
+
+    def _active_count(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- cache / compiled functions ------------------------------------------
+    def _init_cache(self):
+        """Zeros of the decode cache pytree for B=n_slots (positions
+        -1 = every location empty), built from eval_shape — no compile,
+        no dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        def mk(p):
+            toks = jnp.zeros((self.n_slots, 1), jnp.int32)
+            pos = jnp.full((self.n_slots, 1), -1, jnp.int32)
+            return self.model.apply({"params": p}, toks, positions=pos,
+                                    mutable=["cache"])[1]["cache"]
+
+        shapes = jax.eval_shape(mk, self.params)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+        leaves = []
+        for path, s in flat:
+            name = getattr(path[-1], "key", str(path[-1]))
+            if name == "cached_pos":
+                leaves.append(jnp.full(s.shape, -1, s.dtype))
+            else:
+                leaves.append(jnp.zeros(s.shape, s.dtype))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _init_logbuf(self):
+        import jax.numpy as jnp
+
+        return jnp.zeros((self.n_slots, self.cfg.vocab_size), np.float32)
+
+    def _cache_specs(self):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._cache)
+
+    def _prefill_for(self, P: int):
+        """The AOT-compiled prefill executable for prompt bucket P
+        (compile-on-demand; the warm thread populates the same table)."""
+        with self._exec_lock:
+            fn = self._prefill_exec.get(P)
+        if fn is not None:
+            return fn
+        fn = self._build_prefill(P)
+        with self._exec_lock:
+            return self._prefill_exec.setdefault(P, fn)
+
+    def _build_prefill(self, P: int):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+
+        def run(params, cache, logbuf, tokens, slot, true_len):
+            """tokens [1, P] right-padded; writes slot row + last-real-
+            token logits. Pads carry position -1: masked out of every
+            attention, so padding never changes the numbers (the
+            LMGenerator contract, unchanged)."""
+            pos = jnp.arange(P, dtype=jnp.int32)[None, :]
+            pos = jnp.where(pos < true_len, pos, -1)
+            logits, vars_ = model.apply({"params": params}, tokens,
+                                        positions=pos, mutable=["cache"])
+            row = vars_["cache"]  # fresh B=1 cache: [layers, 1, ...]
+            cache = jax.tree_util.tree_map(
+                lambda big, small: jax.lax.dynamic_update_slice_in_dim(
+                    big, small.astype(big.dtype), slot, axis=1),
+                cache, row)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1)[0, 0]  # [V]
+            logbuf = jax.lax.dynamic_update_slice_in_dim(
+                logbuf, last[None, :].astype(logbuf.dtype), slot, axis=0)
+            return cache, logbuf
+
+        donate = (1, 2) if self._donate else ()
+        specs = (
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                self.params),
+            self._cache_specs(),
+            jax.ShapeDtypeStruct((self.n_slots, self.cfg.vocab_size),
+                                 np.float32),
+            jax.ShapeDtypeStruct((1, P), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+        )
+        return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+
+    def _decode(self):
+        with self._exec_lock:
+            fn = self._decode_exec
+        if fn is not None:
+            return fn
+        fn = self._build_decode()
+        with self._exec_lock:
+            if self._decode_exec is None:
+                self._decode_exec = fn
+            return self._decode_exec
+
+    def _build_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import _sample
+
+        model, k = self.model, self.chunk_tokens
+
+        def sample_slots(logits, keys, temp, topk):
+            # vmap the shared one-row sampler: per-slot RNG stream AND
+            # per-slot client knobs (two requests in one chunk may ask
+            # for different temperatures).
+            return jax.vmap(
+                lambda l, kk, t, tk: _sample(l[None], kk, t, tk)[0]
+            )(logits, keys, temp, topk)
+
+        def run(params, cache, logbuf, pos, active, produced, rngs,
+                temp, topk, stop, max_new):
+            def step(carry, _):
+                cache, logits, pos, active, produced, rngs = carry
+                split = jax.vmap(jax.random.split)(rngs)  # [B, 2, 2]
+                next_rngs, sub = split[:, 0], split[:, 1]
+                tok = sample_slots(logits, sub, temp, topk)  # [B]
+                is_stop = (stop >= 0) & (tok == stop)
+                # The stop token itself is never emitted: the slot
+                # retires and the request returns the tokens before it.
+                emit = active & (~is_stop)
+                produced2 = produced + emit.astype(jnp.int32)
+                active2 = emit & (produced2 < max_new)
+                # Inactive slots feed a masked dummy step: position -1
+                # keeps their query row fully masked and their cache
+                # writes invalid, so a retired slot's garbage can never
+                # reach an active slot (rows are independent anyway).
+                feed = jnp.where(active, tok, 0)
+                eff_pos = jnp.where(active, pos, -1).astype(jnp.int32)
+                logits2, vars_ = model.apply(
+                    {"params": params, "cache": cache}, feed[:, None],
+                    positions=eff_pos[:, None], mutable=["cache"])
+                pos2 = jnp.where(active, pos + 1, pos)
+                return ((vars_["cache"], logits2[:, 0], pos2, active2,
+                         produced2, next_rngs), (tok, emit))
+
+            carry = (cache, logbuf, pos, active, produced, rngs)
+            carry, (toks, emits) = jax.lax.scan(step, carry, None,
+                                                length=k)
+            cache, logbuf, pos, active, produced, rngs = carry
+            return (cache, logbuf, pos, active, produced, rngs,
+                    toks, emits)
+
+        donate = (1, 2) if self._donate else ()
+        B, V = self.n_slots, self.cfg.vocab_size
+        sds = jax.ShapeDtypeStruct
+        specs = (
+            jax.tree_util.tree_map(lambda x: sds(x.shape, x.dtype),
+                                   self.params),
+            self._cache_specs(),
+            sds((B, V), np.float32),
+            sds((B,), np.int32),      # pos
+            sds((B,), np.bool_),      # active
+            sds((B,), np.int32),      # produced
+            sds((B, 2), np.uint32),   # rngs
+            sds((B,), np.float32),    # temp
+            sds((B,), np.int32),      # topk
+            sds((B,), np.int32),      # stop
+            sds((B,), np.int32),      # max_new
+        )
+        return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
+        """Compile the decode chunk and the prefill for ``buckets``
+        (default: every configured prompt bucket). Returns the number
+        of compiled executables now available. Safe to call from a
+        background thread: it only populates the AOT tables, never the
+        live slot state."""
+        self._decode()
+        for b in buckets if buckets is not None else self.prompt_buckets:
+            self._prefill_for(int(b))
+        with self._exec_lock:
+            return len(self._prefill_exec) + 1
+
+    # -- submission ----------------------------------------------------------
+    def _make_request(self, prompt: Sequence[int], max_new_tokens: int,
+                      temperature: float, top_k: int, seed: int,
+                      stop_token: Optional[int]) -> Request:
+        from ..models.generate import pow2_bucket
+
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        L = self.cfg.max_seq_len
+        if len(prompt) + max_new_tokens > L:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the cache capacity {L}")
+        # The prompt pads to a power-of-two bucket (compile sharing);
+        # bucket + budget must fit the slot, so a tight request falls
+        # back to an exact-fit bucket — pow2_bucket IS LMGenerator's
+        # bucket policy (shared helper), keeping oracle parity.
+        bucket = pow2_bucket(len(prompt), L - max_new_tokens)
+        return Request(prompt, int(max_new_tokens), float(temperature),
+                       int(top_k), int(seed),
+                       -1 if stop_token is None else int(stop_token),
+                       bucket)
+
+    def _enqueue(self, reqs: List[Request]) -> None:
+        """All-or-nothing enqueue: a batch that does not fit the
+        bounded queue is rejected WHOLE — partial admission would
+        orphan the admitted fraction (decoding with no waiter) exactly
+        when the engine is most loaded."""
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("engine is closed")
+            if len(self._queue) + len(reqs) > self.max_queue:
+                raise EngineOverloaded(
+                    f"admission queue full ({len(self._queue)} waiting, "
+                    f"{len(reqs)} arriving, cap {self.max_queue})")
+            self._queue.extend(reqs)
+            depth = len(self._queue)
+            self._cond.notify()
+        self._reg().gauge("kfx_lm_queue_depth",
+                          "Requests waiting for a decode-engine slot."
+                          ).set(depth, model=self.name)
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               stop_token: Optional[int] = None) -> Request:
+        """Enqueue one prompt; returns the request handle (wait with
+        ``.result(timeout)``). Raises EngineOverloaded when the bounded
+        admission queue is full."""
+        req = self._make_request(prompt, max_new_tokens, temperature,
+                                 top_k, seed, stop_token)
+        self._enqueue([req])
+        return req
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0,
+                 stop_token: Optional[int] = None) -> List[List[int]]:
+        """Blocking convenience mirroring LMGenerator.generate: one
+        request per prompt (seeded seed+i), results in prompt order.
+        The batch enqueues atomically, and one deadline covers the
+        whole batch (request_timeout_s sits under the router's 60s
+        backend timeout — per-request fresh clocks could stack past
+        it)."""
+        reqs = [self._make_request(p, max_new_tokens, temperature,
+                                   top_k, seed + i, stop_token)
+                for i, p in enumerate(prompts)]
+        self._enqueue(reqs)
+        deadline = time.monotonic() + self.request_timeout_s
+        return [r.result(max(0.001, deadline - time.monotonic()))
+                for r in reqs]
+
+    # -- the decode loop -----------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._stopped and not self._queue
+                       and self._active_count() == 0):
+                    self._cond.wait()
+                if self._stopped:
+                    return
+            try:
+                self._admit_ready()
+                if self._active_count():
+                    self._decode_once()
+            except BaseException as e:  # a broken dispatch fails the
+                self._fail_inflight(e)  # requests, never the engine
+                time.sleep(0.01)
+
+    def _admit_ready(self) -> None:
+        """Admit queued requests into free slots (runs between chunks —
+        iteration-level scheduling, never mid-dispatch)."""
+        while True:
+            with self._cond:
+                free = [i for i, r in enumerate(self._slots) if r is None]
+                if not free or not self._queue:
+                    break
+                req = self._queue.popleft()
+            try:
+                self._admit(req, free[0])
+            except BaseException as e:
+                # A failed prefill (compile/OOM) fails THIS request —
+                # the req is not in a slot yet, so the loop-level
+                # failure net would never resolve its future. (_admit
+                # itself handles the donated-carry rebuild when the
+                # failure was mid-dispatch.)
+                req._finish(e)
+        self._touch_gauges()
+
+    def _admit(self, req: Request, slot: int) -> None:
+        import jax
+
+        # Fault point: admission failure/latency — the engine-era
+        # analogue of serving.predict (docs/chaos.md).
+        inj = chaos.draw("engine.admit", target=self.name)
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                req._finish(RuntimeError(
+                    f"chaos[engine.admit]: {self.name}"))
+                return
+        wait = time.monotonic() - req.t_enqueue
+        self._reg().histogram(
+            "kfx_lm_queue_wait_seconds",
+            "Decode-engine admission wait (enqueue to slot prefill).",
+            buckets=QUEUE_WAIT_BUCKETS).observe(wait, model=self.name)
+        tokens = np.zeros((1, req.bucket), np.int32)
+        tokens[0, :len(req.prompt)] = req.prompt
+        with obs_trace.span("engine.admit", trace_id=req.trace_id,
+                            parent_id=req.span_id, model=self.name,
+                            slot=str(slot), bucket=str(req.bucket)):
+            # A compile failure here leaves the carry untouched (only
+            # this request fails, in _admit_ready's net)...
+            fn = self._prefill_for(req.bucket)
+            try:
+                self._cache, self._logbuf = fn(
+                    self.params, self._cache, self._logbuf, tokens,
+                    np.int32(slot), np.int32(len(req.prompt)))
+            except BaseException as e:
+                if self._donate:
+                    # ...but a failed DISPATCH may have died after the
+                    # donation, deleting the carried buffers — and with
+                    # them every active slot's KV. Fail those requests
+                    # honestly and rebuild, or the next decode_chunk
+                    # crashes on deleted arrays.
+                    self._fail_inflight(e)
+                raise
+        self._pos[slot] = len(req.prompt)
+        self._active[slot] = True
+        self._produced[slot] = 0
+        self._rngs[slot] = np.asarray(jax.random.PRNGKey(req.seed),
+                                      np.uint32)
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._stop[slot] = req.stop
+        self._max_new[slot] = req.max_new
+        self._slots[slot] = req
+
+    def _decode_once(self) -> None:
+        oldest = min((r for r in self._slots if r is not None),
+                     key=lambda r: r.t_enqueue)
+        n_active = self._active_count()
+        with obs_trace.span("engine.chunk", trace_id=oldest.trace_id,
+                            parent_id=oldest.span_id, model=self.name,
+                            slots=str(n_active),
+                            k=str(self.chunk_tokens)):
+            out = self._decode()(
+                self.params, self._cache, self._logbuf, self._pos,
+                self._active, self._produced, self._rngs, self._temp,
+                self._topk, self._stop, self._max_new)
+        (self._cache, self._logbuf, pos, active, produced, rngs,
+         toks, emits) = out
+        # np.array (copy): admission mutates these rows in place, and a
+        # bare asarray of a jax output is a read-only view.
+        self._pos = np.array(pos)
+        self._active = np.array(active)
+        self._produced = np.array(produced)
+        self._rngs = np.array(rngs)
+        toks = np.asarray(toks)    # [k, B]
+        emits = np.asarray(emits)  # [k, B] bool
+        reg = self._reg()
+        reg.counter("kfx_lm_engine_chunks_total",
+                    "Decode-chunk dispatches.").inc(1, model=self.name)
+        emitted = 0
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            hits = np.flatnonzero(emits[:, slot])
+            req.tokens.extend(int(t) for t in toks[hits, slot])
+            emitted += len(hits)
+            if not self._active[slot]:
+                self._slots[slot] = None
+                req._finish()
+        if emitted:
+            reg.counter("kfx_lm_generated_tokens_total",
+                        "Tokens generated since startup.").inc(
+                            emitted, model=self.name)
+        self._touch_gauges()
+
+    def _fail_inflight(self, e: BaseException) -> None:
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[slot] = None
+                req._finish(e)
+        self._active[:] = False
+        if not self._stopped:
+            # A dispatch that died mid-donation leaves the carried
+            # device buffers invalidated — rebuild so the engine keeps
+            # serving the next requests.
+            self._cache = self._init_cache()
+            self._logbuf = self._init_logbuf()
+        self._touch_gauges()
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        """Stop the loop and fail every in-flight/queued request (a
+        racing submit gets an immediate error, never a timeout)."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            queued = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+        err = RuntimeError("engine closed")
+        for req in queued:
+            req._finish(err)
+        self._fail_inflight(err)

@@ -1,14 +1,23 @@
 """LM serving: text-generation predictor behind the model server.
 
 Export format (``export_lm``): ``lm_config.json`` (the TransformerConfig,
-dtypes as strings) + ``params.msgpack``. The predictor wraps
-models/generate.LMGenerator — jitted KV-cache prefill + scan decode, one
-device dispatch per request — and serves a ``:generate`` verb:
+dtypes as strings) + ``params.msgpack``. The predictor serves a
+``:generate`` verb:
 
     POST /v1/models/{m}:generate
     {"prompt_tokens": [[1,2,3], ...], "max_new_tokens": 32,
-     "temperature": 0.7, "top_k": 40, "seed": 1}
+     "temperature": 0.7, "top_k": 40, "seed": 1, "stop_token": 2}
     -> {"generated_tokens": [[...], ...]}
+
+Two decode backends share the same model and the same HTTP contract:
+
+  * the continuous-batching DecodeEngine (serving/engine.py, default) —
+    each prompt becomes its own slotted request, admitted mid-flight
+    between decode chunks, so concurrent traffic batches on-device and
+    short requests retire past long ones;
+  * the one-shot LMGenerator (models/generate.py, ``KFX_LM_ENGINE=0``)
+    — run-to-completion; kept as the greedy-parity oracle and escape
+    hatch (it does not support ``stop_token``).
 
 Tokenization is caller-side (the platform is tokenizer-agnostic, like
 the reference's bring-your-own-model servers).
@@ -19,8 +28,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
-from typing import Any, Dict
+from collections import deque
+from typing import Any, Dict, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -65,18 +76,61 @@ def is_lm_export(model_dir: str) -> bool:
     return os.path.exists(os.path.join(model_dir, CONFIG_FILE))
 
 
+class _RateWindow:
+    """Sliding-window token-rate tracker: ``kfx_lm_tokens_per_second``
+    is tokens counted over the trailing window, not the last call's
+    instantaneous ratio — so a burst decays honestly toward 0 instead
+    of a stale headline sticking to /metrics forever."""
+
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._events: "deque[tuple]" = deque()  # (monotonic ts, tokens)
+
+    def record(self, n_tokens: int) -> None:
+        with self._lock:
+            self._events.append((time.monotonic(), n_tokens))
+
+    def rate(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            while self._events and self._events[0][0] < now - self.window_s:
+                self._events.popleft()
+            if not self._events:
+                return 0.0
+            total = sum(n for _, n in self._events)
+            span = now - self._events[0][0]
+        # Normalize by the span actually covered (floored at 1s so a
+        # single fresh burst doesn't explode, capped at the window).
+        return total / min(max(span, 1.0), self.window_s)
+
+
 class LMPredictor(Predictor):
     """Generate-only predictor (classification ``:predict`` does not
-    apply; the server routes ``:generate`` here)."""
+    apply; the server routes ``:generate`` here).
+
+    ``KFX_LM_ENGINE`` (default on) selects the continuous-batching
+    DecodeEngine; ``=0`` falls back to the one-shot LMGenerator oracle.
+    ``n_slots`` is ``max_batch_size`` — with the engine the old hard
+    batch rejection becomes bounded queueing (engine.max_queue)."""
 
     def __init__(self, model_dir: str, name: str = "",
-                 max_batch_size: int = 8, device: str = "auto"):
+                 max_batch_size: int = 8, device: str = "auto",
+                 warm_buckets: Optional[Sequence[int]] = None):
         self.model_dir = model_dir
         self.name = name or "model"
         self.max_batch_size = max_batch_size
         self.device = device
         self._gen = None
+        self._engine = None
+        self._rate = _RateWindow()
+        self._warm_count = 0
+        self._warm_thread: Optional[threading.Thread] = None
         self.vocab_size = 0
+        self.use_engine = os.environ.get("KFX_LM_ENGINE", "1") != "0"
+        self.chunk_tokens = int(
+            os.environ.get("KFX_LM_ENGINE_CHUNK", "8"))
+        self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
         self.metrics = default_registry()
@@ -84,17 +138,81 @@ class LMPredictor(Predictor):
     def load(self) -> None:
         import jax
 
-        from ..models.generate import LMGenerator
-
         cfg, params = load_lm(self.model_dir)
         if self.device == "cpu":
             params = jax.device_put(params, jax.devices("cpu")[0])
         self.vocab_size = cfg.vocab_size
-        self._gen = LMGenerator(cfg, params)
-        # Pre-warm the smallest bucket so the first request doesn't pay
-        # the prefill+decode compile.
-        self._gen.generate([[0]], max_new_tokens=8)
+        if self.use_engine:
+            from .engine import DecodeEngine
+
+            # registry as a thunk: register() swaps self.metrics for
+            # the hosting server's registry AFTER load; the engine must
+            # follow it, not pin whatever was current at construction.
+            self._engine = DecodeEngine(
+                cfg, params, n_slots=self.max_batch_size,
+                chunk_tokens=self.chunk_tokens, name=self.name,
+                registry=lambda: self.metrics)
+            buckets = self.warm_buckets or self._engine.prompt_buckets
+            # First bucket + the decode chunk warm synchronously —
+            # ready means "can serve one request without a compile".
+            self._engine.warm(buckets[:1])
+            self._set_warm(1)
+            rest = buckets[1:]
+        else:
+            from ..models.generate import LMGenerator
+
+            self._gen = LMGenerator(cfg, params)
+            L = self._gen.cfg.max_seq_len
+            buckets = self.warm_buckets or [
+                b for b in (8, 16, 32, 64, 128, 256, 512, 1024, 2048)
+                if b <= max(8, L // 2)]
+            # A length-b all-zeros prompt pads to exactly bucket b, so
+            # each warm call compiles that bucket's prefill+decode.
+            self._gen.generate([[0] * buckets[0]], max_new_tokens=8)
+            self._set_warm(1)
+            rest = buckets[1:]
         self.ready = True
+        # The remaining buckets compile on a background thread: the
+        # first real request on a warm bucket pays nothing, and
+        # readiness of the full bucket set is observable via the
+        # kfx_lm_warm_buckets gauge instead of a first-request stall.
+        self._warm_thread = threading.Thread(
+            target=self._warm_rest, args=(rest,), daemon=True,
+            name=f"kfx-lm-warm-{self.name}")
+        self._warm_thread.start()
+
+    def _set_warm(self, n: int) -> None:
+        self._warm_count = n
+        self.metrics.gauge(
+            "kfx_lm_warm_buckets",
+            "Prompt buckets with compiled decode paths.").set(
+                n, model=self.name)
+
+    def on_metrics_attached(self) -> None:
+        """ModelServer.register swapped ``self.metrics`` — re-seed the
+        load-time gauges (slots, occupancy, warm progress) onto the new
+        registry so a scrape before the first request sees them."""
+        if self._warm_count:
+            self._set_warm(self._warm_count)
+        if self._engine is not None:
+            self._engine._touch_gauges()
+
+    def _warm_rest(self, buckets) -> None:
+        done = 1
+        for b in buckets:
+            try:
+                if self._engine is not None:
+                    self._engine.warm([b])
+                else:
+                    self._gen.generate([[0] * b], max_new_tokens=8)
+            except Exception:
+                continue  # a failed warm costs the first request, only
+            done += 1
+            self._set_warm(done)
+
+    def close(self) -> None:
+        if self._engine is not None:
+            self._engine.close()
 
     def predict(self, instances, probabilities: bool = False
                 ) -> Dict[str, Any]:
@@ -108,35 +226,51 @@ class LMPredictor(Predictor):
                              "is required")
         if isinstance(prompts[0], int):  # single prompt convenience
             prompts = [prompts]
-        if len(prompts) > self.max_batch_size:
+        limit = (self._engine.max_queue if self._engine is not None
+                 else self.max_batch_size)
+        if len(prompts) > limit:
             raise ValueError(f"batch {len(prompts)} exceeds "
-                             f"max_batch_size {self.max_batch_size}")
+                             f"{'queue capacity' if self._engine is not None else 'max_batch_size'} "
+                             f"{limit}")
         for p in prompts:
             arr = np.asarray(p)
             if arr.size == 0 or arr.min() < 0 or \
                     arr.max() >= self.vocab_size:
                 raise ValueError(
                     f"prompt token ids must be in [0, {self.vocab_size})")
+        stop = body.get("stop_token")
+        if stop is not None:
+            stop = int(stop)
+            if self._engine is None:
+                raise ValueError(
+                    "stop_token requires the engine path "
+                    "(KFX_LM_ENGINE=1)")
+        prompts = [list(map(int, p)) for p in prompts]
+        kw = dict(max_new_tokens=int(body.get("max_new_tokens", 32)),
+                  temperature=float(body.get("temperature", 0.0)),
+                  top_k=int(body.get("top_k", 0)),
+                  seed=int(body.get("seed", 0)))
         t0 = time.perf_counter()
-        out = self._gen.generate(
-            [list(map(int, p)) for p in prompts],
-            max_new_tokens=int(body.get("max_new_tokens", 32)),
-            temperature=float(body.get("temperature", 0.0)),
-            top_k=int(body.get("top_k", 0)),
-            seed=int(body.get("seed", 0)))
+        if self._engine is not None:
+            out = self._engine.generate(prompts, stop_token=stop, **kw)
+        else:
+            out = self._gen.generate(prompts, **kw)
         elapsed = time.perf_counter() - t0
         n_tokens = sum(len(ids) for ids in out)
         tps = n_tokens / elapsed if elapsed > 0 else 0.0
         # Decode throughput is the LM serving headline (BENCH lm rows);
         # exporting it makes `kfx top` and /metrics agree with bench.
-        self.metrics.counter(
-            "kfx_lm_generated_tokens_total",
-            "Tokens generated since startup.").inc(n_tokens,
-                                                   model=self.name)
+        self._rate.record(n_tokens)
+        if self._engine is None:
+            # The engine counts emitted tokens itself, per chunk.
+            self.metrics.counter(
+                "kfx_lm_generated_tokens_total",
+                "Tokens generated since startup.").inc(n_tokens,
+                                                       model=self.name)
         self.metrics.gauge(
             "kfx_lm_tokens_per_second",
-            "Decode throughput of the most recent generate call.").set(
-                round(tps, 2), model=self.name)
+            "Decode throughput over the trailing 30s window.").set(
+                round(self._rate.rate(), 2), model=self.name)
         self.metrics.histogram(
             "kfx_lm_generate_seconds",
             "Wall time of generate calls.").observe(elapsed,

@@ -37,6 +37,7 @@ from .. import chaos
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import SPAN_HEADER, TRACE_HEADER
+from .engine import EngineOverloaded
 
 request_log = logging.getLogger("kfx.serving")
 
@@ -421,15 +422,22 @@ class ModelServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+            def _send(self, code: int, payload: Dict[str, Any],
+                      extra_headers: Optional[Dict[str, str]] = None
+                      ) -> None:
                 self._send_text(code, json.dumps(payload),
-                                "application/json")
+                                "application/json",
+                                extra_headers=extra_headers)
 
-            def _send_text(self, code: int, text: str, ctype: str) -> None:
+            def _send_text(self, code: int, text: str, ctype: str,
+                           extra_headers: Optional[Dict[str, str]] = None
+                           ) -> None:
                 body = text.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 trace = self.headers.get(TRACE_HEADER, "")
                 if trace:
                     # Echo the caller's correlation ID (obs.trace flow).
@@ -526,6 +534,12 @@ class ModelServer:
         # Predictors with their own instruments (LM tokens/sec) record
         # into the server's registry so one /metrics shows everything.
         predictor.metrics = self.metrics
+        hook = getattr(predictor, "on_metrics_attached", None)
+        if hook is not None:
+            # Re-seed gauges set before the swap (engine slot counts,
+            # warm-bucket progress) so a scrape before the first
+            # request already sees them on THIS registry.
+            hook()
         if batcher:
             self.batchers[predictor.name] = MicroBatcher(
                 predictor,
@@ -669,6 +683,13 @@ class ModelServer:
         except ValueError as e:
             h._send(400, {"error": str(e)})
             return
+        except EngineOverloaded as e:
+            # Bounded-queueing overflow is load shedding, not a client
+            # mistake and not a server fault: 503 + Retry-After, the
+            # same contract the router uses while scaling from zero.
+            h._send(503, {"error": str(e)},
+                    extra_headers={"Retry-After": "1"})
+            return
         except Exception as e:
             h._send(500, {"error": str(e)})
             return
@@ -684,6 +705,12 @@ class ModelServer:
     def stop(self) -> None:
         for b in self.batchers.values():
             b.close()
+        for p in self.predictors.values():
+            # Predictors with their own machinery (the LM decode
+            # engine's loop thread) resolve in-flight requests here.
+            close = getattr(p, "close", None)
+            if close is not None:
+                close()
         self.httpd.shutdown()
         self.httpd.server_close()
 
@@ -694,7 +721,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="export directory (storageUri)")
     p.add_argument("--name", default="model")
     p.add_argument("--port", type=int, default=8080)
-    p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument("--max-batch-size", type=int, default=None,
+                   help="classifiers default 64 (request bucketing); LM "
+                        "defaults 8 — with the decode engine this sizes "
+                        "the slotted KV cache, which is real HBM "
+                        "(n_slots x max_seq_len per layer)")
     p.add_argument("--device", default="auto",
                    choices=["auto", "default", "cpu"],
                    help="bucket placement: auto probes accelerator vs host")
@@ -728,6 +759,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             framework = "sklearn"
         else:
             framework = "jax"
+    if args.max_batch_size is None:
+        args.max_batch_size = 8 if framework == "lm" else 64
     if framework == "lm":
         from .lm_server import LMPredictor
 

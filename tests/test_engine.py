@@ -1,0 +1,380 @@
+"""Continuous-batching decode engine (serving/engine.py): greedy parity
+with the one-shot LMGenerator oracle, iteration-level admission
+(short requests retire past long ones), stop-token early retirement,
+the >=3x concurrent-throughput win, bounded-queueing overload, chaos at
+the engine.admit fault point, and the /metrics + span surfaces."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu import chaos
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            head_dim=16, n_layers=2, d_ff=64,
+                            max_seq_len=64, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_lm):
+    # Module-scoped: every test drains its requests, so the shared
+    # engine is idle between tests and each one skips the ~4s AOT warm.
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg, params = tiny_lm
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4, name="lm")
+    yield eng
+    eng.close()
+
+
+class TestEngineDecode:
+    def test_greedy_parity_mixed_lengths(self, tiny_lm, engine):
+        """Engine output == one-shot LMGenerator output token-for-token
+        for a mix of prompt lengths (the acceptance-criteria oracle)."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        prompts = [[5, 9, 11, 3, 7], [2], [1, 2, 3, 4, 5, 6, 7, 8, 9],
+                   [13, 14]]
+        out = engine.generate(prompts, max_new_tokens=12)
+        # Oracle per prompt (B=1): row-independent, so per-prompt
+        # one-shot equals the batched one-shot equals the engine.
+        ref = [gen.generate([p], max_new_tokens=12)[0] for p in prompts]
+        assert out == ref
+
+    def test_slot_reuse_stays_exact(self, tiny_lm, engine):
+        """Back-to-back waves through the same slots: reuse must not
+        leak KV between requests (prefill overwrites the whole row)."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        first = engine.generate([[7, 8, 9]] * 4, max_new_tokens=20)
+        second = engine.generate([[5, 9, 11]] * 4, max_new_tokens=8)
+        assert second == [gen.generate([[5, 9, 11]],
+                                       max_new_tokens=8)[0]] * 4
+        assert first[0] == gen.generate([[7, 8, 9]],
+                                        max_new_tokens=20)[0]
+
+    def test_sampling_deterministic_per_request(self, engine):
+        a = engine.generate([[1, 2, 3]], max_new_tokens=12,
+                            temperature=1.0, seed=1)
+        b = engine.generate([[1, 2, 3]], max_new_tokens=12,
+                            temperature=1.0, seed=1)
+        c = engine.generate([[1, 2, 3]], max_new_tokens=12,
+                            temperature=1.0, seed=2)
+        assert a == b
+        assert a != c
+
+    def test_midflight_admission(self, engine):
+        """A short request admitted while a long one decodes retires
+        first — run-to-completion would have serialized it behind the
+        long request's full budget."""
+        long_req = engine.submit([1, 2, 3], max_new_tokens=48)
+        # Let the long request actually start decoding before the
+        # short one arrives — admission happens at a chunk boundary
+        # mid-flight, not in the same admission wave.
+        deadline = time.monotonic() + 30
+        while not engine._active[:].any() and time.monotonic() < deadline:
+            time.sleep(0.002)
+        short_req = engine.submit([4, 5], max_new_tokens=4)
+        assert short_req.result(60) is not None
+        long_req.result(60)
+        assert len(long_req.tokens) == 48
+        assert len(short_req.tokens) == 4
+        # Completion stamps, not wall-clock guesses: the short
+        # request finished strictly before the long one.
+        assert short_req.t_done < long_req.t_done
+
+    def test_stop_token_early_retirement(self, tiny_lm, engine):
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        ref = gen.generate([[5, 9, 11, 3, 7]], max_new_tokens=12)[0]
+        stop = ref[3]
+        out = engine.generate([[5, 9, 11, 3, 7]], max_new_tokens=12,
+                              stop_token=stop)[0]
+        # Truncated at (excluding) the stop token, slot freed early.
+        assert out == ref[:3]
+        assert engine._active_count() == 0
+
+    def test_capacity_guard_and_validation(self, engine):
+        with pytest.raises(ValueError, match="cache capacity"):
+            engine.submit([1] * 60, max_new_tokens=32)
+        with pytest.raises(ValueError, match="non-empty"):
+            engine.submit([], max_new_tokens=4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit([1], max_new_tokens=0)
+
+    def test_bounded_queueing_overload(self, tiny_lm):
+        from kubeflow_tpu.serving.engine import (
+            DecodeEngine, EngineOverloaded)
+
+        cfg, params = tiny_lm
+        eng = DecodeEngine(cfg, params, n_slots=1, chunk_tokens=2,
+                           max_queue=2, name="lm")
+        try:
+            eng.warm([8])
+            first = eng.submit([1, 2], max_new_tokens=40)
+            # Wait until the first request owns the only slot, so the
+            # next two deterministically queue behind it.
+            deadline = time.monotonic() + 30
+            while eng.queue_depth and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert eng.queue_depth == 0
+            reqs = [first] + [eng.submit([1, 2], max_new_tokens=40)
+                              for _ in range(2)]
+            with pytest.raises(EngineOverloaded):
+                eng.submit([1, 2], max_new_tokens=40)
+            for r in reqs:
+                assert len(r.result(60)) == 40
+        finally:
+            eng.close()
+
+    def test_chaos_engine_admit(self, engine):
+        chaos.install(chaos.parse_spec("engine.admit:count=1"))
+        try:
+            req = engine.submit([1, 2, 3], max_new_tokens=4)
+            with pytest.raises(RuntimeError, match="chaos"):
+                req.result(30)
+            assert chaos.injected_counts().get("engine.admit") >= 1
+            # The budget is spent: the next request serves normally.
+            assert len(engine.generate([[1, 2, 3]],
+                                       max_new_tokens=4)[0]) == 4
+        finally:
+            chaos.reset()
+
+
+class TestEngineThroughput:
+    def test_concurrent_throughput_3x(self):
+        """Acceptance criterion: 8 concurrent single-prompt requests
+        decode >= 3x faster through the engine than serialized
+        run-to-completion, with greedy outputs byte-identical. The
+        model is sized so per-step compute (not dispatch overhead)
+        dominates — the regime the engine exists for."""
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        # Weight-streaming-bound shape: per-step cost is dominated by
+        # reading ~5M f32 params, so a batch-8 step costs about the
+        # same as a batch-1 step and the engine's win is structural
+        # (→8x), not a dispatch-overhead accident a loaded CI host can
+        # erode below the asserted floor.
+        cfg = TransformerConfig(vocab_size=512, d_model=512, n_heads=4,
+                                head_dim=128, n_layers=2, d_ff=2048,
+                                max_seq_len=128, dtype=jnp.float32)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        gen = LMGenerator(cfg, params)
+        eng = DecodeEngine(cfg, params, n_slots=8, chunk_tokens=8,
+                           name="lm")
+        try:
+            prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+            new = 16  # a pow2 bucket: the serial leg scans exactly 16
+            gen.generate([prompts[0]], max_new_tokens=new)  # warm
+            eng.generate([prompts[0]], max_new_tokens=new)  # warm
+
+            # One serial rep, doubling as the parity reference (a load
+            # spike there only RAISES the measured speedup); best-of-2
+            # on the engine leg, where a spike could unfairly sink it.
+            t0 = time.perf_counter()
+            serial = [gen.generate([p], max_new_tokens=new)[0]
+                      for p in prompts]
+            serial_s = time.perf_counter() - t0
+            engine_s = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = eng.generate(prompts, max_new_tokens=new)
+                engine_s = min(engine_s, time.perf_counter() - t0)
+                assert out == serial  # byte-identical greedy
+            speedup = serial_s / engine_s
+            assert speedup >= 3.0, (
+                f"aggregate throughput {speedup:.1f}x < 3x "
+                f"(serial {serial_s:.2f}s, engine {engine_s:.2f}s)")
+        finally:
+            eng.close()
+
+
+class TestEngineServing:
+    @pytest.fixture()
+    def lm_server(self, tiny_lm, tmp_path, monkeypatch):
+        from kubeflow_tpu.serving.lm_server import LMPredictor, export_lm
+        from kubeflow_tpu.serving.server import ModelServer
+
+        monkeypatch.setenv("KFX_LM_ENGINE", "1")
+        cfg, params = tiny_lm
+        export_lm(str(tmp_path / "lm"), cfg, params)
+        p = LMPredictor(str(tmp_path / "lm"), name="lm")
+        p.load()
+        srv = ModelServer(port=0)
+        srv.register(p)
+        srv.start()
+        yield srv, p
+        srv.stop()
+
+    def _generate(self, port, body, timeout=60):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/lm:generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.load(r)
+
+    def test_generate_and_engine_metrics_scrape(self, lm_server):
+        """The served engine path answers :generate, and the engine's
+        observability families survive a validating scrape with
+        --require (the CI pin for this subsystem)."""
+        import scripts.scrape_metrics as scrape
+
+        srv, p = lm_server
+        # Before ANY traffic: the register() hook re-seeded the engine
+        # gauges onto the server registry, so readiness is observable
+        # from the first scrape, not the first request.
+        pre = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+        ).read().decode()
+        assert "kfx_lm_slots{" in pre
+        assert "kfx_lm_warm_buckets{" in pre
+        body = self._generate(srv.port,
+                              {"prompt_tokens": [[5, 9, 11], [2, 4]],
+                               "max_new_tokens": 6})
+        assert [len(t) for t in body["generated_tokens"]] == [6, 6]
+        # Background warm converges and is observable via the gauge.
+        deadline = time.monotonic() + 60
+        want = len(p._engine.prompt_buckets)
+        while time.monotonic() < deadline:
+            if p.metrics.gauge("kfx_lm_warm_buckets").value(
+                    model="lm") >= want:
+                break
+            time.sleep(0.05)
+        assert p.metrics.gauge("kfx_lm_warm_buckets").value(
+            model="lm") >= want
+        rc = scrape.main([f"http://127.0.0.1:{srv.port}/metrics",
+                          "--require", "kfx_lm_slot_occupancy",
+                          "--require", "kfx_lm_queue_wait_seconds",
+                          "--require", "kfx_lm_warm_buckets",
+                          "--require", "kfx_lm_tokens_per_second",
+                          "--require", "kfx_lm_engine_chunks_total"])
+        assert rc == 0
+        # Windowed rate: positive after traffic (not a stale last-call
+        # number), and the queue-wait histogram saw both admissions.
+        assert p.metrics.gauge("kfx_lm_tokens_per_second").value(
+            model="lm") > 0
+        assert p.metrics.histogram("kfx_lm_queue_wait_seconds").count(
+            model="lm") >= 2
+
+    def test_engine_parity_with_oracle_predictor(self, tiny_lm,
+                                                 tmp_path, monkeypatch):
+        """KFX_LM_ENGINE=0 serves the one-shot oracle; the engine
+        path's greedy responses are byte-identical to it."""
+        from kubeflow_tpu.serving.lm_server import LMPredictor, export_lm
+
+        cfg, params = tiny_lm
+        export_lm(str(tmp_path / "lm"), cfg, params)
+        monkeypatch.setenv("KFX_LM_ENGINE", "0")
+        oracle = LMPredictor(str(tmp_path / "lm"), name="lm",
+                             warm_buckets=[8])
+        oracle.load()
+        assert oracle._engine is None  # flag respected
+        monkeypatch.setenv("KFX_LM_ENGINE", "1")
+        engine = LMPredictor(str(tmp_path / "lm"), name="lm",
+                             warm_buckets=[8])
+        engine.load()
+        try:
+            body = {"prompt_tokens": [[5, 9, 11], [2], [1, 2, 3, 4]],
+                    "max_new_tokens": 10}
+            assert engine.generate(dict(body))["generated_tokens"] == \
+                oracle.generate(dict(body))["generated_tokens"]
+            with pytest.raises(ValueError, match="stop_token"):
+                oracle.generate({"prompt_tokens": [[1]],
+                                 "stop_token": 3})
+        finally:
+            engine.close()
+
+    def test_overload_is_503_with_retry_after(self, tiny_lm, tmp_path,
+                                              monkeypatch):
+        from kubeflow_tpu.serving.lm_server import LMPredictor, export_lm
+        from kubeflow_tpu.serving.server import ModelServer
+
+        monkeypatch.setenv("KFX_LM_ENGINE", "1")
+        cfg, params = tiny_lm
+        export_lm(str(tmp_path / "lm"), cfg, params)
+        p = LMPredictor(str(tmp_path / "lm"), name="lm",
+                        max_batch_size=1, warm_buckets=[8])
+        p.load()
+        p._engine.max_queue = 1
+        srv = ModelServer(port=0)
+        srv.register(p)
+        srv.start()
+        try:
+            results, lock = [], threading.Lock()
+
+            def fire():
+                try:
+                    self._generate(srv.port,
+                                   {"prompt_tokens": [[1, 2]],
+                                    "max_new_tokens": 48})
+                    with lock:
+                        results.append((200, ""))
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        results.append(
+                            (e.code, e.headers.get("Retry-After", "")))
+
+            threads = [threading.Thread(target=fire) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            codes = [c for c, _ in results]
+            # Some served, the overflow shed with 503 — never a 500 —
+            # and every 503 carried Retry-After (verified on the main
+            # thread; a worker-thread assert would be swallowed).
+            assert 200 in codes
+            assert 503 in codes
+            assert set(codes) <= {200, 503}
+            assert all(ra for c, ra in results if c == 503)
+        finally:
+            srv.stop()
+
+    def test_engine_spans_recorded(self, engine, tmp_path):
+        """engine.admit / engine.chunk land in the span log under the
+        submitting request's trace, and the log passes the schema
+        validator (the `kfx trace` ingestion contract)."""
+        from kubeflow_tpu.obs import trace as obs_trace
+        import scripts.scrape_metrics as scrape
+
+        path = obs_trace.set_span_sink(str(tmp_path / "spans"), "engine")
+        with obs_trace.span("client.generate",
+                            trace_id="trace-engine-test") as root:
+            engine.generate([[5, 9, 11]], max_new_tokens=6)
+        recs = [json.loads(ln) for ln in
+                open(path).read().splitlines() if ln.strip()]
+        by_name = {}
+        for r in recs:
+            by_name.setdefault(r["name"], []).append(r)
+        assert "engine.admit" in by_name and "engine.chunk" in by_name
+        admit = by_name["engine.admit"][0]
+        assert admit["trace"] == "trace-engine-test"
+        assert admit["parent"] == root.span_id
+        assert by_name["engine.chunk"][0]["trace"] == "trace-engine-test"
+        assert scrape.main(["--spans", str(path)]) == 0
