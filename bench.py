@@ -373,6 +373,14 @@ def main() -> int:
         lm.update(_bench_lm_decode(preset="base", batch=8, prompt_len=512,
                                    max_new=64, max_seq_len=640,
                                    prefix="lm_decode_base_"))
+    if have_time(200, "serving_scale"):
+        # Serving autoscaler (serving/autoscaler.py): sustained RPS ramp
+        # against one InferenceService — scale 0->max on concurrency
+        # (cold start measured), a mid-ramp canary with injected faults
+        # auto-rolled-back on SLO breach, low-priority training
+        # preempted for chips and resumed on scale-in.
+        guard.section("serving_scale")
+        lm.update(_bench_serving_scale())
     if have_time(300, "lm_engine"):
         # Continuous batching (serving/engine.py): aggregate decode
         # throughput with 8 CONCURRENT single-prompt clients vs the
@@ -426,6 +434,10 @@ def main() -> int:
         "resnet50_mfu", "resnet50_best_mfu", "resnet50_images_per_s",
         "lm_decode_base_tokens_per_s", "lm_decode_b16_tokens_per_s",
         "lm_engine_concurrent_tokens_per_s", "lm_engine_speedup",
+        "serving_scale_p50_ms", "serving_scale_p99_ms",
+        "serving_scale_success_rate", "serving_scale_max_replicas",
+        "serving_scale_cold_start_ms", "serving_scale_rolled_back",
+        "serving_scale_preempted_training",
         "cpu_count", "host_speed_score", "load_avg_max",
         "contaminated_sections", "sections_skipped_for_budget",
         "bench_wall_s")
@@ -774,6 +786,255 @@ def _bench_resnet50(steps: int = 60, batch: int = 256,
         return out
     except Exception as e:  # secondary metric must not sink the bench
         return {"resnet50_error": str(e)[:200]}
+
+
+_BROKEN_CANARY = """
+import json, os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def _send(self, code, obj):
+        b = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(b)))
+        self.end_headers()
+        self.wfile.write(b)
+    def do_GET(self):
+        self._send(200, {"ready": True})
+    def do_POST(self):
+        self._send(500, {"error": "injected canary fault"})
+
+HTTPServer(("127.0.0.1", int(os.environ["KFX_PORT"])), H).serve_forever()
+"""
+
+
+def _bench_serving_scale(max_replicas: int = 4, slice_chips: int = 6,
+                         phase_s: float = 8.0) -> dict:
+    """Serving autoscaler ramp (ISSUE 6 acceptance): one sklearn
+    InferenceService under a rising concurrent-client ramp —
+
+    * scale-from-zero cold start (ms, and the autoscale.cold_start span
+      lands on the trace waterfall),
+    * replicas 1 -> maxReplicas under load and back after it,
+    * a mid-ramp canary revision that 500s every predict is rolled back
+      automatically on the error-rate SLO (annotation + event),
+    * the slice is pinned to ``slice_chips`` with a low-priority
+      4-chip training job occupying it, so the serving burst must
+      preempt training for chips and hand them back on scale-in.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import json as _json
+
+    out: dict = {"serving_scale_max_replicas_config": max_replicas}
+    prev_chips = os.environ.get("KFX_SLICE_CHIPS")
+    os.environ["KFX_SLICE_CHIPS"] = str(slice_chips)
+    home = tempfile.mkdtemp(prefix="kfx-bench-scale-")
+    try:
+        import numpy as np
+        from sklearn.linear_model import LogisticRegression
+
+        from kubeflow_tpu.controlplane import ControlPlane
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.serving.sklearn_server import export_sklearn
+
+        ds = get_dataset("mnist")
+        images, labels = next(ds.batches(256))
+        est = LogisticRegression(max_iter=20)
+        est.fit(images.reshape(len(images), -1), labels)
+        exp = os.path.join(home, "export")
+        export_sklearn(exp, est, input_shape=ds.shape,
+                       num_classes=ds.num_classes)
+        broken = os.path.join(home, "broken_canary.py")
+        with open(broken, "w") as f:
+            f.write(_BROKEN_CANARY)
+        manifest = f"""
+apiVersion: kubeflow.org/v1
+kind: JAXJob
+metadata:
+  name: bg-train
+spec:
+  runPolicy:
+    schedulingPolicy:
+      priority: 0
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 4
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+          - name: sleep
+            command: ["{sys.executable}", "-c", "import time; time.sleep(600)"]
+---
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: ramp
+spec:
+  predictor:
+    minReplicas: 0
+    maxReplicas: {max_replicas}
+    targetConcurrency: 2
+    stableWindowSeconds: 4
+    panicWindowSeconds: 2
+    scaleToZeroIdleSeconds: 6
+    sklearn:
+      storageUri: file://{exp}
+"""
+        payload = _json.dumps({"instances": np.zeros(
+            (1, 28, 28, 1), np.float32).tolist()}).encode()
+        lats: list = []
+        fails = [0]
+        lock = threading.Lock()
+
+        def one(url):
+            t = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                with lock:
+                    lats.append((time.perf_counter() - t) * 1000)
+                return True
+            except Exception:
+                with lock:
+                    fails[0] += 1
+                return False
+
+        with ControlPlane(home=home) as cp:
+            cp.apply_text(manifest)
+            url = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not url:
+                url = cp.store.get("InferenceService",
+                                   "ramp").status.get("url")
+                time.sleep(0.1)
+            if url is None:
+                raise RuntimeError("InferenceService ramp never "
+                                   "published status.url")
+            predict = f"{url}/v1/models/ramp:predict"
+            # Cold start: request until the activator has scaled 0->1.
+            t0 = time.monotonic()
+            deadline = t0 + 90
+            while time.monotonic() < deadline:
+                if one(predict):
+                    break
+                time.sleep(0.2)
+            out["serving_scale_cold_start_ms"] = round(
+                (time.monotonic() - t0) * 1000, 1)
+            # The ramp: rising client counts; replicas sampled over time.
+            replicas_series: list = []
+            max_seen = [0]
+            stop = threading.Event()
+
+            def sampler():
+                while not stop.is_set():
+                    st = cp.store.get("InferenceService", "ramp").status
+                    n = (st.get("replicas") or {}).get("default", 0)
+                    replicas_series.append(n)
+                    max_seen[0] = max(max_seen[0], n)
+                    time.sleep(0.5)
+
+            smp = threading.Thread(target=sampler, daemon=True)
+            smp.start()
+
+            def client(until):
+                while time.monotonic() < until:
+                    one(predict)
+
+            for i, clients in enumerate((2, 6, 12)):
+                until = time.monotonic() + phase_s
+                threads = [threading.Thread(target=client, args=(until,),
+                                            daemon=True)
+                           for _ in range(clients)]
+                for t in threads:
+                    t.start()
+                if i == 1:
+                    # Mid-ramp canary with injected faults + rollout.
+                    # Retry on Conflict: the operator's concurrent
+                    # status/annotation writes bump resourceVersion
+                    # between our get and update.
+                    from kubeflow_tpu.core.store import Conflict
+                    for _ in range(10):
+                        fresh = cp.store.get("InferenceService", "ramp")
+                        fresh.spec["canary"] = {
+                            "minReplicas": 1,
+                            "containers": [{"name": "bad", "command": [
+                                sys.executable, broken]}]}
+                        fresh.spec["rollout"] = {
+                            "stepPercent": 30, "intervalSeconds": 2.0,
+                            "sloErrorRate": 0.2, "minRequests": 8}
+                        try:
+                            cp.store.update(fresh)
+                            break
+                        except Conflict:
+                            time.sleep(0.05)
+                for t in threads:
+                    t.join()
+            # Rollback should have landed during/after the ramp.
+            rolled = False
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not rolled:
+                cur = cp.store.get("InferenceService", "ramp")
+                rolled = "kubeflow.org/rollout-rolled-back" in \
+                    cur.metadata.annotations
+                time.sleep(0.3)
+            out["serving_scale_rolled_back"] = rolled
+            # Preemption evidence: the low-priority gang was suspended
+            # while the burst held chips.
+            job = cp.store.get("JAXJob", "bg-train")
+            preempted = bool(job.metadata.annotations.get(
+                "kubeflow.org/preempted-by")) or \
+                job.has_condition("Suspended")
+            out["serving_scale_preempted_training"] = preempted
+            stop.set()
+            smp.join(timeout=2)
+            # Scale-in: load gone -> replicas drain, chips return, the
+            # training job resumes.
+            deadline = time.monotonic() + 45
+            resumed = drained = False
+            while time.monotonic() < deadline:
+                cur = cp.store.get("InferenceService", "ramp")
+                job = cp.store.get("JAXJob", "bg-train")
+                drained = (cur.status.get("replicas") or {}).get(
+                    "default", 0) <= 1
+                resumed = not job.run_policy().suspend
+                if drained and (resumed or not preempted):
+                    break
+                time.sleep(0.5)
+            out["serving_scale_scaled_in"] = drained
+            out["serving_scale_training_resumed"] = resumed
+        if lats:
+            lats.sort()
+            total = len(lats) + fails[0]
+            out.update({
+                "serving_scale_p50_ms": round(lats[len(lats) // 2], 2),
+                "serving_scale_p99_ms": round(
+                    lats[int(len(lats) * 0.99)], 2),
+                "serving_scale_requests": total,
+                "serving_scale_success_rate": round(len(lats) / total, 4),
+                "serving_scale_max_replicas": max_seen[0],
+                "serving_scale_replicas_over_time": replicas_series[::4],
+            })
+        return out
+    except Exception as e:  # secondary metric must not sink the bench
+        out["serving_scale_error"] = str(e)[:200]
+        return out
+    finally:
+        if prev_chips is None:
+            os.environ.pop("KFX_SLICE_CHIPS", None)
+        else:
+            os.environ["KFX_SLICE_CHIPS"] = prev_chips
+        shutil.rmtree(home, ignore_errors=True)
 
 
 def _bench_serving_p50(n_requests: int = 200, load_clients: int = 32,

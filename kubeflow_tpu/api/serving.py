@@ -104,6 +104,30 @@ class InferenceService(Resource):
                           self.predictor().get("canaryTrafficPercent", 0))
         return int(v)
 
+    def rollout_spec(self) -> Optional[Dict[str, Any]]:
+        """spec.rollout: the automatic canary rollout controller's
+        config — traffic steps up by ``stepPercent`` every
+        ``intervalSeconds`` while the canary's windowed SLO
+        (``sloP99Ms`` / ``sloErrorRate``) holds, and rolls back to the
+        default revision on breach. Requires a canary revision; when
+        present the controller owns the traffic percent and
+        ``canaryTrafficPercent`` is ignored."""
+        return self.spec.get("rollout")
+
+    def scheduling_priority(self) -> int:
+        """Chip-arbitration priority of this service's serving
+        reservation (sched/scheduler.py): ``spec.schedulingPriority``,
+        else the ``kubeflow.org/priority`` annotation, else 5 — above
+        default-priority (0) training, so bursty inference preempts
+        background work but a priority>=5 training job holds its chips."""
+        v = self.spec.get("schedulingPriority")
+        if v is None:
+            v = self.metadata.annotations.get("kubeflow.org/priority")
+        try:
+            return int(v) if v is not None else 5
+        except (TypeError, ValueError):
+            return 5
+
     def validate(self) -> None:
         super().validate()
         if not self.predictor():
@@ -131,6 +155,63 @@ class InferenceService(Resource):
         if self.min_replicas() < 0 or self.max_replicas() < self.min_replicas():
             raise ValidationError("spec.predictor.minReplicas/maxReplicas",
                                   "0 <= min <= max required")
+        for rev in ("predictor", "canary"):
+            rspec = self.spec.get(rev)
+            if rspec is None:
+                continue
+            for field, lo in (("targetConcurrency", 0.0),
+                              ("stableWindowSeconds", 0.0),
+                              ("scaleDownWindowSeconds", 0.0),
+                              ("panicWindowSeconds", 0.0),
+                              ("panicThreshold", 1.0),
+                              ("maxScaleUpRate", 1.0)):
+                v = rspec.get(field)
+                if v is None:
+                    continue
+                try:
+                    ok = float(v) > lo and not isinstance(v, bool)
+                except (TypeError, ValueError):
+                    ok = False
+                if not ok:
+                    raise ValidationError(f"spec.{rev}.{field}",
+                                          f"must be a number > {lo:g}")
+        sp = self.spec.get("schedulingPriority")
+        if sp is not None and (isinstance(sp, bool)
+                               or not isinstance(sp, int)):
+            raise ValidationError("spec.schedulingPriority",
+                                  "must be an integer")
+        ro = self.rollout_spec()
+        if ro is not None:
+            if self.spec.get("canary") is None:
+                raise ValidationError(
+                    "spec.rollout", "requires a spec.canary revision")
+            step = ro.get("stepPercent", 10)
+            maxp = ro.get("maxPercent", 100)
+            if not (isinstance(step, int) and not isinstance(step, bool)
+                    and 0 < step <= 100):
+                raise ValidationError("spec.rollout.stepPercent",
+                                      "must be an integer in [1, 100]")
+            if not (isinstance(maxp, int) and not isinstance(maxp, bool)
+                    and 0 < maxp <= 100):
+                raise ValidationError("spec.rollout.maxPercent",
+                                      "must be an integer in [1, 100]")
+            for field in ("intervalSeconds", "sloP99Ms", "sloErrorRate",
+                          "minRequests"):
+                v = ro.get(field)
+                if v is None:
+                    continue
+                try:
+                    fv = float(v)
+                except (TypeError, ValueError):
+                    raise ValidationError(f"spec.rollout.{field}",
+                                          "must be a number")
+                if fv < 0 or isinstance(v, bool):
+                    raise ValidationError(f"spec.rollout.{field}",
+                                          "must be >= 0")
+            er = ro.get("sloErrorRate")
+            if er is not None and float(er) > 1.0:
+                raise ValidationError("spec.rollout.sloErrorRate",
+                                      "a rate in [0, 1]")
         for rev in ("predictor", "canary"):
             spec = self.spec.get(rev)
             if spec is not None:

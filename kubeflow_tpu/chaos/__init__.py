@@ -21,6 +21,10 @@ tested property: sites across the stack declare *fault points* —
                         checkpoint boundary
     sched.preempt       scheduler preemption fails  (sched/scheduler.py)
                         to land (cycle aborts)
+    autoscale.decide    autoscaler skips/stalls a   (operators/serving.py)
+                        scale decision cycle
+    serving.cold_start  scale-from-zero spawn is    (operators/serving.py)
+                        delayed
 
 — and a *plan* decides, deterministically, which evaluations inject.
 
@@ -85,6 +89,7 @@ KNOWN_POINTS = frozenset({
     "checkpoint.save", "checkpoint.restore",
     "serving.request", "serving.predict", "engine.admit",
     "runner.crash", "sched.preempt",
+    "autoscale.decide", "serving.cold_start",
 })
 
 

@@ -275,11 +275,14 @@ class KfxCLI:
         log with the same stdout-metric contract the HPO collector uses
         (SURVEY.md §5.5) — so `kfx top`, Katib observations and the
         runner all agree on one number. Headed by the gang scheduler's
-        capacity/queue summary."""
+        capacity/queue summary; per-InferenceService replica lines
+        (ready/spawned vs the autoscaler's target) follow the table."""
         running, queued = _slice_state(_store_jobs(self.cp))
-        print(_capacity_summary(self.cp.sched.capacity,
-                                sum(r.chips for r in running),
-                                len(queued)))
+        serving = _serving_slice_rows(
+            self.cp.store.list("InferenceService"))
+        print(_capacity_summary(
+            self.cp.sched.capacity,
+            sum(r.chips for r in running + serving), len(queued)))
         rows = []
         for kind in _training_kinds():
             for job in self.cp.store.list(kind):
@@ -292,19 +295,36 @@ class KfxCLI:
                     text = ""
                 rows.append([job.name, kind, job.namespace,
                              _job_state(job)] + _telemetry_cells(text))
-        return _print_top(rows)
+        rc = _print_top(rows)
+        _print_serving_top(_serving_top_rows(
+            self.cp.store.list("InferenceService")))
+        return rc
 
     def queue(self) -> int:
         """Gang-scheduler view (`kfx queue`): slice capacity, the gangs
-        holding chips, and the priority-ordered wait queue — derived
-        from the store (conditions + annotations the scheduler writes),
-        so it reads identically against a live plane, a passive CLI
-        plane, or a journal-recovered home."""
+        holding chips (incl. elastic serving reservations), and the
+        priority-ordered wait queue — derived from the store
+        (conditions + annotations the scheduler writes), so it reads
+        identically against a live plane, a passive CLI plane, or a
+        journal-recovered home."""
         running, queued = _slice_state(_store_jobs(self.cp))
-        print(_capacity_summary(self.cp.sched.capacity,
-                                sum(r.chips for r in running),
-                                len(queued)))
-        return _print_queue(running, queued)
+        serving = _serving_slice_rows(
+            self.cp.store.list("InferenceService"))
+        print(_capacity_summary(
+            self.cp.sched.capacity,
+            sum(r.chips for r in running + serving), len(queued)))
+        return _print_queue(running + serving, queued)
+
+    def rollout(self, name: Optional[str], namespace: str) -> int:
+        """Canary rollout state (`kfx rollout [name]`): the controller-
+        owned traffic percent, phase, and the last SLO observation per
+        InferenceService — plus the rollback verdict annotation when a
+        canary was auto-rolled-back."""
+        if name:
+            isvcs = [self.cp.store.get("InferenceService", name, namespace)]
+        else:
+            isvcs = self.cp.store.list("InferenceService", namespace)
+        return _print_rollouts(isvcs)
 
     def profile(self, kind: str, name: str, namespace: str, replica: str,
                 duration_ms: int, logdir: str) -> int:
@@ -410,6 +430,91 @@ def _slice_state(jobs) -> "Tuple[List[_SliceRow], List[_SliceRow]]":
     queued.sort(key=lambda r: (-r.priority, used.get(r.namespace, 0),
                                r.created or ""))
     return running, queued
+
+
+def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
+    """Elastic serving reservations as slice rows (`kfx queue` /
+    `kfx top` header): an InferenceService's spawned predictor replicas
+    (default + canary) each hold one chip, like gang members."""
+    rows = []
+    for isvc in isvcs:
+        repl = isvc.status.get("replicas") or {}
+        chips = sum(int(repl.get(r) or 0) for r in ("default", "canary"))
+        if chips <= 0:
+            continue
+        auto = isvc.status.get("autoscaling") or {}
+        wanted = sum(int((auto.get(r) or {}).get("desired") or 0)
+                     for r in ("default", "canary"))
+        rows.append(_SliceRow(
+            name=isvc.name, kind="InferenceService",
+            namespace=isvc.namespace, priority=isvc.scheduling_priority(),
+            chips=chips, state="Serving",
+            detail=(f"elastic; autoscaler wants {wanted}"
+                    if wanted and wanted != chips else "elastic"),
+            created=isvc.metadata.creation_timestamp))
+    return rows
+
+
+def _serving_top_rows(isvcs) -> List[List[str]]:
+    """Per-revision replica lines for `kfx top`: ready/spawned against
+    the autoscaler's desired count and concurrency target, plus the
+    canary traffic split."""
+    rows = []
+    for isvc in isvcs:
+        repl = isvc.status.get("replicas") or {}
+        ready = isvc.status.get("readyReplicas") or {}
+        auto = isvc.status.get("autoscaling") or {}
+        pct = (isvc.status.get("rollout") or {}).get(
+            "percent", isvc.canary_traffic_percent_split())
+        for rev in ("default", "canary"):
+            if rev not in repl and rev not in auto:
+                continue
+            a = auto.get(rev) or {}
+            panic = " (panic)" if a.get("panic") else ""
+            rows.append([
+                isvc.name, isvc.namespace, rev,
+                f"{int(ready.get(rev) or 0)}/{int(repl.get(rev) or 0)}",
+                f"{a.get('desired', '-')}{panic}",
+                str(a.get("target", "-")),
+                f"{pct}%" if rev == "canary" else "-"])
+    return rows
+
+
+def _print_serving_top(rows: List[List[str]]) -> None:
+    if not rows:
+        return
+    print()
+    _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
+                        "DESIRED", "TARGET", "CANARY%"])
+
+
+def _print_rollouts(isvcs) -> int:
+    from .serving.autoscaler import ROLLBACK_ANNOTATION
+
+    rows, notes = [], []
+    for isvc in isvcs:
+        ro = isvc.status.get("rollout")
+        if ro is None:
+            continue
+        p99 = ro.get("p99Ms")
+        err = ro.get("errorRate")
+        rows.append([
+            isvc.name, isvc.namespace, f"{ro.get('percent', 0)}%",
+            str(ro.get("phase", "")),
+            f"{p99:.1f}" if isinstance(p99, (int, float)) else "-",
+            f"{err:.2%}" if isinstance(err, (int, float)) else "-",
+            str(ro.get("observed", "-"))])
+        verdict = isvc.metadata.annotations.get(ROLLBACK_ANNOTATION)
+        if verdict:
+            notes.append(f"{isvc.name}: rolled back — {verdict}")
+    if not rows:
+        print("no InferenceService with an active rollout")
+        return 0
+    _print_table(rows, ["NAME", "NAMESPACE", "CANARY%", "PHASE",
+                        "P99_MS", "ERR_RATE", "OBSERVED"])
+    for note in notes:
+        print(note)
+    return 0
 
 
 def _print_queue(running, queued) -> int:
@@ -544,8 +649,14 @@ def build_parser() -> argparse.ArgumentParser:
                                "loss/throughput per job)")
 
     sub.add_parser("queue", help="gang-scheduler state: slice capacity, "
-                                 "running gangs, and the priority-"
+                                 "running gangs (incl. serving "
+                                 "reservations), and the priority-"
                                  "ordered wait queue")
+
+    sp = sub.add_parser(
+        "rollout", help="canary rollout state per InferenceService "
+                        "(traffic percent, phase, last SLO observation)")
+    sp.add_argument("name", nargs="?")
 
     sp = sub.add_parser("kill-replica", help="fault injection: kill a replica")
     sp.add_argument("kind")
@@ -625,7 +736,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
             print(p)
         return 0
     _REMOTE_VERBS = ("apply", "run", "get", "describe", "delete", "logs",
-                     "events", "top", "queue")
+                     "events", "top", "queue", "rollout")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
     if os.environ.get("KFX_SERVER") and args.cmd == "trace":
@@ -677,7 +788,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
                            "delete", "kill-replica", "top", "trace",
-                           "queue")
+                           "queue", "rollout")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -738,6 +849,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return cli.top()
         if args.cmd == "queue":
             return cli.queue()
+        if args.cmd == "rollout":
+            return cli.rollout(args.name, args.namespace)
         if args.cmd == "kill-replica":
             return cli.kill_replica(args.kind, args.name, args.namespace,
                                     args.replica)
@@ -961,11 +1074,23 @@ def _remote_dispatch(client, args) -> int:
                     text = ""
                 rows.append([name, kind, ns, _dict_state(o)]
                             + _telemetry_cells(text))
-        return _print_top(rows)
+        rc = _print_top(rows)
+        _print_serving_top(_serving_top_rows(_remote_isvcs(client)))
+        return rc
     if args.cmd == "queue":
         print(_remote_capacity_summary(client))
         running, queued = _slice_state(_remote_jobs(client))
-        return _print_queue(running, queued)
+        return _print_queue(
+            running + _serving_slice_rows(_remote_isvcs(client)), queued)
+    if args.cmd == "rollout":
+        if args.name:
+            isvcs = [client.get("InferenceService", args.namespace,
+                                args.name)]
+        else:
+            isvcs = client.list("InferenceService", args.namespace)
+        from .api.base import from_manifest
+
+        return _print_rollouts([from_manifest(o) for o in isvcs])
     raise AssertionError(f"unhandled remote cmd {args.cmd}")
 
 
@@ -980,6 +1105,23 @@ def _remote_jobs(client):
                 yield kind, from_manifest(o)
             except Exception:
                 continue
+
+
+def _remote_isvcs(client):
+    """InferenceService resources rebuilt from the server's dicts (the
+    remote serving rows share the local derivation)."""
+    from .api.base import from_manifest
+
+    out = []
+    try:
+        for o in client.list("InferenceService"):
+            try:
+                out.append(from_manifest(o))
+            except Exception:
+                continue
+    except Exception:
+        pass
+    return out
 
 
 def _remote_capacity_summary(client) -> str:

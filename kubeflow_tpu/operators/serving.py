@@ -18,7 +18,6 @@ splitting default/canary traffic, KPA scaling on concurrency. Here:
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import json
 import os
@@ -28,8 +27,9 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+from .. import chaos
 from ..api.serving import (
     ISVC_EXPLAINER_READY,
     ISVC_PREDICTOR_READY,
@@ -39,6 +39,22 @@ from ..api.serving import (
 )
 from ..core.controller import Controller, Result
 from ..core.store import Conflict, NotFound, ResourceStore
+from ..obs import trace as obs_trace
+from ..obs.metrics import default_registry
+from ..serving.autoscaler import (
+    COLD_START_CHAOS_POINT,
+    PROGRESSING,
+    ROLLBACK_ANNOTATION,
+    ROLLED_BACK,
+    ConcurrencyAutoscaler,
+    Decision,
+    RolloutPlan,
+    SLOWindow,
+    autoscaler_config_from_spec,
+    chaos_skip_decision,
+    revision_slo_state,
+    rollout_spec_from_dict,
+)
 from ..serving.router import Router
 from ..utils.net import free_port
 from ..utils.proc import inject_pythonpath
@@ -76,8 +92,10 @@ class _Revision:
         self.replicas: List[_Replica] = []
         self.restarts = 0
         self.spawn_error = ""  # last custom-container launch failure
-        # (timestamp, desired) samples for the autoscaler's damping window.
-        self.scale_window: "collections.deque" = collections.deque()
+        # Decode-engine queue sampling state (autoscaler load signal).
+        self.engine_queue = 0.0
+        self.engine_sampled = float("-inf")
+        self.engine_absent = False
 
     def spawn(self) -> None:
         port = free_port()
@@ -210,6 +228,19 @@ class _Revision:
         self.replicas.clear()
 
 
+class _RolloutRuntime:
+    """In-memory half of one InferenceService's canary rollout: the
+    traffic plan plus the SLO delta window over the canary's router
+    metrics. Durable state (percent/phase/rolled-back annotation) lives
+    on the resource so a plane restart resumes, not restarts."""
+
+    def __init__(self, spec_dict: dict, plan: RolloutPlan):
+        self.spec_dict = spec_dict
+        self.plan = plan
+        self.window = SLOWindow()
+        self.last_obs: Dict[str, object] = {}
+
+
 class _IsvcRuntime:
     def __init__(self):
         self.router: Optional[Router] = None
@@ -220,17 +251,40 @@ class _IsvcRuntime:
         self.cold_hit: Dict[str, bool] = {}
         # Last spawn failure surfaced per revision (event dedup).
         self.reported_spawn_error: Dict[str, str] = {}
+        # KPA loop per predictor revision (serving/autoscaler.py).
+        self.autoscalers: Dict[str, ConcurrencyAutoscaler] = {}
+        self.autoscaling_status: Dict[str, Dict] = {}
+        # wall-clock start of an in-flight scale-from-zero, per revision
+        # (closed into an autoscale.cold_start span at first readiness).
+        self.cold_started: Dict[str, float] = {}
+        self.rollout: Optional[_RolloutRuntime] = None
+        self.rollout_status: Optional[Dict] = None
+        # Scheduler-arbitration event dedup.
+        self.reported_scale_block = ""
 
 
 class InferenceServiceController(Controller):
     KIND = "InferenceService"
     RESYNC_PERIOD = 1.0
 
+    # How often (at most) a revision's replicas are polled for decode-
+    # engine queue depth — the LM load signal beyond router concurrency.
+    ENGINE_SAMPLE_PERIOD_S = 1.0
+
     def __init__(self, store: ResourceStore, home: str):
         super().__init__(store)
         self.home = home
         self._lock = threading.Lock()
         self._runtimes: Dict[str, _IsvcRuntime] = {}
+        # Set by the control plane: the cluster gang scheduler. Serving
+        # replica deltas are admitted through it as elastic serving
+        # reservations (one replica == one chip), so bursty inference
+        # preempts low-priority training and returns chips on scale-in.
+        self.scheduler = None
+
+    def _reg(self):
+        return self.metrics if self.metrics is not None \
+            else default_registry()
 
     # -- lifecycle ----------------------------------------------------------
     def on_delete(self, obj) -> None:
@@ -239,6 +293,9 @@ class InferenceServiceController(Controller):
     def _teardown(self, key: str) -> None:
         with self._lock:
             rt = self._runtimes.pop(key, None)
+        if self.scheduler is not None:
+            ns, _, name = key.partition("/")
+            self.scheduler.resize_serving(name, ns, 0)
         if rt is None:
             return
         for rev in rt.revisions.values():
@@ -267,7 +324,8 @@ class InferenceServiceController(Controller):
                 self._runtimes[key] = rt
 
         if rt.router is None:
-            rt.router = Router().start()
+            rt.router = Router(metrics=self._reg(), name=isvc.name,
+                               namespace=isvc.namespace).start()
             ctrl, k = self, key
 
             def cold():
@@ -293,10 +351,31 @@ class InferenceServiceController(Controller):
                     # scale-down window before the first request lands.
                     getattr(rt.router, rev_name).last_request_time = \
                         time.monotonic()
+                    # Cold-start clock: closed into an
+                    # autoscale.cold_start span (+ histogram) when the
+                    # spawned replica first probes ready. A request that
+                    # 503'd just before the replica turned ready is not
+                    # a cold start — re-arming here would emit a bogus
+                    # 0s span on the very next probe.
+                    rev = rt.revisions.get(rev_name)
+                    if rev is None or not any(r.ready for r in rev.replicas):
+                        rt.cold_started.setdefault(rev_name, time.time())
+                    # Chaos: delay the scale-from-zero spawn — the
+                    # activator lagging its cold request.
+                    chaos.maybe_delay(COLD_START_CHAOS_POINT, default_s=0.5,
+                                      target=f"{key}/{rev_name}")
                     break
             rt.cold_pending = False
 
         all_ready = True
+        reg = self._reg()
+        now_mono = time.monotonic()
+        # PASS 1 — plan: ensure each predictor revision exists and
+        # compute its desired replica count (activator floor + the KPA
+        # loop in serving/autoscaler.py). Nothing spawns yet: the chip
+        # delta across BOTH revisions is admitted through the scheduler
+        # as one elastic serving reservation first.
+        plans: Dict[str, Tuple[int, int]] = {}  # rev -> (floor, desired)
         for rev_name in ("default", "canary"):
             spec = isvc.revision_spec(rev_name)
             rev = rt.revisions.get(rev_name)
@@ -304,6 +383,8 @@ class InferenceServiceController(Controller):
                 if rev is not None:
                     rev.teardown()
                     del rt.revisions[rev_name]
+                rt.autoscalers.pop(rev_name, None)
+                rt.autoscaling_status.pop(rev_name, None)
                 continue
             container = (spec.get("containers") or [None])[0]
             if container is not None:
@@ -350,6 +431,7 @@ class InferenceServiceController(Controller):
                 has_ready = any(r.ready for r in rev.replicas)
                 if idle_s > 0 and has_ready and idle >= idle_s:
                     rt.cold_hit[rev_name] = False
+                    rt.cold_started.pop(rev_name, None)
                     # Remove the revision from the router BEFORE killing
                     # its replicas: a request racing the scale-down must
                     # take the cold 503+activator path, not hit a dead
@@ -361,38 +443,65 @@ class InferenceServiceController(Controller):
             # for a traffic-woken zero-scale revision): readiness is
             # judged against this, never against autoscaler targets.
             base_want = want
-            # Concurrency autoscaler (Knative KPA analogue, SURVEY.md §3
-            # CS3 step 4): with maxReplicas above the floor, desired
-            # replicas = ceil(peak in-flight / targetConcurrency),
-            # clamped to [floor, max]. Scale-down is damped by taking the
-            # max desired over a sliding window so a burst's replicas
-            # aren't torn down between its waves.
-            backend_set = getattr(rt.router, rev_name)
-            max_repl = int(spec.get("maxReplicas", max(want, 1)))
-            if max_repl > max(base_want, 1):
-                import math
+            plans[rev_name] = (base_want,
+                               self._autoscale(key, isvc, rt, rev_name,
+                                               rev, spec, base_want,
+                                               now_mono, reg))
 
-                target = max(float(spec.get("targetConcurrency", 4.0)),
-                             1e-9)
-                window_s = float(spec.get("scaleDownWindowSeconds", 30.0))
-                peak = backend_set.take_peak_concurrency()
-                desired = math.ceil(peak / target)
-                now = time.monotonic()
-                hist = rev.scale_window
-                hist.append((now, desired))
-                while hist and hist[0][0] < now - window_s:
-                    hist.popleft()
-                damped = max((d for _, d in hist), default=0)
-                if damped > want:
-                    want = min(damped, max_repl)
+        # Chip arbitration (sched/scheduler.py): one elastic serving
+        # reservation covers the sum of both revisions' targets. Growth
+        # takes free capacity, then preempts strictly-lower-priority
+        # training; shrink returns chips to the queue. Without a wired
+        # scheduler (standalone controllers) every plan is granted.
+        total_want = sum(d for _, d in plans.values())
+        granted_total = total_want
+        if self.scheduler is not None:
+            granted_total = self.scheduler.resize_serving(
+                isvc.name, isvc.namespace, total_want,
+                priority=isvc.scheduling_priority())
+            if granted_total < total_want:
+                msg = (f"granted {granted_total}/{total_want} chip(s); "
+                       f"waiting for capacity")
+                if rt.reported_scale_block != msg:
+                    rt.reported_scale_block = msg
+                    self.record_event(isvc, "Warning", "ScaleBlocked", msg)
+            elif rt.reported_scale_block:
+                rt.reported_scale_block = ""
+                self.record_event(
+                    isvc, "Normal", "ScaleGranted",
+                    f"serving reservation of {total_want} chip(s) granted")
+        # Allocate granted chips: default first (it guarantees the
+        # spec's floor traffic), the canary takes the remainder.
+        remaining = granted_total
+        grants: Dict[str, int] = {}
+        for rev_name in ("default", "canary"):
+            if rev_name not in plans:
+                continue
+            grants[rev_name] = min(plans[rev_name][1], remaining)
+            remaining -= grants[rev_name]
+
+        # PASS 2 — actuate: spawn/reap to the granted counts, probe
+        # readiness, close cold-start spans.
+        for rev_name, rev in list(rt.revisions.items()):
+            if rev_name not in plans:
+                continue
+            base_want, desired = plans[rev_name]
+            want = grants[rev_name]
+            backend_set = getattr(rt.router, rev_name)
             if want < len(rev.replicas):
-                # Scale-down ordering (same rule as scale-to-zero below):
+                # Scale-down ordering (same rule as scale-to-zero above):
                 # drop the doomed replicas from the router BEFORE killing
                 # them, or a racing request 502s against a dead port.
                 backend_set.set_endpoints(
                     [f"127.0.0.1:{r.port}"
                      for r in rev.replicas[:want] if r.ready])
             rev.reap_and_respawn(want)
+            reg.gauge(
+                "kfx_autoscaler_replicas",
+                "Replica processes running per revision (spawned, "
+                "including those still loading).",
+            ).set(len(rev.replicas), namespace=isvc.namespace, isvc=isvc.name,
+                  revision=rev_name)
             if rev.spawn_error:
                 # Launch failure (e.g. typo'd custom command): surface
                 # once per distinct error; the respawn loop keeps
@@ -403,6 +512,8 @@ class InferenceServiceController(Controller):
                     self.record_event(isvc, "Warning", "SpawnFailed",
                                       f"{rev_name}: {rev.spawn_error}")
             ready = rev.probe()
+            if ready > 0 and rev_name in rt.cold_started:
+                self._finish_cold_start(isvc, rt, rev_name, reg)
             # Readiness is judged against the spec's guarantee (base
             # replicas), not the autoscaler's transient target — a burst
             # must not flip a healthy, serving ISVC to NotReady while
@@ -464,20 +575,241 @@ class InferenceServiceController(Controller):
             if ready < want:
                 all_ready = False
 
-        # Router wiring + traffic split.
+        # Router wiring + traffic split. With a spec.rollout the canary
+        # percent is CONTROLLER-OWNED: it steps up while the canary's
+        # SLO holds and snaps to 0 on breach (_reconcile_rollout);
+        # otherwise the static spec split applies.
         default_rev = rt.revisions.get("default")
         canary_rev = rt.revisions.get("canary")
         if default_rev is not None:
             rt.router.default.set_endpoints(default_rev.endpoints())
         if canary_rev is not None:
             rt.router.canary.set_endpoints(canary_rev.endpoints())
-            rt.router.canary_percent = isvc.canary_traffic_percent_split()
+            rt.router.canary_percent = self._reconcile_rollout(isvc, rt, reg)
         else:
             rt.router.canary_percent = 0
+            rt.rollout = None
+            rt.rollout_status = None
 
         self._sync_status(isvc, rt, all_ready, graph_ready)
         return Result(requeue=True, requeue_after=0.25) if not all_ready \
             else None
+
+    # -- autoscaling ---------------------------------------------------------
+    def _autoscale(self, key: str, isvc: InferenceService,
+                   rt: _IsvcRuntime, rev_name: str, rev: _Revision,
+                   spec: dict, base_want: int, now_mono: float,
+                   reg) -> int:
+        """One revision's KPA cycle: sample the router's peak in-flight
+        concurrency (+ decode-engine queue depth), feed the autoscaler,
+        and return the desired replica count in [floor, maxReplicas].
+        The ``autoscale.decide`` chaos point skips (or stalls) the
+        decision, holding the current replica count for a cycle."""
+        backend_set = getattr(rt.router, rev_name)
+        cfg = autoscaler_config_from_spec(spec, base_want)
+        asc = rt.autoscalers.get(rev_name)
+        if asc is None:
+            asc = rt.autoscalers[rev_name] = ConcurrencyAutoscaler(cfg)
+        else:
+            asc.reconfigure(cfg)
+        if base_want == 0:
+            # The activator owns the zero state: either this revision
+            # was never traffic-woken, or its idle window just expired
+            # (cold_hit cleared above). Stale samples from the drained
+            # burst must not resurrect it — the next cold request
+            # restarts the loop from scratch.
+            asc.reset()
+            rt.autoscaling_status[rev_name] = {
+                "desired": 0, "target": cfg.target_concurrency,
+                "panic": False, "reason": "scale-to-zero"}
+            reg.gauge(
+                "kfx_autoscaler_desired_replicas",
+                "Autoscaler target replicas per revision.",
+            ).set(0, namespace=isvc.namespace, isvc=isvc.name,
+                  revision=rev_name)
+            return 0
+        peak = backend_set.take_peak_concurrency()
+        queue_depth = self._engine_queue_depth(rev)
+        asc.observe(now_mono, peak, queue_depth)
+        reg.gauge(
+            "kfx_router_peak_concurrency",
+            "Peak in-flight concurrency per revision since the last "
+            "autoscaler sample (the KPA load signal).",
+        ).set(peak, namespace=isvc.namespace, isvc=isvc.name,
+              revision=rev_name)
+        current = len(rev.replicas)
+        if cfg.max_replicas <= max(base_want, 1) and base_want >= 1:
+            # Autoscaling disabled: the floor IS the target.
+            decision = Decision(desired=base_want, panic=False, load=peak,
+                                reason="static")
+        elif chaos_skip_decision(f"{key}/{rev_name}"):
+            # A skipped cycle freezes the AUTOSCALER, not the spec: the
+            # floor still applies, or an injected cycle could hold a
+            # revision below minReplicas (e.g. never replace a crashed
+            # replica, or never answer a cold request).
+            decision = Decision(desired=max(current, base_want),
+                                panic=False, load=peak,
+                                reason="chaos-skipped")
+        else:
+            decision = asc.desired(now_mono, current, base_want)
+        reg.gauge(
+            "kfx_autoscaler_desired_replicas",
+            "Autoscaler target replicas per revision.",
+        ).set(decision.desired, namespace=isvc.namespace,
+              isvc=isvc.name, revision=rev_name)
+        reg.gauge(
+            "kfx_autoscaler_panic",
+            "1 while the revision's autoscaler is in panic (burst) mode.",
+        ).set(1 if decision.panic else 0, namespace=isvc.namespace,
+              isvc=isvc.name, revision=rev_name)
+        rt.autoscaling_status[rev_name] = {
+            "desired": decision.desired,
+            "target": cfg.target_concurrency,
+            "panic": decision.panic,
+            "reason": decision.reason,
+        }
+        return decision.desired
+
+    def _engine_queue_depth(self, rev: _Revision) -> float:
+        """Best-effort decode-engine queue depth across the revision's
+        ready replicas (the model server's /metrics?format=json engine
+        block) — queued LM requests are unmet concurrency the router's
+        in-flight count can't see. Rate-limited; a non-LM revision is
+        detected once and never polled again."""
+        if rev.engine_absent:
+            return 0.0
+        now = time.monotonic()
+        if now - rev.engine_sampled < self.ENGINE_SAMPLE_PERIOD_S:
+            return rev.engine_queue
+        rev.engine_sampled = now
+        total, answered, saw_engine = 0.0, False, False
+        for r in rev.replicas:
+            if not r.ready:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{r.port}/metrics?format=json",
+                        timeout=0.5) as resp:
+                    engine = json.load(resp).get("engine") or {}
+                answered = True
+            except (OSError, ValueError):
+                continue
+            for row in engine.values():
+                saw_engine = True
+                total += float(row.get("queue_depth", 0.0))
+        if answered and not saw_engine:
+            rev.engine_absent = True  # classifier server: stop polling
+        rev.engine_queue = total
+        return total
+
+    def _finish_cold_start(self, isvc: InferenceService, rt: _IsvcRuntime,
+                           rev_name: str, reg) -> None:
+        """Close a scale-from-zero window: the cold request arrived at
+        ``cold_started[rev]`` and the revision just probed ready. The
+        interval lands on the `kfx trace` waterfall as an
+        ``autoscale.cold_start`` span under the service's admission
+        span, and in the cold-start histogram."""
+        started = rt.cold_started.pop(rev_name)
+        duration = max(time.time() - started, 0.0)
+        obs_trace.record_span(
+            "autoscale.cold_start", ts=started, duration=duration,
+            trace_id=obs_trace.trace_of(isvc),
+            parent_id=obs_trace.span_of(isvc),
+            namespace=isvc.namespace, isvc=isvc.name,
+            revision=rev_name)
+        reg.histogram(
+            "kfx_autoscaler_cold_start_seconds",
+            "Scale-from-zero latency: cold request to first ready "
+            "replica.",
+        ).observe(duration, namespace=isvc.namespace,
+                  isvc=isvc.name, revision=rev_name)
+        self.record_event(isvc, "Normal", "ColdStart",
+                          f"{rev_name} scaled from zero in {duration:.2f}s")
+
+    # -- canary rollout ------------------------------------------------------
+    def _reconcile_rollout(self, isvc: InferenceService,
+                           rt: _IsvcRuntime, reg) -> int:
+        """The rollout state machine's impure shell: (re)build the plan
+        from spec + durable status, advance it on its interval with the
+        canary's windowed SLO numbers, persist phase/percent to status,
+        and annotate + event a rollback. Returns the percent the router
+        must apply."""
+        spec_dict = isvc.rollout_spec()
+        if not spec_dict:
+            rt.rollout = None
+            rt.rollout_status = None
+            return isvc.canary_traffic_percent_split()
+        now = time.monotonic()
+        ro = rt.rollout
+        if ro is None or ro.spec_dict != spec_dict:
+            st = isvc.status.get("rollout") or {}
+            percent, phase = 0, PROGRESSING
+            if st.get("spec") == spec_dict:
+                # Same rollout config as the durable status: resume it
+                # (a plane restart must not re-traffic a rolled-back
+                # canary).
+                percent = int(st.get("percent", 0))
+                phase = str(st.get("phase", PROGRESSING))
+            elif ROLLBACK_ANNOTATION in isvc.metadata.annotations:
+                # Spec changed: a NEW rollout attempt — clear the old
+                # verdict so `kfx get` doesn't show a stale rollback.
+                self._update_annotation(isvc, ROLLBACK_ANNOTATION, None)
+            ro = rt.rollout = _RolloutRuntime(
+                spec_dict,
+                RolloutPlan(rollout_spec_from_dict(spec_dict), now,
+                            percent=percent, phase=phase))
+            # Re-base the SLO window at activation so pre-rollout
+            # traffic never pollutes the first interval's delta.
+            ro.window.advance(*revision_slo_state(
+                reg, isvc.namespace, isvc.name, "canary"))
+        plan = ro.plan
+        if plan.due(now):
+            p99, err_rate, n = ro.window.advance(
+                *revision_slo_state(
+                    reg, isvc.namespace, isvc.name, "canary"))
+            tick = plan.tick(now, p99, err_rate, n)
+            ro.last_obs = {
+                "p99Ms": round(p99 * 1000.0, 1) if p99 is not None else None,
+                "errorRate": round(err_rate, 4),
+                "observed": n,
+            }
+            if tick.event is not None:
+                etype, reason, message = tick.event
+                self.record_event(isvc, etype, reason, message)
+                if reason == "RolloutRolledBack":
+                    ro.last_obs["reason"] = message
+                    reg.counter(
+                        "kfx_rollout_rollbacks_total",
+                        "Automatic canary rollbacks on SLO breach.",
+                    ).inc(1, namespace=isvc.namespace, isvc=isvc.name)
+        if plan.phase == ROLLED_BACK and \
+                ROLLBACK_ANNOTATION not in isvc.metadata.annotations:
+            # Durable verdict; retried next reconcile on write conflict.
+            self._update_annotation(
+                isvc, ROLLBACK_ANNOTATION,
+                (ro.last_obs or {}).get("reason") or "SLO breach")
+        reg.gauge(
+            "kfx_rollout_canary_percent",
+            "Canary traffic percent the rollout controller applies.",
+        ).set(plan.percent, namespace=isvc.namespace, isvc=isvc.name)
+        rt.rollout_status = {"percent": plan.percent, "phase": plan.phase,
+                             "spec": spec_dict, **ro.last_obs}
+        return plan.percent
+
+    def _update_annotation(self, isvc: InferenceService, key: str,
+                           value: Optional[str]) -> None:
+        fresh = self.get_resource(isvc.key)
+        if fresh is None:
+            return
+        if value is None:
+            fresh.metadata.annotations.pop(key, None)
+        else:
+            fresh.metadata.annotations[key] = value
+        try:
+            self.store.update(fresh)
+            isvc.metadata.annotations = fresh.metadata.annotations
+        except (Conflict, NotFound):
+            self.queue.add(isvc.key)
 
     def _sync_status(self, isvc: InferenceService, rt: _IsvcRuntime,
                      all_ready: bool,
@@ -504,6 +836,20 @@ class InferenceServiceController(Controller):
             changed = True
         if isvc.status.get("replicas") != replica_counts:
             isvc.status["replicas"] = replica_counts
+            changed = True
+        # Autoscaler + rollout projections: what `kfx top` / `kfx
+        # rollout` render, and the durable state a restarted plane
+        # resumes the rollout from.
+        autoscaling = dict(rt.autoscaling_status)
+        if autoscaling and isvc.status.get("autoscaling") != autoscaling:
+            isvc.status["autoscaling"] = autoscaling
+            changed = True
+        if rt.rollout_status is None:
+            if "rollout" in isvc.status:
+                del isvc.status["rollout"]
+                changed = True
+        elif isvc.status.get("rollout") != rt.rollout_status:
+            isvc.status["rollout"] = dict(rt.rollout_status)
             changed = True
         status = "True" if all_ready else "False"
         for ctype in (ISVC_PREDICTOR_READY, ISVC_READY):

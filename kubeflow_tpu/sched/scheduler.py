@@ -125,6 +125,13 @@ class _Entry:
     passed_over: int = 0       # backfill jumps over this head so far
     reason: str = REASON_CAPACITY
     message: str = ""
+    # Serving reservations (InferenceService replica sets) are ELASTIC:
+    # always _ADMITTED, holding `chips` granted chips while `wanted`
+    # records the autoscaler's target — the schedule pass grows chips
+    # toward wanted as capacity frees. They are never preemption
+    # victims (a serving replica has no checkpoint to resume from).
+    serving: bool = False
+    wanted: int = 0
 
 
 class Scheduler:
@@ -208,6 +215,84 @@ class Scheduler:
                 return
             self._schedule_locked()
 
+    # -- serving reservations (elastic, autoscaler-driven) --------------------
+    SERVING_KIND = "InferenceService"
+
+    def resize_serving(self, name: str, namespace: str, wanted: int,
+                       priority: int = 5) -> int:
+        """Elastic chip reservation for one InferenceService's replica
+        set (one replica process == one chip, like gang members).
+        Returns the chips *granted* now — shrink is immediate (freed
+        chips wake queued training), growth takes free capacity first
+        and then preempts strictly-lower-priority training for the
+        shortfall (bounded by the preemption storm guard; remaining
+        shortfall is granted as victims drain, waking the serving
+        controller). ``wanted <= 0`` drops the reservation."""
+        ukey = self._ukey(self.SERVING_KIND, name, namespace)
+        with self._lock:
+            e = self._entries.get(ukey)
+            if wanted <= 0:
+                if e is not None:
+                    self._entries.pop(ukey, None)
+                    self._schedule_locked()
+                return 0
+            wanted = min(wanted, self.capacity)
+            if e is None:
+                e = _Entry(ukey=ukey, kind=self.SERVING_KIND, name=name,
+                           namespace=namespace, chips=0, priority=priority,
+                           seq=self._seq, enqueued_at=time.time(),
+                           state=_ADMITTED, serving=True, reason="")
+                self._seq += 1
+                self._entries[ukey] = e
+            e.priority = priority
+            e.wanted = wanted
+            if wanted < e.chips:
+                e.chips = wanted
+                self._schedule_locked()  # returned chips wake the queue
+            else:
+                self._grow_serving_locked(wake=False)
+                if e.chips < e.wanted:
+                    self._preempt_for_serving_locked(e)
+            return e.chips
+
+    def serving_granted(self, name: str, namespace: str) -> int:
+        with self._lock:
+            e = self._entries.get(
+                self._ukey(self.SERVING_KIND, name, namespace))
+            return e.chips if e is not None else 0
+
+    def _grow_serving_locked(self, wake: bool = True) -> None:
+        """Hand free chips to under-granted serving reservations,
+        highest priority first. Runs at the top of every schedule pass:
+        latency-critical serving growth takes freed capacity before
+        queued training backfills it (the arbitration policy —
+        docs/scheduling.md)."""
+        pending = sorted((e for e in self._entries.values()
+                          if e.serving and e.state == _ADMITTED
+                          and e.wanted > e.chips),
+                         key=lambda e: (-e.priority, e.seq))
+        for e in pending:
+            free = self.capacity - self._reserved_locked()
+            if free <= 0:
+                return
+            grant = min(e.wanted - e.chips, free)
+            if grant > 0:
+                e.chips += grant
+                if wake:
+                    self._wake(e)
+
+    def _preempt_for_serving_locked(self, e: _Entry) -> None:
+        """Preempt lower-priority training for a serving shortfall.
+        Unlike a gang head, a serving reservation is elastic — every
+        chip freed is a replica that can serve — so partial relief is
+        taken even when the full shortfall cannot be met."""
+        head = _Entry(ukey=e.ukey, kind=e.kind, name=e.name,
+                      namespace=e.namespace, chips=e.wanted - e.chips,
+                      priority=e.priority, seq=e.seq,
+                      enqueued_at=e.enqueued_at)
+        self._maybe_preempt_locked(
+            head, self.capacity - self._reserved_locked(), partial=True)
+
     def on_suspended(self, job) -> bool:
         """The training operator tore the gang down on
         ``runPolicy.suspend``. A scheduler-preempted job goes back to
@@ -285,7 +370,10 @@ class Scheduler:
 
     def _schedule_locked(self) -> None:
         """Admit queued entries until nothing more fits: head first, then
-        backfill in order; preempt for a blocked high-priority head."""
+        backfill in order; preempt for a blocked high-priority head.
+        Under-granted serving reservations drink first (elastic growth
+        beats queued batch work for freed capacity)."""
+        self._grow_serving_locked()
         skip: set = set()  # failed a resume write this pass; retry later
         while True:
             queued = [e for e in self._entries.values()
@@ -381,16 +469,20 @@ class Scheduler:
         e.preempted = False
         return True
 
-    def _maybe_preempt_locked(self, head: _Entry, free: int) -> None:
+    def _maybe_preempt_locked(self, head: _Entry, free: int,
+                              partial: bool = False) -> None:
         """Suspend the lowest-priority victims so ``head`` can fit —
         bounded by the cooldown and the per-cycle victim cap (the
-        preemption-storm guard)."""
+        preemption-storm guard). ``partial`` (serving growth) takes
+        victims even when the full need cannot be met: each freed chip
+        is one more serving replica, unlike a gang that is all-or-
+        nothing. Serving reservations are never victims."""
         now = time.monotonic()
         if now - self._last_preempt < self.PREEMPTION_COOLDOWN_S:
             return
         pool = sorted(
             (e for e in self._entries.values()
-             if e.state == _ADMITTED and not e.preempted
+             if e.state == _ADMITTED and not e.preempted and not e.serving
              and e.priority < head.priority),
             key=lambda e: (e.priority, -e.seq))  # lowest prio, youngest 1st
         # Chips already being freed by in-flight preemptions (victims
@@ -408,7 +500,7 @@ class Scheduler:
             need -= v.chips
         if not take:
             return
-        if need > 0 and len(take) == len(pool):
+        if not partial and need > 0 and len(take) == len(pool):
             return  # even preempting everything eligible cannot fit head
         self._last_preempt = now
         suspended = 0
@@ -463,6 +555,10 @@ class Scheduler:
         queue depth (the counters/histogram are recorded live)."""
         with self._lock:
             reserved = self._reserved_locked()
+            serving = sum(e.chips for e in self._entries.values()
+                          if e.serving and e.state == _ADMITTED)
+            serving_wanted = sum(e.wanted for e in self._entries.values()
+                                 if e.serving and e.state == _ADMITTED)
             depth: Dict[str, int] = {}
             for e in self._entries.values():
                 if e.state == _QUEUED:
@@ -472,6 +568,13 @@ class Scheduler:
                   ).set(self.capacity)
         reg.gauge("kfx_sched_reserved_chips",
                   "Chips reserved by admitted gangs.").set(reserved)
+        reg.gauge("kfx_sched_serving_chips",
+                  "Chips granted to elastic serving reservations "
+                  "(subset of reserved).").set(serving)
+        reg.gauge("kfx_sched_serving_wanted_chips",
+                  "Chips serving reservations are asking for "
+                  "(>= granted while a scale-up waits on capacity)."
+                  ).set(serving_wanted)
         g = reg.gauge("kfx_sched_queue_depth",
                       "Jobs waiting in the scheduler queue by namespace.")
         g.clear()
@@ -505,6 +608,9 @@ class Scheduler:
             "waitedSeconds": round(max(time.time() - e.enqueued_at, 0.0), 3),
             "reason": e.reason, "message": e.message,
         }
+        if e.serving:
+            row["serving"] = True
+            row["wanted"] = e.wanted
         if position is not None:
             row["position"] = position
         return row

@@ -1,0 +1,334 @@
+"""Serving autoscaler: KPA-lite concurrency scaling, SLO-watched canary
+rollout, and the pure decision machinery behind both.
+
+The reference's KFServing layer is Knative-shaped (SURVEY.md §2.1/§3
+CS3): the KPA scales each revision's pod count toward a per-pod
+concurrency target with a short *panic* window for bursts and a longer
+*stable* window damping scale-down, and canary rollouts step traffic up
+revision by revision while SLOs hold. This module is that control
+theory with the Kubernetes removed — **pure state machines**, no
+processes, no clocks of their own (callers pass ``now``), so the whole
+decision surface unit-tests in microseconds:
+
+  * ``ConcurrencyAutoscaler`` — observe (router peak in-flight + engine
+    queue depth) → desired replicas in [floor, max];
+  * ``SLOWindow`` — windowed p99 / error-rate deltas from cumulative
+    histogram + counter state (the per-revision
+    ``kfx_serving_request_seconds`` / ``kfx_router_requests_total``
+    families the router records);
+  * ``RolloutPlan`` — canary percent stepping with automatic rollback
+    on SLO breach.
+
+The InferenceService operator (operators/serving.py) owns the impure
+half: sampling the router, spawning/reaping replicas, admitting chip
+deltas through the cluster scheduler (sched/scheduler.py serving
+reservations), and writing status/events.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+from typing import Deque, List, Optional, Tuple
+
+from .. import chaos
+from ..obs.metrics import percentile_from_buckets
+
+# Decision chaos point: an injection makes the operator skip (or, with
+# mode=delay, stall) one autoscale cycle for the targeted revision —
+# the "controller missed its tick" failure every real autoscaler has.
+DECIDE_CHAOS_POINT = "autoscale.decide"
+# Cold-start chaos point: delays the scale-from-zero spawn, stretching
+# the autoscale.cold_start span the trace waterfall measures.
+COLD_START_CHAOS_POINT = "serving.cold_start"
+
+ROLLBACK_ANNOTATION = "kubeflow.org/rollout-rolled-back"
+
+# Rollout phases (status.rollout.phase).
+PROGRESSING = "Progressing"
+PROMOTED = "Promoted"
+ROLLED_BACK = "RolledBack"
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """Per-revision scaling knobs (spec fields of the same names,
+    camelCased, on the predictor/canary spec — api/serving.py)."""
+
+    max_replicas: int = 1
+    target_concurrency: float = 4.0
+    stable_window_s: float = 30.0
+    panic_window_s: float = 6.0
+    # Burst gate: panic mode engages when the panic-window load calls
+    # for >= threshold x the current replicas (Knative's 200% default).
+    panic_threshold: float = 2.0
+    # At most this growth factor per decision (Knative's
+    # max-scale-up-rate); a 1->N jump still takes log steps, bounding
+    # the chip shock one reconcile can demand from the scheduler.
+    max_scale_up_rate: float = 4.0
+
+
+@dataclasses.dataclass
+class Decision:
+    desired: int
+    panic: bool
+    load: float        # the windowed load the decision derives from
+    reason: str = ""
+
+
+class ConcurrencyAutoscaler:
+    """One revision's KPA-lite loop. ``observe()`` feeds load samples
+    (peak in-flight concurrency since the last sample, plus any decode-
+    engine queue depth — queued requests are unmet concurrency);
+    ``desired()`` turns the windows into a replica count.
+
+    Scale-up follows the panic window (burst reacts in one sample);
+    scale-down follows the *maximum* want over the stable window, so a
+    bursty load's replicas survive the troughs between waves. Panic
+    mode is sticky for a panic window and never scales down."""
+
+    def __init__(self, cfg: AutoscalerConfig):
+        self.cfg = cfg
+        # (t, load) samples; load = concurrency + queue_depth.
+        self._samples: Deque[Tuple[float, float]] = collections.deque()
+        self._panic_until = float("-inf")
+
+    def reconfigure(self, cfg: AutoscalerConfig) -> None:
+        self.cfg = cfg
+
+    def reset(self) -> None:
+        """Drop the sample history (scale-to-zero: once the activator's
+        idle window has confirmed silence, stale in-window samples must
+        not resurrect the replica)."""
+        self._samples.clear()
+        self._panic_until = float("-inf")
+
+    def observe(self, now: float, concurrency: float,
+                queue_depth: float = 0.0) -> None:
+        self._samples.append((now, concurrency + max(queue_depth, 0.0)))
+        horizon = now - max(self.cfg.stable_window_s,
+                            self.cfg.panic_window_s)
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+
+    def _window(self, now: float, width: float) -> List[float]:
+        return [v for t, v in self._samples if t >= now - width]
+
+    def desired(self, now: float, current: int, floor: int) -> Decision:
+        """Replicas this revision should run, clamped to
+        [floor, max_replicas]. ``floor`` is the operator's spec
+        guarantee (minReplicas, or the activator's 1 for a traffic-
+        woken zero-scale revision) — this function only ever raises
+        it."""
+        cfg = self.cfg
+        target = max(cfg.target_concurrency, 1e-9)
+        stable = self._window(now, cfg.stable_window_s)
+        panic = self._window(now, cfg.panic_window_s)
+        stable_avg = sum(stable) / len(stable) if stable else 0.0
+        stable_max = max(stable, default=0.0)
+        panic_avg = sum(panic) / len(panic) if panic else 0.0
+        want = math.ceil(stable_avg / target)
+        want_panic = math.ceil(panic_avg / target)
+        reason = "stable"
+        # Panic: the short window alone calls for a burst of replicas.
+        if want_panic >= cfg.panic_threshold * max(current, 1):
+            self._panic_until = now + cfg.panic_window_s
+        if now < self._panic_until:
+            want = max(want, want_panic, current)
+            reason = "panic"
+        elif want < current:
+            # Damped scale-down: the window's worst moment must also
+            # agree before replicas are torn down between waves.
+            want = max(want, min(math.ceil(stable_max / target), current))
+            reason = "scale-down"
+        if current > 0 and want > current:
+            cap = max(current + 1,
+                      math.ceil(current * cfg.max_scale_up_rate))
+            if want > cap:
+                want, reason = cap, reason + "+rate-capped"
+        desired = max(min(want, cfg.max_replicas), floor, 0)
+        return Decision(desired=desired, panic=now < self._panic_until,
+                        load=panic_avg if reason.startswith("panic")
+                        else stable_avg, reason=reason)
+
+
+def chaos_skip_decision(target: str) -> bool:
+    """Evaluate the ``autoscale.decide`` fault point for one revision's
+    cycle. Returns True when this cycle's decision must be skipped
+    (replicas held as-is); ``mode=delay`` only stalls the reconcile."""
+    rule = chaos.draw(DECIDE_CHAOS_POINT, target=target)
+    if rule is None:
+        return False
+    if rule.delay > 0:
+        import time
+
+        time.sleep(rule.delay)
+    return rule.mode != "delay"
+
+
+# -- SLO watching -------------------------------------------------------------
+
+
+class SLOWindow:
+    """Turns *cumulative* histogram/counter state into per-window
+    deltas: feed the current cumulative buckets + error/total counts,
+    get (p99 seconds, error rate, requests) for the interval since the
+    previous call, then re-base. The registry's counters only ever go
+    up, so the delta is exact regardless of scrape cadence."""
+
+    def __init__(self):
+        self._base_buckets: Optional[List[Tuple[float, int]]] = None
+        self._base_errors = 0.0
+        self._base_total = 0.0
+
+    def advance(self, buckets: List[Tuple[float, int]], errors: float,
+                total: float) -> Tuple[Optional[float], float, int]:
+        base = {le: c for le, c in (self._base_buckets or [])}
+        delta = [(le, c - base.get(le, 0)) for le, c in buckets]
+        n = int(total - self._base_total)
+        err = errors - self._base_errors
+        self._base_buckets = list(buckets)
+        self._base_errors, self._base_total = errors, total
+        p99 = percentile_from_buckets(delta, 0.99) if delta else None
+        rate = (err / n) if n > 0 else 0.0
+        return p99, rate, n
+
+
+def revision_slo_state(reg, namespace: str, isvc: str, revision: str
+                       ) -> Tuple[List[Tuple[float, int]], float, float]:
+    """Cumulative (latency buckets, 5xx errors, total requests) for one
+    revision, read from the router-recorded plane-registry families —
+    the SLOWindow input. Filtered on namespace AND name: the registry
+    is plane-wide and isvc names are only unique per namespace."""
+    hist = reg.histogram("kfx_serving_request_seconds")
+    buckets: List[Tuple[float, int]] = []
+    for labels, hv in hist.samples():
+        if labels.get("namespace") == namespace and \
+                labels.get("isvc") == isvc and \
+                labels.get("revision") == revision:
+            buckets = hv.buckets
+            break
+    ctr = reg.counter("kfx_router_requests_total")
+    errors = total = 0.0
+    for labels, v in ctr.samples():
+        if labels.get("namespace") != namespace or \
+                labels.get("isvc") != isvc or \
+                labels.get("revision") != revision:
+            continue
+        total += v
+        if labels.get("code") == "5xx":
+            errors += v
+    return buckets, errors, total
+
+
+# -- canary rollout -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RolloutSpec:
+    """spec.rollout (api/serving.py validates the manifest shape)."""
+
+    step_percent: int = 10
+    interval_s: float = 30.0
+    max_percent: int = 100
+    slo_p99_ms: float = 0.0       # 0 = latency not judged
+    slo_error_rate: float = 0.05
+    min_requests: int = 10        # per interval, before judging/stepping
+
+
+@dataclasses.dataclass
+class RolloutTick:
+    percent: int
+    phase: str
+    event: Optional[Tuple[str, str, str]] = None  # (type, reason, message)
+
+
+class RolloutPlan:
+    """The canary traffic state machine. Traffic starts at one step and
+    climbs by ``step_percent`` every ``interval_s`` while the canary's
+    windowed SLO holds; a breach drops traffic to 0 and latches
+    ``RolledBack`` (only a spec change resets it — re-judging a known-
+    bad revision would flap). An interval with fewer than
+    ``min_requests`` canary requests neither steps nor judges: silence
+    is not evidence. Reaching ``max_percent`` latches ``Promoted``."""
+
+    def __init__(self, spec: RolloutSpec, now: float,
+                 percent: int = 0, phase: str = PROGRESSING):
+        self.spec = spec
+        self.percent = percent or min(spec.step_percent, spec.max_percent)
+        self.phase = phase
+        if phase == ROLLED_BACK:
+            self.percent = 0
+        self._next_step = now + spec.interval_s
+
+    def due(self, now: float) -> bool:
+        """True when an interval boundary has passed and the caller
+        should advance its SLO window and ``tick``."""
+        return self.phase != ROLLED_BACK and now >= self._next_step
+
+    def tick(self, now: float, p99_s: Optional[float], error_rate: float,
+             n_requests: int) -> RolloutTick:
+        if self.phase == ROLLED_BACK:
+            return RolloutTick(0, self.phase)
+        if now < self._next_step:
+            return RolloutTick(self.percent, self.phase)
+        self._next_step = now + self.spec.interval_s
+        if n_requests < self.spec.min_requests:
+            return RolloutTick(self.percent, self.phase)
+        breach = self._breach(p99_s, error_rate)
+        if breach:
+            self.phase = ROLLED_BACK
+            self.percent = 0
+            return RolloutTick(0, self.phase,
+                               ("Warning", "RolloutRolledBack", breach))
+        if self.phase == PROMOTED:
+            return RolloutTick(self.percent, self.phase)
+        self.percent = min(self.percent + self.spec.step_percent,
+                           self.spec.max_percent)
+        if self.percent >= self.spec.max_percent:
+            self.phase = PROMOTED
+            return RolloutTick(self.percent, self.phase,
+                               ("Normal", "RolloutPromoted",
+                                f"canary holding {self.percent}% with SLO "
+                                f"green"))
+        return RolloutTick(self.percent, self.phase,
+                           ("Normal", "RolloutStep",
+                            f"canary traffic stepped to {self.percent}%"))
+
+    def _breach(self, p99_s: Optional[float], error_rate: float
+                ) -> Optional[str]:
+        if error_rate > self.spec.slo_error_rate:
+            return (f"canary error rate {error_rate:.1%} > SLO "
+                    f"{self.spec.slo_error_rate:.1%}")
+        if self.spec.slo_p99_ms > 0 and p99_s is not None \
+                and p99_s * 1000.0 > self.spec.slo_p99_ms:
+            return (f"canary p99 {p99_s * 1000.0:.0f}ms > SLO "
+                    f"{self.spec.slo_p99_ms:.0f}ms")
+        return None
+
+
+def autoscaler_config_from_spec(spec: dict, floor: int) -> AutoscalerConfig:
+    """Map a revision spec's camelCase knobs onto AutoscalerConfig.
+    ``targetConcurrency``/``scaleDownWindowSeconds`` keep their pre-
+    subsystem names; the panic knobs are new."""
+    return AutoscalerConfig(
+        max_replicas=int(spec.get("maxReplicas", max(floor, 1))),
+        target_concurrency=float(spec.get("targetConcurrency", 4.0)),
+        stable_window_s=float(spec.get(
+            "stableWindowSeconds", spec.get("scaleDownWindowSeconds", 30.0))),
+        panic_window_s=float(spec.get("panicWindowSeconds", 6.0)),
+        panic_threshold=float(spec.get("panicThreshold", 2.0)),
+        max_scale_up_rate=float(spec.get("maxScaleUpRate", 4.0)),
+    )
+
+
+def rollout_spec_from_dict(spec: dict) -> RolloutSpec:
+    return RolloutSpec(
+        step_percent=int(spec.get("stepPercent", 10)),
+        interval_s=float(spec.get("intervalSeconds", 30.0)),
+        max_percent=int(spec.get("maxPercent", 100)),
+        slo_p99_ms=float(spec.get("sloP99Ms", 0.0)),
+        slo_error_rate=float(spec.get("sloErrorRate", 0.05)),
+        min_requests=int(spec.get("minRequests", 10)),
+    )

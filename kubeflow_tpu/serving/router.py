@@ -42,9 +42,13 @@ class BackendSet:
     EJECT_AFTER = 3
     PROBE_AFTER_S = 2.0
 
-    def __init__(self, endpoints: Optional[List[str]] = None):
+    def __init__(self, endpoints: Optional[List[str]] = None,
+                 revision: str = ""):
         self._lock = threading.Lock()
         self._endpoints = list(endpoints or [])
+        # Label for this set's per-revision metrics ("default"/"canary"/
+        # "transformer"/"explainer"), stamped by the owning Router.
+        self.revision = revision
         self._rr = itertools.count()
         # Passive health: consecutive failures and ejection timestamps
         # by endpoint (monotonic; an entry in _ejected means "out of
@@ -79,13 +83,19 @@ class BackendSet:
 
     def set_endpoints(self, endpoints: List[str]) -> None:
         with self._lock:
+            previous = set(self._endpoints)
             self._endpoints = list(endpoints)
-            # Health state for endpoints that left the set must not
-            # linger — a re-added replica starts with a clean slate.
+            # Scale-in hygiene: health state must track the endpoint
+            # SET, not the endpoint string. State for endpoints that
+            # left the set is dropped, and an endpoint ADDED this call
+            # starts with a clean slate even if same-named state
+            # lingered (free_port() reuses ports across replicas, and a
+            # late failure report from the dead replica's in-flight
+            # request must not pre-eject its successor).
             self._fails = {e: n for e, n in self._fails.items()
-                           if e in self._endpoints}
+                           if e in self._endpoints and e in previous}
             self._ejected = {e: t for e, t in self._ejected.items()
-                             if e in self._endpoints}
+                             if e in self._endpoints and e in previous}
 
     def pick(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
         """Next endpoint, skipping ``exclude`` (the retry path's
@@ -139,10 +149,23 @@ class Router:
     """HTTP proxy with default/canary percentage split."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 rng: Optional[random.Random] = None):
-        self.default = BackendSet()
-        self.canary = BackendSet()
+                 rng: Optional[random.Random] = None,
+                 metrics=None, name: str = "", namespace: str = ""):
+        self.default = BackendSet(revision="default")
+        self.canary = BackendSet(revision="canary")
         self.canary_percent = 0
+        # Per-revision observability (the autoscaler/SLO-watcher input):
+        # when a registry is wired (the operator passes the control
+        # plane's), every forwarded request records
+        # kfx_serving_request_seconds{namespace,isvc,revision} and
+        # kfx_router_requests_total{namespace,isvc,revision,code}, and
+        # in-flight concurrency is mirrored to kfx_router_inflight. The
+        # namespace label matters: the registry is plane-wide and isvc
+        # names are only unique per namespace — without it, same-named
+        # services would pollute each other's SLO windows.
+        self.metrics = metrics
+        self.name = name
+        self.namespace = namespace
         # Inference-graph components (SURVEY.md §3 CS3): when configured,
         # :predict chains through the transformer and :explain routes to
         # the explainer; both reach the predictor back through this router
@@ -151,8 +174,8 @@ class Router:
         # ``*_configured`` flags are set by the operator: a configured but
         # not-yet-ready component must 503 (cold path), never silently
         # skip its stage of the graph.
-        self.transformer = BackendSet()
-        self.explainer = BackendSet()
+        self.transformer = BackendSet(revision="transformer")
+        self.explainer = BackendSet(revision="explainer")
         self.transformer_configured = False
         self.explainer_configured = False
         self._rng = rng or random.Random(0xC0FFEE)
@@ -226,10 +249,37 @@ class Router:
             h.wfile.write(body)
             return
         chosen.enter()
+        self._set_inflight(chosen)
         try:
             self._forward(h, backend, chosen, has_body)
         finally:
             chosen.exit()
+            self._set_inflight(chosen)
+
+    def _set_inflight(self, chosen: BackendSet) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "kfx_router_inflight",
+                "In-flight proxied requests by revision backend set.",
+            ).set(chosen._in_flight, namespace=self.namespace,
+                  isvc=self.name, revision=chosen.revision)
+
+    def _record_request(self, chosen: BackendSet, status: int,
+                        seconds: float) -> None:
+        """Per-revision request accounting — the canary SLO watcher's
+        error-rate and p99 source (operators/serving.py)."""
+        if self.metrics is None:
+            return
+        self.metrics.counter(
+            "kfx_router_requests_total",
+            "Proxied requests by revision and status class.",
+        ).inc(1, namespace=self.namespace, isvc=self.name,
+              revision=chosen.revision, code=f"{status // 100}xx")
+        self.metrics.histogram(
+            "kfx_serving_request_seconds",
+            "Router-observed request latency by revision.",
+        ).observe(seconds, namespace=self.namespace, isvc=self.name,
+                  revision=chosen.revision)
 
     def _forward(self, h, backend: str, chosen: BackendSet,
                  has_body: bool) -> None:
@@ -241,6 +291,7 @@ class Router:
         router.dispatch span adopting the caller's trace/span headers;
         its ID is forwarded as X-Kfx-Span-Id so the model server's
         serving.predict span parents to this hop."""
+        t0 = time.perf_counter()
         data = b""
         if has_body:
             length = int(h.headers.get("Content-Length", 0))
@@ -275,6 +326,7 @@ class Router:
             obs_trace.finish_span(sp, status="ok" if ok else "error")
         if last is not None:
             status, headers, payload = last
+            self._record_request(chosen, status, time.perf_counter() - t0)
             h.send_response(status)
             # send_response() already emitted Server/Date; don't duplicate.
             skip = _HOP_BY_HOP | {"content-length", "server", "date"}
@@ -285,6 +337,7 @@ class Router:
             h.end_headers()
             h.wfile.write(payload)
             return
+        self._record_request(chosen, 502, time.perf_counter() - t0)
         body = json.dumps(
             {"error": f"backend {attempt_backend}: {last_err}"}).encode()
         h.send_response(502)

@@ -503,6 +503,22 @@ class ModelServer:
             }
         return out
 
+    def _engine_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-model decode-engine load from this registry's gauges —
+        what the InferenceService autoscaler polls as its queue-depth
+        signal (engine requests waiting for a slot are unmet
+        concurrency the router's in-flight count cannot see). Empty for
+        classifier servers: the operator stops polling on first sight
+        of an empty block."""
+        out: Dict[str, Dict[str, float]] = {}
+        for family, field in (("kfx_lm_queue_depth", "queue_depth"),
+                              ("kfx_lm_slot_occupancy", "slot_occupancy"),
+                              ("kfx_lm_slots", "slots")):
+            for labels, value in self.metrics.gauge(family).samples():
+                model = labels.get("model", "")
+                out.setdefault(model, {})[field] = value
+        return out
+
     def _finish_request(self, h, name: str, verb: str, t0: float) -> None:
         """Record latency/outcome for one routed request and emit the
         structured request log line (trace ID echoed from the caller)."""
@@ -563,7 +579,8 @@ class ModelServer:
             if (q.get("format") or [""])[0] == "json":
                 h._send(200, {"request_count": self.request_count,
                               "models": sorted(self.predictors),
-                              "latency_ms": self._latency_summary()})
+                              "latency_ms": self._latency_summary(),
+                              "engine": self._engine_summary()})
             else:
                 from ..utils.prom import PROM_CTYPE
 

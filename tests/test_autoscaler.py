@@ -1,0 +1,608 @@
+"""Serving autoscaler tests (serving/autoscaler.py + the operator's
+scale/rollout loops): the pure KPA decision function, the canary
+rollout state machine with SLO auto-rollback, elastic serving
+reservations in the cluster scheduler, router scale-in hygiene, the
+autoscale.decide / serving.cold_start chaos points, and two lean e2e
+legs on the tiny sklearn server — a 0->1->N ramp (cold-start span +
+scrape --require of the new families) and an automatic canary
+rollback under an injected error burst."""
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu import chaos
+from kubeflow_tpu.core.store import ResourceStore
+from kubeflow_tpu.sched import Scheduler
+from kubeflow_tpu.serving.autoscaler import (
+    COLD_START_CHAOS_POINT,
+    PROGRESSING,
+    PROMOTED,
+    ROLLED_BACK,
+    AutoscalerConfig,
+    ConcurrencyAutoscaler,
+    RolloutPlan,
+    RolloutSpec,
+    SLOWindow,
+    chaos_skip_decision,
+)
+from kubeflow_tpu.serving.router import BackendSet
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+INF = float("inf")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# -- the pure KPA decision function ------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(max_replicas=10, target_concurrency=4.0,
+                stable_window_s=30.0, panic_window_s=6.0,
+                panic_threshold=2.0, max_scale_up_rate=4.0)
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+class TestConcurrencyAutoscaler:
+    def test_below_target_holds_floor(self):
+        asc = ConcurrencyAutoscaler(_cfg())
+        asc.observe(0.0, 2.0)
+        d = asc.desired(0.0, current=1, floor=1)
+        assert d.desired == 1 and not d.panic
+
+    def test_burst_engages_panic_and_scales_up(self):
+        asc = ConcurrencyAutoscaler(_cfg())
+        asc.observe(0.0, 12.0)  # want 3 >= 2x current(1) -> panic
+        d = asc.desired(0.0, current=1, floor=1)
+        assert d.desired == 3 and d.panic and d.reason.startswith("panic")
+
+    def test_panic_never_scales_down(self):
+        asc = ConcurrencyAutoscaler(_cfg())
+        asc.observe(0.0, 12.0)
+        assert asc.desired(0.0, current=1, floor=1).desired == 3
+        # Load vanishes inside the sticky panic window: replicas hold.
+        asc.observe(2.0, 0.0)
+        d = asc.desired(2.0, current=3, floor=1)
+        assert d.desired == 3 and d.panic
+
+    def test_rate_cap_bounds_one_decision(self):
+        asc = ConcurrencyAutoscaler(_cfg(target_concurrency=1.0,
+                                         max_replicas=20))
+        asc.observe(0.0, 20.0)
+        d = asc.desired(0.0, current=1, floor=1)
+        # 1 -> 20 wants a 20x jump; one decision grants at most 4x.
+        assert d.desired == 4 and "rate-capped" in d.reason
+
+    def test_scale_down_damped_by_window_max(self):
+        asc = ConcurrencyAutoscaler(_cfg())
+        asc.observe(0.0, 8.0)           # wave: want 2
+        asc.observe(10.0, 0.0)          # trough inside the window
+        d = asc.desired(10.0, current=2, floor=1)
+        assert d.desired == 2 and d.reason == "scale-down"
+        # Once the wave ages out of the stable window, scale-down lands.
+        asc.observe(45.0, 0.0)
+        assert asc.desired(45.0, current=2, floor=1).desired == 1
+
+    def test_clamped_to_max_replicas(self):
+        asc = ConcurrencyAutoscaler(_cfg(max_replicas=2,
+                                         target_concurrency=1.0))
+        asc.observe(0.0, 50.0)
+        assert asc.desired(0.0, current=2, floor=1).desired == 2
+
+    def test_queue_depth_is_unmet_concurrency(self):
+        asc = ConcurrencyAutoscaler(_cfg())
+        asc.observe(0.0, 0.0, queue_depth=8.0)
+        assert asc.desired(0.0, current=2, floor=1).desired == 2
+
+    def test_reset_drops_history(self):
+        asc = ConcurrencyAutoscaler(_cfg())
+        asc.observe(0.0, 40.0)
+        assert asc.desired(0.0, current=1, floor=1).desired > 1
+        asc.reset()
+        # Stale burst samples must not resurrect a scaled-to-zero rev.
+        assert asc.desired(0.1, current=0, floor=0).desired == 0
+
+
+# -- SLO window deltas --------------------------------------------------------
+
+
+class TestSLOWindow:
+    def test_cumulative_state_becomes_interval_deltas(self):
+        w = SLOWindow()
+        p99, rate, n = w.advance([(0.1, 10), (1.0, 10), (INF, 10)],
+                                 errors=0, total=10)
+        assert n == 10 and rate == 0.0 and p99 is not None and p99 <= 0.1
+        # Next interval: 10 new slow requests, 5 of them errors — the
+        # old fast traffic must not dilute the fresh regression.
+        p99, rate, n = w.advance([(0.1, 10), (1.0, 20), (INF, 20)],
+                                 errors=5, total=20)
+        assert n == 10 and rate == 0.5 and 0.1 < p99 <= 1.0
+
+    def test_empty_interval_is_not_evidence(self):
+        w = SLOWindow()
+        w.advance([(0.1, 4), (INF, 4)], errors=0, total=4)
+        p99, rate, n = w.advance([(0.1, 4), (INF, 4)], errors=0, total=4)
+        assert n == 0 and rate == 0.0
+
+
+# -- canary rollout state machine --------------------------------------------
+
+
+def _rspec(**kw):
+    base = dict(step_percent=25, interval_s=10.0, max_percent=100,
+                slo_p99_ms=0.0, slo_error_rate=0.1, min_requests=5)
+    base.update(kw)
+    return RolloutSpec(**base)
+
+
+class TestRolloutPlan:
+    def test_steps_to_promoted_while_slo_holds(self):
+        plan = RolloutPlan(_rspec(), now=0.0)
+        assert plan.percent == 25 and not plan.due(5.0)
+        seen = []
+        for t in (10.0, 20.0, 30.0):
+            assert plan.due(t)
+            seen.append(plan.tick(t, p99_s=0.01, error_rate=0.0,
+                                  n_requests=20))
+        assert [s.percent for s in seen] == [50, 75, 100]
+        assert seen[-1].phase == PROMOTED
+        assert seen[-1].event[1] == "RolloutPromoted"
+        # Promoted latches: further green intervals change nothing.
+        after = plan.tick(40.0, 0.01, 0.0, 20)
+        assert after.percent == 100 and after.event is None
+
+    def test_error_breach_rolls_back_and_latches(self):
+        plan = RolloutPlan(_rspec(), now=0.0)
+        tick = plan.tick(10.0, p99_s=0.01, error_rate=0.5, n_requests=20)
+        assert tick.percent == 0 and tick.phase == ROLLED_BACK
+        assert tick.event[1] == "RolloutRolledBack"
+        assert "error rate" in tick.event[2]
+        # Latched: no more stepping, no re-judging, not even due.
+        assert not plan.due(100.0)
+        assert plan.tick(100.0, 0.01, 0.0, 50).percent == 0
+
+    def test_p99_breach(self):
+        plan = RolloutPlan(_rspec(slo_p99_ms=100.0), now=0.0)
+        tick = plan.tick(10.0, p99_s=0.5, error_rate=0.0, n_requests=20)
+        assert tick.phase == ROLLED_BACK and "p99" in tick.event[2]
+
+    def test_thin_interval_neither_steps_nor_judges(self):
+        plan = RolloutPlan(_rspec(), now=0.0)
+        # 100% errors but only 2 requests: silence is not evidence.
+        tick = plan.tick(10.0, p99_s=None, error_rate=1.0, n_requests=2)
+        assert tick.percent == 25 and tick.phase == PROGRESSING
+
+    def test_resume_from_durable_state(self):
+        plan = RolloutPlan(_rspec(), now=0.0, percent=75,
+                           phase=PROGRESSING)
+        assert plan.percent == 75
+        rb = RolloutPlan(_rspec(), now=0.0, percent=75, phase=ROLLED_BACK)
+        assert rb.percent == 0 and not rb.due(999.0)
+
+
+# -- elastic serving reservations in the scheduler ---------------------------
+
+
+def _job(name, replicas=1, prio=0):
+    from kubeflow_tpu.api.base import from_manifest
+
+    return from_manifest({
+        "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+        "metadata": {"name": name},
+        "spec": {
+            "runPolicy": {"schedulingPolicy": {"priority": prio}},
+            "jaxReplicaSpecs": {"Worker": {
+                "replicas": replicas, "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "m",
+                    "command": [PY, "-c", "import time; time.sleep(9)"],
+                }]}}}}}})
+
+
+class TestServingReservations:
+    def _sched(self, store, capacity):
+        sched = Scheduler(store, capacity=capacity)
+        sched.PREEMPTION_COOLDOWN_S = 0.0
+        return sched
+
+    def test_growth_takes_free_capacity(self):
+        sched = self._sched(ResourceStore(), capacity=4)
+        assert sched.resize_serving("svc", "default", 2) == 2
+        assert sched.snapshot()["reserved"] == 2
+        assert sched.resize_serving("svc", "default", 3) == 3
+
+    def test_shrink_returns_chips_and_wakes_queued_training(self):
+        store = ResourceStore()
+        sched = self._sched(store, capacity=2)
+        assert sched.resize_serving("svc", "default", 2) == 2
+        wakes = []
+        sched.register_waker("JAXJob", wakes.append)
+        store.create(_job("train", replicas=2))
+        assert not sched.try_admit(_job("train", replicas=2))[0]
+        # Scale-in: the burst drained, chips hand straight back.
+        assert sched.resize_serving("svc", "default", 0) == 0
+        assert wakes == ["default/train"]
+        assert sched.try_admit(_job("train", replicas=2))[0]
+
+    def test_burst_preempts_low_priority_training_partially(self):
+        store = ResourceStore()
+        for n in ("bg-a", "bg-b"):
+            store.create(_job(n, replicas=2, prio=1))
+        sched = self._sched(store, capacity=4)
+        assert sched.try_admit(_job("bg-a", replicas=2, prio=1))[0]
+        assert sched.try_admit(_job("bg-b", replicas=2, prio=1))[0]
+        # No free chips: the serving burst suspends lower-priority
+        # training. The grant lands as victims tear down (elastic —
+        # partial relief is taken, unlike an all-or-nothing gang).
+        granted = sched.resize_serving("svc", "default", 3, priority=5)
+        assert granted == 0
+        suspended = [n for n in ("bg-a", "bg-b")
+                     if store.get("JAXJob", n).run_policy().suspend]
+        assert suspended, "no training was preempted for the burst"
+        for n in suspended:
+            assert sched.on_suspended(store.get("JAXJob", n)) is True
+        assert sched.serving_granted("svc", "default") == 3
+        snap_rows = [r for r in sched.snapshot()["running"]
+                     if r.get("serving")]
+        assert snap_rows and snap_rows[0]["chips"] == 3
+        assert snap_rows[0]["wanted"] == 3
+        # Scale-in: chips return, the victim resumes from checkpoint.
+        sched.resize_serving("svc", "default", 0)
+        resumed = [n for n in suspended
+                   if not store.get("JAXJob", n).run_policy().suspend]
+        assert resumed, "preempted training never got its chips back"
+
+    def test_equal_priority_training_is_not_preempted(self):
+        store = ResourceStore()
+        store.create(_job("peer", replicas=4, prio=5))
+        sched = self._sched(store, capacity=4)
+        assert sched.try_admit(_job("peer", replicas=4, prio=5))[0]
+        assert sched.resize_serving("svc", "default", 2, priority=5) == 0
+        assert not store.get("JAXJob", "peer").run_policy().suspend
+
+    def test_serving_is_never_a_preemption_victim(self):
+        store = ResourceStore()
+        sched = self._sched(store, capacity=2)
+        assert sched.resize_serving("svc", "default", 2, priority=5) == 2
+        ok, reason, _ = sched.try_admit(_job("urgent", replicas=2, prio=9))
+        assert not ok and reason == "WaitingForCapacity"
+        assert sched.serving_granted("svc", "default") == 2
+
+    def test_wanted_capped_by_slice_capacity(self):
+        sched = self._sched(ResourceStore(), capacity=3)
+        assert sched.resize_serving("svc", "default", 99) == 3
+
+
+# -- router scale-in hygiene --------------------------------------------------
+
+
+class TestRouterScaleInHygiene:
+    E1, E2 = "127.0.0.1:7001", "127.0.0.1:7002"
+
+    def test_removed_then_readded_endpoint_starts_clean(self):
+        bs = BackendSet([self.E1, self.E2])
+        for _ in range(3):
+            bs.report_failure(self.E2)
+        assert bs.ejected_endpoints() == [self.E2]
+        # Scale-in removes :7002; a later scale-up reuses the port.
+        bs.set_endpoints([self.E1])
+        bs.set_endpoints([self.E1, self.E2])
+        # The successor must NOT inherit the dead replica's record —
+        # one failure away from instant ejection.
+        assert bs.ejected_endpoints() == []
+        bs.report_failure(self.E2)
+        bs.report_failure(self.E2)
+        assert bs.ejected_endpoints() == []  # 2 fresh fails < EJECT_AFTER
+
+    def test_surviving_endpoint_keeps_health_state(self):
+        bs = BackendSet([self.E1, self.E2])
+        for _ in range(3):
+            bs.report_failure(self.E1)
+        # A no-op re-wire (every reconcile does this) must not amnesty
+        # an ejected endpoint that never left the set.
+        bs.set_endpoints([self.E1, self.E2])
+        assert bs.ejected_endpoints() == [self.E1]
+
+    def test_late_failure_report_for_removed_endpoint_ignored(self):
+        bs = BackendSet([self.E1, self.E2])
+        bs.set_endpoints([self.E1])
+        for _ in range(5):
+            bs.report_failure(self.E2)  # dead replica's in-flight fails
+        bs.set_endpoints([self.E1, self.E2])
+        assert bs.ejected_endpoints() == []
+
+
+# -- chaos points -------------------------------------------------------------
+
+
+class TestAutoscaleChaos:
+    def test_decide_skip_is_deterministic_and_budgeted(self):
+        chaos.install(chaos.parse_spec("autoscale.decide:count=1"))
+        assert chaos_skip_decision("default/svc/default") is True
+        assert chaos_skip_decision("default/svc/default") is False
+
+    def test_decide_match_scopes_to_revision(self):
+        chaos.install(chaos.parse_spec(
+            "autoscale.decide:count=1,match=/canary"))
+        assert chaos_skip_decision("default/svc/default") is False
+        assert chaos_skip_decision("default/svc/canary") is True
+
+    def test_decide_delay_mode_stalls_but_does_not_skip(self):
+        chaos.install(chaos.parse_spec(
+            "autoscale.decide:mode=delay,delay=0.05,count=1"))
+        t0 = time.monotonic()
+        assert chaos_skip_decision("default/svc/default") is False
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_cold_start_delay_injection(self):
+        chaos.install(chaos.parse_spec(
+            "serving.cold_start:count=1,delay=0.05"))
+        t0 = time.monotonic()
+        chaos.maybe_delay(COLD_START_CHAOS_POINT, default_s=0.0,
+                          target="default/svc/default")
+        assert time.monotonic() - t0 >= 0.05
+        t1 = time.monotonic()  # budget spent: second cold start is free
+        chaos.maybe_delay(COLD_START_CHAOS_POINT, default_s=0.0,
+                          target="default/svc/default")
+        assert time.monotonic() - t1 < 0.05
+
+
+# -- e2e on the tiny sklearn server ------------------------------------------
+
+
+_BROKEN_CANARY = """
+import json, os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def _send(self, code, obj):
+        b = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(b)))
+        self.end_headers()
+        self.wfile.write(b)
+    def do_GET(self):
+        self._send(200, {"ready": True})
+    def do_POST(self):
+        self._send(500, {"error": "injected canary fault"})
+
+HTTPServer(("127.0.0.1", int(os.environ["KFX_PORT"])), H).serve_forever()
+"""
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _wait_url(cp, name, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        url = cp.store.get("InferenceService", name).status.get("url")
+        if url:
+            return url
+        time.sleep(0.1)
+    raise AssertionError("router url never published")
+
+
+class TestAutoscalerE2E:
+    @pytest.fixture(scope="class")
+    def sklearn_export(self, tmp_path_factory):
+        from sklearn.linear_model import LogisticRegression
+
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.serving.sklearn_server import export_sklearn
+
+        ds = get_dataset("mnist")
+        images, labels = next(ds.batches(256))
+        est = LogisticRegression(max_iter=20)
+        est.fit(images.reshape(len(images), -1), labels)
+        out = tmp_path_factory.mktemp("asc-export")
+        export_sklearn(str(out), est, input_shape=ds.shape,
+                       num_classes=ds.num_classes)
+        return str(out)
+
+    def test_scale_zero_to_n_ramp_cold_span_and_scrape(
+            self, sklearn_export, tmp_path):
+        """minReplicas=0 -> cold request scales 0->1 (recorded as an
+        autoscale.cold_start span + histogram), concurrent load scales
+        1->2, and the plane /metrics carries every new family under
+        scrape_metrics --require."""
+        from kubeflow_tpu.apiserver import ApiServer
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+        import scrape_metrics
+
+        home = str(tmp_path / "kfx")
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: ramp
+spec:
+  predictor:
+    minReplicas: 0
+    maxReplicas: 2
+    targetConcurrency: 1
+    stableWindowSeconds: 120
+    scaleToZeroIdleSeconds: 120
+    sklearn:
+      storageUri: file://{sklearn_export}
+"""
+        with ControlPlane(home=home) as cp:
+            cp.apply_text(manifest)
+            url = _wait_url(cp, "ramp")
+            x = np.zeros((2, 28, 28, 1), np.float32).tolist()
+            predict = f"{url}/v1/models/ramp:predict"
+
+            # Cold start: 503 until the activator has scaled 0->1.
+            status = None
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                try:
+                    status, body = _post(predict, {"instances": x},
+                                         timeout=30)
+                    break
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    time.sleep(0.3)
+            assert status == 200 and len(body["predictions"]) == 2
+
+            # Concurrent ramp: peak in-flight > targetConcurrency must
+            # grow replicas toward maxReplicas.
+            payload = json.dumps({"instances": x}).encode()
+            stop = threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        req = urllib.request.Request(
+                            predict, data=payload,
+                            headers={"Content-Type": "application/json"})
+                        with urllib.request.urlopen(req, timeout=30) as r:
+                            r.read()
+                    except Exception:
+                        time.sleep(0.05)
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                grown = 0
+                deadline = time.monotonic() + 45
+                while time.monotonic() < deadline and grown < 2:
+                    cur = cp.store.get("InferenceService", "ramp")
+                    grown = max(grown, (cur.status.get("replicas") or {})
+                                .get("default", 0))
+                    time.sleep(0.2)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join()
+            assert grown >= 2, f"never scaled past 1 (saw {grown})"
+            auto = cp.store.get("InferenceService", "ramp").status.get(
+                "autoscaling") or {}
+            assert auto.get("default", {}).get("desired", 0) >= 1
+
+            # The scale-from-zero window is on the trace waterfall.
+            reasons = [e.reason for e in cp.store.events_for(
+                "InferenceService", "default/ramp")]
+            assert "ColdStart" in reasons
+            span_names = []
+            for path in glob.glob(os.path.join(home, "spans", "*.jsonl")):
+                with open(path) as f:
+                    span_names += [json.loads(line).get("name")
+                                   for line in f if line.strip()]
+            assert "autoscale.cold_start" in span_names
+
+            # Every new family is live on the plane's /metrics and
+            # pinned by the scrape validator.
+            with ApiServer(cp, port=0) as srv:
+                assert scrape_metrics.main(
+                    [f"{srv.url}/metrics",
+                     "--require", "kfx_router_inflight",
+                     "--require", "kfx_router_peak_concurrency",
+                     "--require", "kfx_router_requests_total",
+                     "--require", "kfx_autoscaler_replicas",
+                     "--require", "kfx_autoscaler_desired_replicas",
+                     "--require", "kfx_autoscaler_cold_start_seconds"]) == 0
+
+    def test_canary_auto_rollback_on_error_burst(self, sklearn_export,
+                                                 tmp_path):
+        """A canary revision that 500s every predict is rolled back
+        automatically: traffic snaps to 0, the rollback annotation and
+        RolloutRolledBack event land, and the rollout families are
+        scrapeable."""
+        from kubeflow_tpu.apiserver import ApiServer
+        from kubeflow_tpu.controlplane import ControlPlane
+        from kubeflow_tpu.serving.autoscaler import ROLLBACK_ANNOTATION
+
+        sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+        import scrape_metrics
+
+        broken = tmp_path / "broken_canary.py"
+        broken.write_text(_BROKEN_CANARY)
+        manifest = f"""
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: cnry
+spec:
+  rollout:
+    stepPercent: 50
+    intervalSeconds: 1.0
+    sloErrorRate: 0.2
+    minRequests: 3
+  predictor:
+    minReplicas: 1
+    sklearn:
+      storageUri: file://{sklearn_export}
+  canary:
+    minReplicas: 1
+    containers:
+    - name: bad
+      command: ["{PY}", "{broken}"]
+"""
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply_text(manifest)
+            cp.wait_for_condition("InferenceService", "cnry", "Ready",
+                                  timeout=120)
+            url = cp.store.get("InferenceService", "cnry").status["url"]
+            predict = f"{url}/v1/models/cnry:predict"
+            x = np.zeros((1, 28, 28, 1), np.float32).tolist()
+
+            # Error burst: ~half the requests hit the broken canary and
+            # 500; the SLO watcher's windowed error rate breaches.
+            rolled = False
+            saw_error = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not rolled:
+                try:
+                    _post(predict, {"instances": x}, timeout=15)
+                except urllib.error.HTTPError as e:
+                    saw_error = saw_error or e.code >= 500
+                cur = cp.store.get("InferenceService", "cnry")
+                rolled = ROLLBACK_ANNOTATION in cur.metadata.annotations
+            assert saw_error, "canary faults never reached a client"
+            assert rolled, "rollback annotation never landed"
+
+            cur = cp.store.get("InferenceService", "cnry")
+            assert "error rate" in cur.metadata.annotations[
+                ROLLBACK_ANNOTATION]
+            ro = cur.status.get("rollout") or {}
+            assert ro.get("phase") == ROLLED_BACK and ro.get("percent") == 0
+            reasons = [e.reason for e in cp.store.events_for(
+                "InferenceService", "default/cnry")]
+            assert "RolloutRolledBack" in reasons
+
+            # Rolled back == default-only traffic: predicts succeed.
+            status, _ = _post(predict, {"instances": x}, timeout=30)
+            assert status == 200
+
+            with ApiServer(cp, port=0) as srv:
+                assert scrape_metrics.main(
+                    [f"{srv.url}/metrics",
+                     "--require", "kfx_rollout_canary_percent",
+                     "--require", "kfx_rollout_rollbacks_total"]) == 0

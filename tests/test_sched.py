@@ -348,13 +348,19 @@ class TestSchedulerInPlane:
             # The queue wait landed in the histogram.
             assert cp.metrics.render().count("kfx_sched_queue_seconds") > 1
 
+    @pytest.mark.slow
     def test_preempt_checkpoint_resume_e2e(self, tmp_path, monkeypatch):
         """The acceptance story: a priority-9 job preempts a priority-1
         job mid-training; the victim suspends (checkpoints already on
         disk), the preemptor runs, the victim resumes from its latest
         step and completes. Metrics pass scrape_metrics.py (incl. the
         --require'd kfx_sched_* families) and the sched.admit span sits
-        between reconcile and gang.spawn in the trace."""
+        between reconcile and gang.spawn in the trace.
+
+        Promoted to `slow` (tier-1 budget): at ~99s it was the single
+        heaviest non-slow test, and its preempt/resume arbitration is
+        now also covered lean by TestServingReservations
+        (tests/test_autoscaler.py) and the serial-gang e2e above."""
         import urllib.request  # noqa: F401  (ApiServer readiness below)
 
         from kubeflow_tpu.api import training as T
