@@ -103,6 +103,31 @@ class TransformerConfig:
     # tokens against it. Position ids must be passed explicitly (pads are
     # -1 and masked out of the cache). Built via models.generate.
     decode: bool = False
+    # Paged decode cache (vLLM-style): kv_page_size > 0 replaces the
+    # dense per-row [B, max_seq_len] KV layout with one global pool of
+    # ``kv_pages`` fixed-size pages shared by every request slot; the
+    # caller passes per-row block tables mapping logical block index ->
+    # physical page (-1 = unallocated). Cache shapes become batch-
+    # INDEPENDENT (no per-row cursor — the write location IS the token's
+    # position id), which is what lets prefill (B=1) and decode
+    # (B=n_slots) share one pool. 0 = dense legacy layout (the one-shot
+    # oracle path). Requires kv_page_size | max_seq_len so the gathered
+    # view is exactly [B, max_seq_len] and stays bit-identical to dense.
+    kv_page_size: int = 0
+    kv_pages: int = 0
+
+    def __post_init__(self):
+        if self.kv_page_size < 0 or self.kv_pages < 0:
+            raise ValueError("kv_page_size / kv_pages must be >= 0")
+        if self.kv_page_size > 0:
+            if self.max_seq_len % self.kv_page_size:
+                raise ValueError(
+                    f"kv_page_size {self.kv_page_size} must divide "
+                    f"max_seq_len {self.max_seq_len} (the gathered view "
+                    "must tile exactly)")
+            if self.kv_pages < 1:
+                raise ValueError(
+                    "kv_pages must be >= 1 when kv_page_size > 0")
 
     @property
     def qkv_features(self) -> int:
@@ -172,7 +197,8 @@ class Attention(nn.Module):
                 and flash_window_ok(cfg, seq_len))
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, block_tables=None,
+                 write_locations=None):
         cfg = self.cfg
         B, S, _ = x.shape
         proj = lambda name, feats: nn.DenseGeneral(
@@ -220,7 +246,8 @@ class Attention(nn.Module):
         q = q / np.sqrt(cfg.head_dim)
 
         if cfg.decode:
-            out = self._decode_attend(q, k, v, positions)
+            out = self._decode_attend(q, k, v, positions, block_tables,
+                                      write_locations)
         elif cfg.cp > 1:
             # Context-parallel path: seq sharded over "ctx", heads over
             # "model" (each head attends independently, so tp composes),
@@ -295,48 +322,106 @@ class Attention(nn.Module):
                             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                             name="out")(out), "attn_out")
 
-    def _decode_attend(self, q, k, v, positions):
-        """KV-cache attention: write the S new (already-roped) K/V rows
-        at each row's cache cursor, attend Q against every valid cached
-        slot. Per-slot validity is the cached position id (-1 =
-        empty/pad), so left- or right-padded prompts both stay exact.
+    def _decode_attend(self, q, k, v, positions, block_tables=None,
+                       write_locations=None):
+        """KV-cache attention. Two cache layouts behind one mask rule —
+        per-slot validity is the cached position id (-1 = empty/pad),
+        never the cache location, so both layouts stay exact for left-
+        or right-padded prompts and greedy outputs agree byte-for-byte.
 
-        The cursor is PER BATCH ROW ([B], not a shared scalar): the
-        serving engine (serving/engine.py) runs one cache row per
+        Dense (kv_page_size == 0): one [B, max_seq_len] KV row per
+        batch row, written at a PER-ROW cursor ([B], not a shared
+        scalar): the serving engine used to run one cache row per
         request slot, and slots prefill/retire independently, so row
-        cursors diverge. Writes are row-indexed scatters; out-of-bounds
-        updates (an idle slot whose cursor marched past L between
-        admissions) are dropped by XLA's scatter semantics, and a
-        prefill overwrites the whole row anyway. The one-shot generate
-        path keeps every cursor equal, where the scatter degenerates to
-        the old dynamic_update_slice."""
+        cursors diverge. Out-of-bounds scatter updates (an idle slot
+        whose cursor marched past L) are dropped by XLA's scatter
+        semantics. The one-shot generate path keeps every cursor equal,
+        where the scatter degenerates to a dynamic_update_slice.
+
+        Paged (kv_page_size > 0, vLLM-style): ONE global pool of
+        ``kv_pages`` fixed-size pages shared by every request slot,
+        batch-independent — prefill (B=1) and decode (B=n_slots)
+        mutate the same pool, which is what lets the serving engine
+        prefill directly into a slot's pages with no row copy. The
+        caller passes per-row block tables [B, max_seq_len/page_size]
+        mapping logical block -> physical page (-1 = unallocated).
+        There is no in-cache cursor: each token's write LOCATION in the
+        row's logical space (page = table[loc // P], slot = loc % P)
+        is ``write_locations`` — defaulting to the position id, which
+        is exact for prefill; the engine's decode chunks pass the
+        dense-equivalent cursor location (prompt bucket + step) so the
+        logical layout, pad gaps included, reproduces the dense cache
+        byte-for-byte (an unwritten gap entry and a written pad both
+        mask to probability exactly 0, so the attention sums are
+        bit-identical to the dense layout's). Writes to pad positions
+        (-1), negative locations, or unallocated blocks are dropped;
+        gathered entries from unallocated blocks read as position -1
+        (masked). Page recycling across requests relies on the pool
+        owner invalidating freed pages' position ids — see
+        serving/engine.py."""
         cfg = self.cfg
         B, S, H, D = q.shape
         L = cfg.max_seq_len
-        ck = self.variable("cache", "cached_key",
-                           lambda: jnp.zeros((B, L, H, D), cfg.dtype))
-        cv = self.variable("cache", "cached_value",
-                           lambda: jnp.zeros((B, L, H, D), cfg.dtype))
-        cpos = self.variable("cache", "cached_pos",
-                             lambda: jnp.full((B, L), -1, jnp.int32))
-        cur = self.variable("cache", "cache_index",
-                            lambda: jnp.zeros((B,), jnp.int32))
-        i = cur.value  # [B]
-        rows = jnp.arange(B, dtype=jnp.int32)[:, None]          # [B, 1]
-        at = i[:, None] + jnp.arange(S, dtype=jnp.int32)[None]  # [B, S]
-        ck.value = ck.value.at[rows, at].set(k.astype(cfg.dtype))
-        cv.value = cv.value.at[rows, at].set(v.astype(cfg.dtype))
-        cpos.value = cpos.value.at[rows, at].set(positions)
-        cur.value = i + S
+        if cfg.kv_page_size > 0:
+            P, N = cfg.kv_page_size, cfg.kv_pages
+            if block_tables is None:
+                raise ValueError(
+                    "paged decode (kv_page_size > 0) requires block_tables")
+            ck = self.variable("cache", "cached_key",
+                               lambda: jnp.zeros((N, P, H, D), cfg.dtype))
+            cv = self.variable("cache", "cached_value",
+                               lambda: jnp.zeros((N, P, H, D), cfg.dtype))
+            cpos = self.variable("cache", "cached_pos",
+                                 lambda: jnp.full((N, P), -1, jnp.int32))
+            pos = positions  # [B, S]
+            loc = pos if write_locations is None else write_locations
+            ok = (pos >= 0) & (loc >= 0)
+            blk = jnp.where(ok, loc // P, 0)
+            page = jnp.take_along_axis(block_tables, blk, axis=1)  # [B, S]
+            # Invalid (pad position, negative location, or block not
+            # yet allocated) -> an out-of-range page index;
+            # mode="drop" discards the update.
+            page = jnp.where(ok & (page >= 0), page, N)
+            slot = jnp.where(ok, loc % P, 0)
+            ck.value = ck.value.at[page, slot].set(
+                k.astype(cfg.dtype), mode="drop")
+            cv.value = cv.value.at[page, slot].set(
+                v.astype(cfg.dtype), mode="drop")
+            cpos.value = cpos.value.at[page, slot].set(pos, mode="drop")
+            # Gather each row's logical view [L] through its table.
+            # Unallocated blocks clamp to page 0 for K/V (their scores
+            # are masked to exactly-0 probability via position -1, so
+            # the garbage never contributes) and force position -1.
+            pt = jnp.clip(block_tables, 0, N - 1)        # [B, nblk]
+            gk = ck.value[pt].reshape(B, L, H, D)
+            gv = cv.value[pt].reshape(B, L, H, D)
+            gp = jnp.where((block_tables >= 0)[..., None],
+                           cpos.value[pt], -1).reshape(B, L)
+        else:
+            ck = self.variable("cache", "cached_key",
+                               lambda: jnp.zeros((B, L, H, D), cfg.dtype))
+            cv = self.variable("cache", "cached_value",
+                               lambda: jnp.zeros((B, L, H, D), cfg.dtype))
+            cpos = self.variable("cache", "cached_pos",
+                                 lambda: jnp.full((B, L), -1, jnp.int32))
+            cur = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((B,), jnp.int32))
+            i = cur.value  # [B]
+            rows = jnp.arange(B, dtype=jnp.int32)[:, None]          # [B, 1]
+            at = i[:, None] + jnp.arange(S, dtype=jnp.int32)[None]  # [B, S]
+            ck.value = ck.value.at[rows, at].set(k.astype(cfg.dtype))
+            cv.value = cv.value.at[rows, at].set(v.astype(cfg.dtype))
+            cpos.value = cpos.value.at[rows, at].set(positions)
+            cur.value = i + S
+            gk, gv, gp = ck.value, cv.value, cpos.value
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value)  # [B,H,S,L]
-        kp = cpos.value[:, None, None, :]                    # [B,1,1,L]
-        qp = positions[:, None, :, None]                     # [B,1,S,1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, gk)  # [B,H,S,L]
+        kp = gp[:, None, None, :]                      # [B,1,1,L]
+        qp = positions[:, None, :, None]               # [B,1,S,1]
         mask = (kp >= 0) & (kp <= qp)
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype),
-                          cv.value)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), gv)
 
 
 class DenseFFN(nn.Module):
@@ -451,7 +536,8 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, block_tables=None,
+                 write_locations=None):
         cfg = self.cfg
 
         def sp_shard(y):
@@ -469,7 +555,8 @@ class Block(nn.Module):
 
         x = sp_shard(x)
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.dtype, name="ln1")(x), positions)
+            RMSNorm(cfg.dtype, name="ln1")(x), positions, block_tables,
+            write_locations)
         x = sp_shard(x)
         ffn = MoEFFN(cfg, name="moe") if cfg.n_experts > 0 else \
             DenseFFN(cfg, name="mlp")
@@ -484,7 +571,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, positions=None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, block_tables=None,
+                 write_locations=None):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
@@ -585,7 +673,14 @@ class TransformerLM(nn.Module):
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = ScanBlock(cfg, name="layers")(x, positions)
+        if cfg.kv_page_size > 0:
+            if write_locations is None:
+                write_locations = positions
+            x, _ = ScanBlock(cfg, name="layers")(x, positions,
+                                                 block_tables,
+                                                 write_locations)
+        else:
+            x, _ = ScanBlock(cfg, name="layers")(x, positions)
 
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
         if return_hidden:

@@ -92,10 +92,21 @@ class _Revision:
         self.replicas: List[_Replica] = []
         self.restarts = 0
         self.spawn_error = ""  # last custom-container launch failure
-        # Decode-engine queue sampling state (autoscaler load signal).
+        # Decode-engine queue sampling state (autoscaler load signal),
+        # plus the paged-KV pool totals for `kfx top`'s KV% column.
         self.engine_queue = 0.0
+        self.engine_kv_pages = 0.0
+        self.engine_kv_free = 0.0
         self.engine_sampled = float("-inf")
         self.engine_absent = False
+
+    @property
+    def engine_kv_util(self):
+        """Fraction of the revision's KV pages in use (None when no
+        decode engine answered — classifier revisions)."""
+        if self.engine_kv_pages <= 0:
+            return None
+        return 1.0 - self.engine_kv_free / self.engine_kv_pages
 
     def spawn(self) -> None:
         port = free_port()
@@ -662,12 +673,19 @@ class InferenceServiceController(Controller):
             "1 while the revision's autoscaler is in panic (burst) mode.",
         ).set(1 if decision.panic else 0, namespace=isvc.namespace,
               isvc=isvc.name, revision=rev_name)
-        rt.autoscaling_status[rev_name] = {
+        status = {
             "desired": decision.desired,
             "target": cfg.target_concurrency,
             "panic": decision.panic,
             "reason": decision.reason,
         }
+        kv_util = rev.engine_kv_util
+        if kv_util is not None:
+            # Paged-KV pool utilization (token-weighted load — the
+            # occupancy signal the dense slot count used to hide):
+            # surfaced in `kfx top`'s per-isvc table.
+            status["kvUtil"] = round(kv_util, 3)
+        rt.autoscaling_status[rev_name] = status
         return decision.desired
 
     def _engine_queue_depth(self, rev: _Revision) -> float:
@@ -683,6 +701,7 @@ class InferenceServiceController(Controller):
             return rev.engine_queue
         rev.engine_sampled = now
         total, answered, saw_engine = 0.0, False, False
+        kv_pages, kv_free = 0.0, 0.0
         for r in rev.replicas:
             if not r.ready:
                 continue
@@ -697,9 +716,13 @@ class InferenceServiceController(Controller):
             for row in engine.values():
                 saw_engine = True
                 total += float(row.get("queue_depth", 0.0))
+                kv_pages += float(row.get("kv_pages", 0.0))
+                kv_free += float(row.get("kv_pages_free", 0.0))
         if answered and not saw_engine:
             rev.engine_absent = True  # classifier server: stop polling
         rev.engine_queue = total
+        rev.engine_kv_pages = kv_pages
+        rev.engine_kv_free = kv_free
         return total
 
     def _finish_cold_start(self, isvc: InferenceService, rt: _IsvcRuntime,

@@ -1,44 +1,60 @@
-"""Continuous-batching LM decode engine: slotted KV cache + in-flight
-admission (Orca-style iteration-level scheduling; vLLM's block manager
-reduced to the TPU-friendly fixed-shape case).
+"""Continuous-batching LM decode engine: paged KV cache + in-flight
+admission (Orca-style iteration-level scheduling, OSDI'22; vLLM's
+PagedAttention block manager, SOSP'23, in the TPU-friendly fixed-shape
+form) with content-hashed shared-prefix reuse.
 
 The one-shot path (models/generate.LMGenerator) is run-to-completion:
 each request owns the whole device for its prefill + scan decode, so
 concurrent single-prompt traffic serializes and aggregate throughput
-collapses to ~1/B of the batched number. This engine owns a fixed-shape
-slotted cache — ``n_slots`` independent KV rows of ``max_seq_len``
-each — and a persistent decode loop on a dedicated thread. Exactly two
-compiled functions replace the per-request monolith:
+collapses to ~1/B of the batched number. The engine's first cut (PR 5)
+owned ``n_slots`` dense KV rows of ``max_seq_len`` each — worst-case
+HBM paid per slot regardless of actual request length, which is what
+capped ``n_slots``. This engine instead owns ONE global pool of
+``kv_pages`` fixed-size KV pages (``kv_page_size`` tokens each,
+batch-independent — models/transformer.py ``_decode_attend``) plus a
+per-slot **block table** mapping logical cache blocks to physical
+pages:
 
-  * ``prefill_into_slot(params, cache, logbuf, tokens, slot, true_len)``
-    — one compile per prompt bucket; runs the prompt through the model
-    with a fresh single-row cache and writes that row (K/V, positions,
-    cursor) plus the last real token's logits into the shared state at
-    ``slot``;
-  * ``decode_chunk(params, cache, logbuf, ...slot state...)`` — ONE
-    compile total; advances *every active slot* by ``chunk_tokens``
-    tokens in a single ``lax.scan`` dispatch (preserving the
-    one-dispatch-per-k-tokens property the tunneled-accelerator comment
-    in models/generate.py demands), with per-slot position ids,
-    per-slot RNG streams, per-slot sampling knobs, active-slot masking
-    and per-slot stop-token / length early-retirement.
+  * pages are allocated at prefill and chunk boundaries, so a request
+    only ever holds pages for tokens it has actually produced;
+  * **admission is gated on free pages, not free slots** — ``n_slots``
+    is just the max concurrency (a [B, vocab] logits row per slot),
+    so it can rise far past the dense layout's HBM-bound count;
+  * retirement returns pages to the free list copy-free (freed pages'
+    position ids are invalidated in one batched scatter before reuse,
+    so a recycled page can never leak stale KV into a new request);
+  * a content-hashed **prefix cache** keeps retired-but-hot prompt
+    pages: a new request whose prompt starts with a cached prefix
+    points its block table at the refcounted read-only pages and skips
+    that much prefill entirely (a partially-filled boundary page is
+    shared via device copy-on-write); cache pages are reclaimed LRU
+    when the pool needs them back.
 
-Requests are admitted into free slots at chunk boundaries and retire
-independently, so a 64-token request never blocks an 8-token one; a
-full house queues (bounded — overflow raises ``EngineOverloaded``,
-which the model server answers with 503 + Retry-After).
+Exactly two hot compiled functions remain: ``prefill`` (one compile per
+power-of-two prompt-TAIL bucket; writes the unmatched prompt tokens
+through the slot's block table straight into the pool — no row copy —
+plus the last real token's logits) and ``decode_chunk`` (ONE compile;
+chunked ``lax.scan`` advancing every active slot). Two cold helpers
+(page-invalidate, page-copy for COW) compile once each.
 
 Exactness: attention masks by cached *position id* (-1 = empty), never
-by cache location, and a prefill overwrites its entire slot row — so
-slot reuse cannot leak KV between requests and greedy decode is
-byte-identical to the one-shot oracle (asserted in tests/test_engine.py;
-``KFX_LM_ENGINE=0`` keeps the oracle serving for A/B).
+by cache location, and decode writes land at the DENSE-EQUIVALENT
+location (prompt bucket + step), so greedy decode stays byte-identical
+to the one-shot oracle (asserted in tests/test_engine.py;
+``KFX_LM_ENGINE=0`` keeps the oracle serving for A/B). When the pool
+runs dry mid-decode the youngest slot is preempted and re-queued as a
+recompute continuation (its pages freed for the older slots); a
+request that cannot be placed at all fails with ``PageAllocError``
+(an ``EngineOverloaded``), which the model server answers with
+503 + Retry-After — bounded queueing, never a crash mid-chunk.
 
-Observability: ``kfx_lm_slot_occupancy`` / ``kfx_lm_queue_wait_seconds``
-(+ slots/queue-depth gauges, chunk counter) land on the hosting model
-server's /metrics; each admission stamps an ``engine.admit`` span and
-each dispatch an ``engine.chunk`` span into the request's trace tree.
-Chaos point ``engine.admit`` fails or delays admissions (docs/chaos.md).
+Observability: ``kfx_lm_kv_pages`` / ``kfx_lm_kv_pages_free`` gauges,
+``kfx_lm_prefix_cache_hits_total`` counter, token-weighted
+``kfx_lm_slot_occupancy`` (slot capacity scaled by the pool fraction
+active slots hold, distinct pages — an engine with 90% of its pages
+free reads as mostly idle even with every slot busy), plus the PR-5
+families.
+Chaos points ``engine.admit`` and ``engine.kv_alloc`` (docs/chaos.md).
 
 jax is imported lazily (inside methods): server.py imports this module
 for ``EngineOverloaded`` on its own import path.
@@ -46,10 +62,13 @@ for ``EngineOverloaded`` on its own import path.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import threading
 import time
-from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from collections import OrderedDict, deque
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
+                    Union)
 
 import numpy as np
 
@@ -59,7 +78,7 @@ from ..obs.metrics import MetricsRegistry, default_registry
 
 # Admission wait buckets (seconds): a healthy engine admits within one
 # chunk (sub-ms..ms on tiny models, tens of ms on big ones); the tail
-# is queueing behind a full house.
+# is queueing behind a full pool.
 QUEUE_WAIT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0)
@@ -71,24 +90,36 @@ class EngineOverloaded(RuntimeError):
     503 + Retry-After (shed load, don't 400 a well-formed request)."""
 
 
+class PageAllocError(EngineOverloaded):
+    """KV page pool exhausted (or the ``engine.kv_alloc`` chaos point
+    forced the failure) for a request that nothing in flight can
+    unblock. Subclasses EngineOverloaded so the server's existing
+    shed-load contract (503 + Retry-After) covers it."""
+
+
 class Request:
     """One in-flight generation: token budget, sampling knobs, and a
-    completion event the submitting thread waits on."""
+    completion event the submitting thread waits on. ``tokens`` doubles
+    as the recompute-continuation state: a preempted request re-enters
+    the queue with its generated ids intact and prefills
+    prompt+generated on re-admission."""
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
-                 "stop", "bucket", "tokens", "error", "t_enqueue",
+                 "stop", "tokens", "rng", "error", "t_enqueue",
                  "t_done", "trace_id", "span_id", "_event")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
-                 top_k: int, seed: int, stop: int, bucket: int):
+                 top_k: int, seed: int, stop: int):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.top_k = top_k
         self.seed = seed
         self.stop = stop              # -1 = no stop token
-        self.bucket = bucket          # prompt pad bucket (cache budget)
         self.tokens: List[int] = []   # generated ids, filled by the loop
+        # RNG stream stashed at preemption ([2] uint32); None until
+        # then — a fresh admission derives the stream from ``seed``.
+        self.rng: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.monotonic()
         self.t_done = 0.0
@@ -116,9 +147,196 @@ class Request:
         return self.tokens
 
 
+class BlockManager:
+    """Host-side page-pool bookkeeping: a free list plus per-page
+    refcounts (a page shared by k block tables — slots and/or the
+    prefix cache — carries ref k and returns to the free list only
+    when the last holder releases it). Freed pages are remembered as
+    ``dirty`` until their cached position ids are invalidated on
+    device (the engine batches that into one scatter per reuse)."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 1 or page_size < 1:
+            raise ValueError("n_pages and page_size must be >= 1")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(n_pages - 1, -1, -1))
+        self.ref = np.zeros((n_pages,), np.int32)
+        self.dirty: set = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages (ref 1 each). All-or-nothing: raises
+        PageAllocError without side effects when the free list is
+        short (the caller reclaims prefix-cache pages first)."""
+        if n > len(self._free):
+            raise PageAllocError(
+                f"KV page pool exhausted ({len(self._free)} free, "
+                f"{n} needed, {self.n_pages} total)")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self.ref[p] = 1
+        return pages
+
+    def incref(self, page: int) -> None:
+        assert self.ref[page] > 0, f"incref of free page {page}"
+        self.ref[page] += 1
+
+    def decref(self, pages: Sequence[int]) -> List[int]:
+        """Release one reference per page; pages hitting zero return
+        to the free list (marked dirty) and are listed back."""
+        freed = []
+        for p in pages:
+            assert self.ref[p] > 0, f"decref of free page {p}"
+            self.ref[p] -= 1
+            if self.ref[p] == 0:
+                self._free.append(p)
+                self.dirty.add(p)
+                freed.append(p)
+        return freed
+
+
+class _PrefixEntry:
+    __slots__ = ("key", "parent", "page", "tokens", "partial", "nchildren")
+
+    def __init__(self, key: bytes, parent: bytes, page: int,
+                 tokens: Tuple[int, ...], partial: bool):
+        self.key = key          # lru/map key (chain hash; partial: parent)
+        self.parent = parent
+        self.page = page
+        self.tokens = tokens    # partial entries: the page's real tokens
+        self.partial = partial
+        self.nchildren = 0      # cached entries extending this one
+
+
+def _chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
+    h = hashlib.sha256(parent)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
+
+
+class PrefixCache:
+    """Content-hashed prompt-page cache over the shared pool.
+
+    Full pages are keyed by the CHAIN hash of their content (page i's
+    key folds page i-1's key, so a match is a match of the whole
+    prefix, not of one page in isolation). At most one PARTIAL entry
+    per parent key remembers a request's last, partially-filled prompt
+    page — matched by exact token comparison and shared via device
+    copy-on-write (the copy drops everything past the matched tokens,
+    so a stale tail can never leak). The cache holds one pool ref per
+    entry; eviction is LRU over childless entries whose page no live
+    slot still uses (ref == 1)."""
+
+    def __init__(self, manager: BlockManager):
+        self.mgr = manager
+        self.full: Dict[bytes, _PrefixEntry] = {}
+        self.partial: Dict[bytes, _PrefixEntry] = {}
+        self._lru: "OrderedDict[Tuple[bool, bytes], _PrefixEntry]" = \
+            OrderedDict()
+        self.hits = 0
+        self.tokens_reused = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def _touch(self, e: _PrefixEntry) -> None:
+        self._lru.move_to_end((e.partial, e.key))
+
+    def match(self, tokens: Sequence[int], max_reuse: int
+              ) -> Tuple[List[int], Optional[Tuple[int, int]], int, bytes]:
+        """Longest cached prefix of ``tokens`` reusable within
+        ``max_reuse`` (the caller caps at len-1: the last prompt token
+        must run through the model for its logits). Returns
+        (full_pages, cow, matched_tokens, chain_key) where ``cow`` is
+        (source_page, n_tokens) when a partial boundary page extends
+        the match via copy-on-write."""
+        ps = self.mgr.page_size
+        pages: List[int] = []
+        key, matched = b"", 0
+        while matched + ps <= max_reuse:
+            nxt = _chain_hash(key, tokens[matched:matched + ps])
+            e = self.full.get(nxt)
+            if e is None:
+                break
+            pages.append(e.page)
+            key, matched = nxt, matched + ps
+            self._touch(e)
+        cow = None
+        pe = self.partial.get(key)
+        if pe is not None:
+            # Longest agreeing prefix of the boundary page (the COW
+            # copy keeps exactly this many token slots valid).
+            cap = min(len(pe.tokens), max_reuse - matched)
+            extra = 0
+            while extra < cap and \
+                    tokens[matched + extra] == pe.tokens[extra]:
+                extra += 1
+            if extra > 0:
+                cow = (pe.page, extra)
+                matched += extra
+                self._touch(pe)
+        return pages, cow, matched, key
+
+    def insert_full(self, parent: bytes, page_tokens: Sequence[int],
+                    page: int) -> bytes:
+        """Register one full prompt page; returns its chain key. A
+        pre-existing identical entry is refreshed, not duplicated."""
+        key = _chain_hash(parent, page_tokens)
+        e = self.full.get(key)
+        if e is not None:
+            self._touch(e)
+            return key
+        e = _PrefixEntry(key, parent, page, (), False)
+        self.mgr.incref(page)
+        self.full[key] = e
+        self._lru[(False, key)] = e
+        pe = self.full.get(parent)
+        if pe is not None:
+            pe.nchildren += 1
+        return key
+
+    def insert_partial(self, parent: bytes, tokens: Sequence[int],
+                       page: int) -> None:
+        """Register a partially-filled boundary page (first writer
+        wins per parent — replacing a hot partial with an equivalent
+        one would only churn refcounts)."""
+        if not tokens or parent in self.partial:
+            return
+        e = _PrefixEntry(parent, parent, page, tuple(tokens), True)
+        self.mgr.incref(page)
+        self.partial[parent] = e
+        self._lru[(True, parent)] = e
+        pe = self.full.get(parent)
+        if pe is not None:
+            pe.nchildren += 1
+
+    def _drop(self, e: _PrefixEntry) -> List[int]:
+        del (self.partial if e.partial else self.full)[e.key]
+        del self._lru[(e.partial, e.key)]
+        pe = self.full.get(e.parent)
+        if pe is not None:
+            pe.nchildren -= 1
+        return self.mgr.decref([e.page])
+
+    def evict_one(self) -> bool:
+        """Reclaim the least-recently-used childless entry whose page
+        no slot is still reading (pool ref == 1). Returns whether a
+        page went back to the free list."""
+        for e in list(self._lru.values()):
+            if e.nchildren == 0 and self.mgr.ref[e.page] == 1:
+                self._drop(e)
+                return True
+        return False
+
+
 class DecodeEngine:
-    """Owns the slotted cache, the compiled prefill/decode functions and
-    the decode-loop thread. One instance per served LM."""
+    """Owns the paged KV pool, the block tables, the prefix cache, the
+    compiled prefill/decode functions and the decode-loop thread. One
+    instance per served LM."""
 
     def __init__(self, cfg, params, n_slots: int = 8,
                  chunk_tokens: int = 8, max_queue: Optional[int] = None,
@@ -126,7 +344,10 @@ class DecodeEngine:
                  registry: Union[MetricsRegistry,
                                  Callable[[], MetricsRegistry],
                                  None] = None,
-                 request_timeout_s: float = 50.0):
+                 request_timeout_s: float = 50.0,
+                 kv_page_size: int = 32,
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         import jax
 
         from ..models.generate import decode_config
@@ -136,7 +357,29 @@ class DecodeEngine:
             raise ValueError("n_slots must be >= 1")
         if chunk_tokens < 1:
             raise ValueError("chunk_tokens must be >= 1")
-        self.cfg = decode_config(cfg)
+        base = decode_config(cfg)
+        L = base.max_seq_len
+        ps = min(int(kv_page_size), L)
+        if ps < 1:
+            raise ValueError(f"kv_page_size must be >= 1, got {ps}")
+        while L % ps:
+            # The gathered view must tile max_seq_len exactly; fall
+            # back to the largest divisor at or below the request.
+            ps -= 1
+        self.page_size = ps
+        self.n_blocks = L // ps
+        # Default pool = the dense layout's HBM (n_slots full rows);
+        # shrink kv_pages to cap KV HBM below that — admission then
+        # gates on pages, and n_slots is just max concurrency.
+        self.n_pages = int(kv_pages) if kv_pages else n_slots * self.n_blocks
+        if self.n_pages < self.n_blocks:
+            # One request must always be placeable, or the engine
+            # could accept traffic it can never serve.
+            raise ValueError(
+                f"kv_pages {self.n_pages} < blocks per max-length "
+                f"request {self.n_blocks}")
+        self.cfg = dataclasses.replace(base, kv_page_size=ps,
+                                       kv_pages=self.n_pages)
         self.name = name
         self.n_slots = n_slots
         self.chunk_tokens = chunk_tokens
@@ -152,19 +395,28 @@ class DecodeEngine:
         # backend donation is unsupported noise, skip it.
         self._donate = jax.default_backend() != "cpu"
 
-        L = self.cfg.max_seq_len
         self.prompt_buckets: List[int] = []
         b = 8
         while b <= max(8, L // 2):
             self.prompt_buckets.append(min(b, L))
             b *= 2
 
+        # -- pool bookkeeping (touched only by the loop thread)
+        self._mgr = BlockManager(self.n_pages, ps)
+        self._prefix: Optional[PrefixCache] = \
+            PrefixCache(self._mgr) if prefix_cache else None
+        self._prompt_tokens = 0  # prompt tokens admitted (for skip frac)
+
         # -- device state (touched only by the loop thread after start)
         self._cache = self._init_cache()
         self._logbuf = self._init_logbuf()
         # -- host slot state (numpy mirrors round-tripped per chunk)
         B = n_slots
+        self._tables = np.full((B, self.n_blocks), -1, np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(B)]
         self._pos = np.zeros((B,), np.int32)       # next decode position
+        self._loc = np.zeros((B,), np.int32)       # next decode write loc
+        self._max_loc = np.zeros((B,), np.int32)   # last writable loc
         self._active = np.zeros((B,), np.bool_)
         self._produced = np.zeros((B,), np.int32)
         self._rngs = np.zeros((B, 2), np.uint32)
@@ -179,6 +431,8 @@ class DecodeEngine:
         self._exec_lock = threading.Lock()
         self._prefill_exec: Dict[int, Any] = {}
         self._decode_exec: Any = None
+        self._reset_exec: Any = None
+        self._copy_exec: Any = None
 
         self._cond = threading.Condition()
         self._queue: "deque[Request]" = deque()
@@ -195,17 +449,60 @@ class DecodeEngine:
             return r()
         return r if r is not None else default_registry()
 
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """KV HBM per cached token: 2 (K+V) x layers x heads x head_dim
+        x dtype bytes, plus the page's position-id word amortized."""
+        c = self.cfg
+        item = np.dtype(c.dtype).itemsize
+        return 2 * c.n_layers * c.n_heads * c.head_dim * item + 4
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Cumulative prefix-cache counters (zeros while the cache is
+        off): prompt tokens admitted and tokens served from cached
+        pages. Public surface for per-window deltas (bench's
+        shared-prefix leg computes its skipped fraction from these)."""
+        reused = self._prefix.tokens_reused if self._prefix is not None \
+            else 0
+        return {"tokens_reused": reused,
+                "prompt_tokens": self._prompt_tokens}
+
+    def _occupancy(self) -> float:
+        """Token-weighted occupancy: slot capacity (``n_slots``) scaled
+        by the pool fraction active slots' pages actually pin. The old
+        slot count read "full" for n_slots tiny requests even with 90%
+        of KV HBM free, so the autoscaler over-scaled exactly when
+        paging had created headroom. DISTINCT pages: prefix-shared
+        pages appear in every sharer's list but pin one physical page
+        — double-counting would read "full" exactly when sharing had
+        created headroom."""
+        held = len({pg for i, r in enumerate(self._slots)
+                    if r is not None for pg in self._slot_pages[i]})
+        return self.n_slots * held / float(self.n_pages)
+
     def _touch_gauges(self) -> None:
         reg = self._reg()
         reg.gauge("kfx_lm_slots",
-                  "Decode-engine KV-cache slots.").set(
+                  "Decode-engine request slots (max concurrency).").set(
                       self.n_slots, model=self.name)
         reg.gauge("kfx_lm_slot_occupancy",
-                  "Decode-engine slots currently generating.").set(
-                      int(self._active_count()), model=self.name)
+                  "Token-weighted engine load: slot capacity scaled by "
+                  "the KV-page fraction active slots hold.").set(
+                      round(self._occupancy(), 4), model=self.name)
         reg.gauge("kfx_lm_queue_depth",
                   "Requests waiting for a decode-engine slot.").set(
                       len(self._queue), model=self.name)
+        reg.gauge("kfx_lm_kv_pages",
+                  "KV cache pages in the engine's pool.").set(
+                      self.n_pages, model=self.name)
+        reg.gauge("kfx_lm_kv_pages_free",
+                  "KV cache pages on the free list.").set(
+                      self._mgr.n_free, model=self.name)
+        # Seed the hit counter (inc 0) so --require scrapes see the
+        # family before the first warm-cache admission.
+        reg.counter("kfx_lm_prefix_cache_hits_total",
+                    "Admissions that reused cached prefix pages.").inc(
+                        0, model=self.name)
 
     def _active_count(self) -> int:
         return sum(1 for r in self._slots if r is not None)
@@ -217,16 +514,19 @@ class DecodeEngine:
 
     # -- cache / compiled functions ------------------------------------------
     def _init_cache(self):
-        """Zeros of the decode cache pytree for B=n_slots (positions
-        -1 = every location empty), built from eval_shape — no compile,
-        no dispatch."""
+        """Zeros of the paged cache pytree (positions -1 = every page
+        empty), built from eval_shape — no compile, no dispatch. The
+        pool is batch-independent, so the B used here is irrelevant to
+        the shapes."""
         import jax
         import jax.numpy as jnp
 
         def mk(p):
-            toks = jnp.zeros((self.n_slots, 1), jnp.int32)
-            pos = jnp.full((self.n_slots, 1), -1, jnp.int32)
+            toks = jnp.zeros((1, 1), jnp.int32)
+            pos = jnp.full((1, 1), -1, jnp.int32)
+            bt = jnp.full((1, self.n_blocks), -1, jnp.int32)
             return self.model.apply({"params": p}, toks, positions=pos,
+                                    block_tables=bt,
                                     mutable=["cache"])[1]["cache"]
 
         shapes = jax.eval_shape(mk, self.params)
@@ -252,7 +552,7 @@ class DecodeEngine:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._cache)
 
     def _prefill_for(self, P: int):
-        """The AOT-compiled prefill executable for prompt bucket P
+        """The AOT-compiled prefill executable for prompt-tail bucket P
         (compile-on-demand; the warm thread populates the same table)."""
         with self._exec_lock:
             fn = self._prefill_exec.get(P)
@@ -268,25 +568,27 @@ class DecodeEngine:
 
         model = self.model
 
-        def run(params, cache, logbuf, tokens, slot, true_len):
-            """tokens [1, P] right-padded; writes slot row + last-real-
-            token logits. Pads carry position -1: masked out of every
-            attention, so padding never changes the numbers (the
-            LMGenerator contract, unchanged)."""
+        def run(params, cache, logbuf, tokens, table, slot, true_len,
+                start):
+            """tokens [1, P] right-padded prompt TAIL starting at
+            absolute position ``start`` (0 for a cache miss; the
+            matched prefix length on a hit — earlier positions are
+            read from shared pages through the block table). Writes
+            land directly in the pool pages ``table`` maps, plus the
+            last real token's logits at ``logbuf[slot]``. Pads carry
+            position -1: their writes are dropped and they are masked
+            out of every attention, so padding never changes the
+            numbers (the LMGenerator contract, unchanged)."""
             pos = jnp.arange(P, dtype=jnp.int32)[None, :]
-            pos = jnp.where(pos < true_len, pos, -1)
-            logits, vars_ = model.apply({"params": params}, tokens,
-                                        positions=pos, mutable=["cache"])
-            row = vars_["cache"]  # fresh B=1 cache: [layers, 1, ...]
-            cache = jax.tree_util.tree_map(
-                lambda big, small: jax.lax.dynamic_update_slice_in_dim(
-                    big, small.astype(big.dtype), slot, axis=1),
-                cache, row)
+            pos = jnp.where(pos < true_len, start + pos, -1)
+            logits, vars_ = model.apply(
+                {"params": params, "cache": cache}, tokens,
+                positions=pos, block_tables=table, mutable=["cache"])
             last = jax.lax.dynamic_slice_in_dim(
                 logits, true_len - 1, 1, axis=1)[0, 0]  # [V]
             logbuf = jax.lax.dynamic_update_slice_in_dim(
                 logbuf, last[None, :].astype(logbuf.dtype), slot, axis=0)
-            return cache, logbuf
+            return vars_["cache"], logbuf
 
         donate = (1, 2) if self._donate else ()
         specs = (
@@ -297,6 +599,8 @@ class DecodeEngine:
             jax.ShapeDtypeStruct((self.n_slots, self.cfg.vocab_size),
                                  np.float32),
             jax.ShapeDtypeStruct((1, P), np.int32),
+            jax.ShapeDtypeStruct((1, self.n_blocks), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
             jax.ShapeDtypeStruct((), np.int32),
             jax.ShapeDtypeStruct((), np.int32),
         )
@@ -329,10 +633,10 @@ class DecodeEngine:
                 lambda l, kk, t, tk: _sample(l[None], kk, t, tk)[0]
             )(logits, keys, temp, topk)
 
-        def run(params, cache, logbuf, pos, active, produced, rngs,
-                temp, topk, stop, max_new):
+        def run(params, cache, logbuf, tables, pos, loc, active,
+                produced, rngs, temp, topk, stop, max_new):
             def step(carry, _):
-                cache, logits, pos, active, produced, rngs = carry
+                cache, logits, pos, loc, active, produced, rngs = carry
                 split = jax.vmap(jax.random.split)(rngs)  # [B, 2, 2]
                 next_rngs, sub = split[:, 0], split[:, 1]
                 tok = sample_slots(logits, sub, temp, topk)  # [B]
@@ -343,23 +647,29 @@ class DecodeEngine:
                 produced2 = produced + emit.astype(jnp.int32)
                 active2 = emit & (produced2 < max_new)
                 # Inactive slots feed a masked dummy step: position -1
-                # keeps their query row fully masked and their cache
-                # writes invalid, so a retired slot's garbage can never
-                # reach an active slot (rows are independent anyway).
+                # keeps their query row fully masked and location -1
+                # drops their cache writes, so a retired slot's garbage
+                # can never reach an active slot. Writes land at the
+                # DENSE-EQUIVALENT location (prompt bucket + step), so
+                # the logical layout — pad gaps included — reproduces
+                # the one-shot oracle's cache byte-for-byte.
                 feed = jnp.where(active, tok, 0)
                 eff_pos = jnp.where(active, pos, -1).astype(jnp.int32)
+                eff_loc = jnp.where(active, loc, -1).astype(jnp.int32)
                 logits2, vars_ = model.apply(
                     {"params": params, "cache": cache}, feed[:, None],
-                    positions=eff_pos[:, None], mutable=["cache"])
+                    positions=eff_pos[:, None], block_tables=tables,
+                    write_locations=eff_loc[:, None], mutable=["cache"])
                 pos2 = jnp.where(active, pos + 1, pos)
-                return ((vars_["cache"], logits2[:, 0], pos2, active2,
-                         produced2, next_rngs), (tok, emit))
+                loc2 = jnp.where(active, loc + 1, loc)
+                return ((vars_["cache"], logits2[:, 0], pos2, loc2,
+                         active2, produced2, next_rngs), (tok, emit))
 
-            carry = (cache, logbuf, pos, active, produced, rngs)
+            carry = (cache, logbuf, pos, loc, active, produced, rngs)
             carry, (toks, emits) = jax.lax.scan(step, carry, None,
                                                 length=k)
-            cache, logbuf, pos, active, produced, rngs = carry
-            return (cache, logbuf, pos, active, produced, rngs,
+            cache, logbuf, pos, loc, active, produced, rngs = carry
+            return (cache, logbuf, pos, loc, active, produced, rngs,
                     toks, emits)
 
         donate = (1, 2) if self._donate else ()
@@ -370,7 +680,9 @@ class DecodeEngine:
                                    self.params),
             self._cache_specs(),
             sds((B, V), np.float32),
+            sds((B, self.n_blocks), np.int32),  # block tables
             sds((B,), np.int32),      # pos
+            sds((B,), np.int32),      # loc
             sds((B,), np.bool_),      # active
             sds((B,), np.int32),      # produced
             sds((B, 2), np.uint32),   # rngs
@@ -381,6 +693,74 @@ class DecodeEngine:
         )
         return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
 
+    def _reset_fn(self):
+        """Compiled page invalidation: sets cached position ids to -1
+        for every page selected by a [n_pages] mask (ONE compile; the
+        mask is data). Recycled pages pass through here before reuse,
+        so a new tenant can never attend a previous request's KV."""
+        with self._exec_lock:
+            fn = self._reset_exec
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        def run(cache, mask):
+            flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+            leaves = []
+            for path, leaf in flat:
+                name = getattr(path[-1], "key", str(path[-1]))
+                if name == "cached_pos":  # [layers, N, P]
+                    leaf = jnp.where(mask[None, :, None], -1, leaf)
+                leaves.append(leaf)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        donate = (0,) if self._donate else ()
+        specs = (self._cache_specs(),
+                 jax.ShapeDtypeStruct((self.n_pages,), np.bool_))
+        fn = jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+        with self._exec_lock:
+            if self._reset_exec is None:
+                self._reset_exec = fn
+            return self._reset_exec
+
+    def _copy_fn(self):
+        """Compiled copy-on-write: clones page ``src`` into ``dst``
+        keeping only the first ``keep`` token slots valid (positions
+        past the matched prefix are stamped -1, so the source's later
+        tokens can never leak into the borrowing request)."""
+        with self._exec_lock:
+            fn = self._copy_exec
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        ps = self.page_size
+
+        def run(cache, dst, src, keep):
+            flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+            leaves = []
+            for path, leaf in flat:
+                name = getattr(path[-1], "key", str(path[-1]))
+                row = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=1)
+                if name == "cached_pos":  # [layers, 1, P]
+                    valid = jnp.arange(ps, dtype=jnp.int32)[None, None, :]
+                    row = jnp.where(valid < keep, row, -1)
+                leaves.append(jax.lax.dynamic_update_slice_in_dim(
+                    leaf, row, dst, axis=1))
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        donate = (0,) if self._donate else ()
+        sds = jax.ShapeDtypeStruct
+        specs = (self._cache_specs(), sds((), np.int32),
+                 sds((), np.int32), sds((), np.int32))
+        fn = jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+        with self._exec_lock:
+            if self._copy_exec is None:
+                self._copy_exec = fn
+            return self._copy_exec
+
     def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
         """Compile the decode chunk and the prefill for ``buckets``
         (default: every configured prompt bucket). Returns the number
@@ -388,6 +768,13 @@ class DecodeEngine:
         background thread: it only populates the AOT tables, never the
         live slot state."""
         self._decode()
+        # The cold helpers too: the page-invalidate runs on the first
+        # page reuse and the COW copy on the first partial prefix hit —
+        # both would otherwise pay their one-time compile inside a
+        # serving request.
+        self._reset_fn()
+        if self._prefix is not None:
+            self._copy_fn()
         for b in buckets if buckets is not None else self.prompt_buckets:
             self._prefill_for(int(b))
         with self._exec_lock:
@@ -397,8 +784,6 @@ class DecodeEngine:
     def _make_request(self, prompt: Sequence[int], max_new_tokens: int,
                       temperature: float, top_k: int, seed: int,
                       stop_token: Optional[int]) -> Request:
-        from ..models.generate import pow2_bucket
-
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -409,15 +794,9 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the cache capacity {L}")
-        # The prompt pads to a power-of-two bucket (compile sharing);
-        # bucket + budget must fit the slot, so a tight request falls
-        # back to an exact-fit bucket — pow2_bucket IS LMGenerator's
-        # bucket policy (shared helper), keeping oracle parity.
-        bucket = pow2_bucket(len(prompt), L - max_new_tokens)
         return Request(prompt, int(max_new_tokens), float(temperature),
                        int(top_k), int(seed),
-                       -1 if stop_token is None else int(stop_token),
-                       bucket)
+                       -1 if stop_token is None else int(stop_token))
 
     def _enqueue(self, reqs: List[Request]) -> None:
         """All-or-nothing enqueue: a batch that does not fit the
@@ -467,6 +846,40 @@ class DecodeEngine:
         return [r.result(max(0.001, deadline - time.monotonic()))
                 for r in reqs]
 
+    # -- page allocation -----------------------------------------------------
+    def _alloc_pages(self, n: int) -> List[int]:
+        """Take ``n`` pages, reclaiming LRU prefix-cache pages when the
+        free list is short, and invalidating any recycled page's
+        position ids on device BEFORE handing it out (one batched
+        scatter per reuse wave). The ``engine.kv_alloc`` chaos point
+        forces the failure path."""
+        inj = chaos.draw("engine.kv_alloc", target=self.name)
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                raise PageAllocError(
+                    f"chaos[engine.kv_alloc]: {self.name}")
+        while self._mgr.n_free < n:
+            if self._prefix is None or not self._prefix.evict_one():
+                break  # alloc() raises with the honest numbers
+        pages = self._mgr.alloc(n)
+        if self._mgr.dirty:
+            mask = np.zeros((self.n_pages,), np.bool_)
+            mask[list(self._mgr.dirty)] = True
+            self._cache = self._reset_fn()(self._cache, mask)
+            self._mgr.dirty.clear()
+        return pages
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot's page references to the pool (pages still
+        pinned by the prefix cache or other slots survive; the rest go
+        back to the free list and will be invalidated before reuse)."""
+        self._mgr.decref(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._tables[slot, :] = -1
+        self._active[slot] = False
+
     # -- the decode loop -----------------------------------------------------
     def _loop(self) -> None:
         while True:
@@ -486,7 +899,12 @@ class DecodeEngine:
 
     def _admit_ready(self) -> None:
         """Admit queued requests into free slots (runs between chunks —
-        iteration-level scheduling, never mid-dispatch)."""
+        iteration-level scheduling, never mid-dispatch). Admission is
+        gated on free PAGES: a request the pool cannot hold right now
+        stays queued (bounded — overflow already 503s at submit) while
+        in-flight work retires and frees pages; if nothing is in
+        flight to free them, it fails honestly instead of waiting
+        forever."""
         while True:
             with self._cond:
                 free = [i for i, r in enumerate(self._slots) if r is None]
@@ -495,6 +913,13 @@ class DecodeEngine:
                 req = self._queue.popleft()
             try:
                 self._admit(req, free[0])
+            except PageAllocError as e:
+                if self._active_count() == 0:
+                    req._finish(e)
+                else:
+                    with self._cond:
+                        self._queue.appendleft(req)
+                    break
             except BaseException as e:
                 # A failed prefill (compile/OOM) fails THIS request —
                 # the req is not in a slot yet, so the loop-level
@@ -507,6 +932,8 @@ class DecodeEngine:
     def _admit(self, req: Request, slot: int) -> None:
         import jax
 
+        from ..models.generate import pow2_bucket
+
         # Fault point: admission failure/latency — the engine-era
         # analogue of serving.predict (docs/chaos.md).
         inj = chaos.draw("engine.admit", target=self.name)
@@ -517,44 +944,191 @@ class DecodeEngine:
                 req._finish(RuntimeError(
                     f"chaos[engine.admit]: {self.name}"))
                 return
-        wait = time.monotonic() - req.t_enqueue
-        self._reg().histogram(
-            "kfx_lm_queue_wait_seconds",
-            "Decode-engine admission wait (enqueue to slot prefill).",
-            buckets=QUEUE_WAIT_BUCKETS).observe(wait, model=self.name)
-        tokens = np.zeros((1, req.bucket), np.int32)
-        tokens[0, :len(req.prompt)] = req.prompt
+        L, ps = self.cfg.max_seq_len, self.page_size
+        # Recompute continuation: a preempted request re-prefills
+        # prompt + already-generated (teacher forcing — same values
+        # the incremental decode wrote, so the completion stays exact)
+        # and keeps appending to the same token list.
+        full = req.prompt + req.tokens
+        n = len(full)
+        remaining = req.max_new - len(req.tokens)
+        bucket = pow2_bucket(n, L - remaining)
+        # Shared-prefix reuse, capped at n-1: the last prompt token
+        # must run through the model to produce the next-token logits.
+        shared: List[int] = []
+        cow = None
+        matched = 0
+        if self._prefix is not None:
+            shared, cow, matched, key = self._prefix.match(full, n - 1)
+        tail = full[matched:]
+        P = pow2_bucket(len(tail), L)
+        fn = self._prefill_for(P)       # compile OUTSIDE the mutation
+        cfn = self._copy_fn() if cow else None  # window: failing here
+        # leaves the pool untouched and fails only this request.
+        first_own = len(shared)  # COW lands in the first owned block
+        # Blocks this admission must place: the COW copy target plus
+        # every block the prompt tail writes ([matched, n-1]); decode
+        # blocks are allocated lazily at chunk boundaries. The matched
+        # pages (and the COW source) are pinned FIRST: _alloc_pages
+        # reclaims LRU cache pages, and an unpinned just-matched page
+        # (ref 1, cache-only) could be evicted and handed back as a
+        # tail page — one physical page at two logical blocks.
+        pinned = shared + ([cow[0]] if cow is not None else [])
+        for pg in pinned:
+            self._mgr.incref(pg)
+        want_blocks = list(range(first_own, (n - 1) // ps + 1))
+        if bucket // ps > (n - 1) // ps:
+            # Reserve the FIRST decode block too when the pad gap puts
+            # it past the prompt blocks: an admission that cannot place
+            # one decodable token would be preempted (youngest) at the
+            # very next chunk boundary, wasting the whole prefill in an
+            # admit/preempt ping-pong under pool pressure.
+            want_blocks.append(bucket // ps)
+        try:
+            pages = self._alloc_pages(len(want_blocks))
+        except PageAllocError:
+            self._mgr.decref(pinned)  # back to their cache/slot refs
+            raise
+        row = np.full((self.n_blocks,), -1, np.int32)
+        for j, pg in enumerate(shared):
+            row[j] = pg
+        for b, pg in zip(want_blocks, pages):
+            row[b] = pg
+        if not req.tokens:  # fresh admission, not a requeued preempt
+            wait = time.monotonic() - req.t_enqueue
+            self._reg().histogram(
+                "kfx_lm_queue_wait_seconds",
+                "Decode-engine admission wait (enqueue to slot "
+                "prefill).",
+                buckets=QUEUE_WAIT_BUCKETS).observe(wait, model=self.name)
+        tokens = np.zeros((1, P), np.int32)
+        tokens[0, :len(tail)] = tail
         with obs_trace.span("engine.admit", trace_id=req.trace_id,
                             parent_id=req.span_id, model=self.name,
-                            slot=str(slot), bucket=str(req.bucket)):
-            # A compile failure here leaves the carry untouched (only
-            # this request fails, in _admit_ready's net)...
-            fn = self._prefill_for(req.bucket)
+                            slot=str(slot), bucket=str(bucket),
+                            prefix_tokens=str(matched)):
             try:
+                if cow is not None:
+                    self._cache = cfn(self._cache,
+                                      np.int32(row[first_own]),
+                                      np.int32(cow[0]),
+                                      np.int32(cow[1]))
                 self._cache, self._logbuf = fn(
                     self.params, self._cache, self._logbuf, tokens,
-                    np.int32(slot), np.int32(len(req.prompt)))
+                    row[None, :], np.int32(slot), np.int32(len(tail)),
+                    np.int32(matched))
             except BaseException as e:
                 if self._donate:
-                    # ...but a failed DISPATCH may have died after the
+                    # A failed DISPATCH may have died after the
                     # donation, deleting the carried buffers — and with
                     # them every active slot's KV. Fail those requests
                     # honestly and rebuild, or the next decode_chunk
                     # crashes on deleted arrays.
                     self._fail_inflight(e)
+                else:
+                    self._mgr.decref(pinned + pages)
                 raise
-        self._pos[slot] = len(req.prompt)
+        if cow is not None:
+            # The COW source's pin was only for the copy window; the
+            # slot keeps the private clone, not the source.
+            self._mgr.decref([cow[0]])
+        self._tables[slot] = row
+        self._slot_pages[slot] = shared + pages
+        # Register this prompt's pages for future admissions: every
+        # full prompt page not already cached, chained after the
+        # matched prefix, plus the partially-filled boundary page.
+        if self._prefix is not None:
+            # Stats count CLIENT admissions only: a preempt-requeue
+            # re-matches the pages its own first admission registered —
+            # recompute savings, not prompt reuse — and its n includes
+            # generated tokens, which are not "prompt tokens admitted".
+            if not req.tokens:
+                if matched:
+                    self._prefix.hits += 1
+                    self._prefix.tokens_reused += matched
+                    self._reg().counter(
+                        "kfx_lm_prefix_cache_hits_total",
+                        "Admissions that reused cached prefix pages."
+                        ).inc(1, model=self.name)
+                self._prompt_tokens += n
+            # ``key`` covers the matched FULL pages; block len(shared)
+            # (COW'd or fresh) chains from it like any other page.
+            h = key
+            for b in range(len(shared), n // ps):
+                h = self._prefix.insert_full(
+                    h, full[b * ps:(b + 1) * ps], int(row[b]))
+            if n % ps and row[n // ps] >= 0:
+                self._prefix.insert_partial(
+                    h, full[(n // ps) * ps:n], int(row[n // ps]))
+        self._pos[slot] = n
+        self._loc[slot] = bucket
+        self._max_loc[slot] = bucket + remaining - 1
         self._active[slot] = True
-        self._produced[slot] = 0
-        self._rngs[slot] = np.asarray(jax.random.PRNGKey(req.seed),
-                                      np.uint32)
+        self._produced[slot] = len(req.tokens)
+        if req.rng is not None:
+            # Preemption stashed the live per-request stream (one split
+            # per emitted token, so this equals a replay); restoring it
+            # skips O(tokens) sequential split dispatches that would
+            # stall every active slot on re-admission.
+            self._rngs[slot] = req.rng
+        else:
+            self._rngs[slot] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32)
         self._temp[slot] = req.temperature
         self._topk[slot] = req.top_k
         self._stop[slot] = req.stop
         self._max_new[slot] = req.max_new
         self._slots[slot] = req
 
+    def _ensure_chunk_pages(self) -> None:
+        """Allocate, at the chunk boundary, every page the next chunk
+        may write (decode locations loc..loc+k-1, capped at the slot's
+        budget). On pool exhaustion the YOUNGEST active slot is
+        preempted — pages freed, request re-queued at the front as a
+        recompute continuation — so the oldest requests always make
+        progress; a lone slot that still cannot be placed fails with
+        PageAllocError."""
+        while True:
+            try:
+                for slot, req in enumerate(self._slots):
+                    if req is None or not self._active[slot]:
+                        continue
+                    lo = int(self._loc[slot])
+                    hi = min(lo + self.chunk_tokens - 1,
+                             int(self._max_loc[slot]))
+                    for b in range(lo // self.page_size,
+                                   hi // self.page_size + 1):
+                        if self._tables[slot, b] < 0:
+                            pg = self._alloc_pages(1)[0]
+                            self._tables[slot, b] = pg
+                            self._slot_pages[slot].append(pg)
+                return
+            except PageAllocError:
+                victims = [s for s, r in enumerate(self._slots)
+                           if r is not None and self._active[s]]
+                if len(victims) <= 1:
+                    raise
+                self._preempt(max(
+                    victims, key=lambda s: self._slots[s].t_enqueue))
+
+    def _preempt(self, slot: int) -> None:
+        req = self._slots[slot]
+        # Stash the live RNG stream so re-admission resumes it (greedy
+        # ignores it; sampled must not fork from the replayed run).
+        req.rng = np.array(self._rngs[slot], np.uint32)
+        self._slots[slot] = None
+        self._release_slot(slot)
+        self._reg().counter(
+            "kfx_lm_kv_preemptions_total",
+            "Slots preempted (recompute-requeued) on pool exhaustion."
+            ).inc(1, model=self.name)
+        with self._cond:
+            self._queue.appendleft(req)
+
     def _decode_once(self) -> None:
+        self._ensure_chunk_pages()
+        if not self._active_count():
+            return  # every slot preempted away
         oldest = min((r for r in self._slots if r is not None),
                      key=lambda r: r.t_enqueue)
         n_active = self._active_count()
@@ -563,14 +1137,16 @@ class DecodeEngine:
                             slots=str(n_active),
                             k=str(self.chunk_tokens)):
             out = self._decode()(
-                self.params, self._cache, self._logbuf, self._pos,
-                self._active, self._produced, self._rngs, self._temp,
-                self._topk, self._stop, self._max_new)
-        (self._cache, self._logbuf, pos, active, produced, rngs,
+                self.params, self._cache, self._logbuf,
+                np.ascontiguousarray(self._tables), self._pos,
+                self._loc, self._active, self._produced, self._rngs,
+                self._temp, self._topk, self._stop, self._max_new)
+        (self._cache, self._logbuf, pos, loc, active, produced, rngs,
          toks, emits) = out
         # np.array (copy): admission mutates these rows in place, and a
         # bare asarray of a jax output is a read-only view.
         self._pos = np.array(pos)
+        self._loc = np.array(loc)
         self._active = np.array(active)
         self._produced = np.array(produced)
         self._rngs = np.array(rngs)
@@ -588,6 +1164,7 @@ class DecodeEngine:
             emitted += len(hits)
             if not self._active[slot]:
                 self._slots[slot] = None
+                self._release_slot(slot)
                 req._finish()
         if emitted:
             reg.counter("kfx_lm_generated_tokens_total",
@@ -601,10 +1178,16 @@ class DecodeEngine:
                 self._slots[slot] = None
                 req._finish(e)
         self._active[:] = False
+        self._tables[:, :] = -1
+        self._slot_pages = [[] for _ in range(self.n_slots)]
+        self._mgr = BlockManager(self.n_pages, self.page_size)
+        if self._prefix is not None:
+            self._prefix = PrefixCache(self._mgr)
         if not self._stopped:
             # A dispatch that died mid-donation leaves the carried
             # device buffers invalidated — rebuild so the engine keeps
-            # serving the next requests.
+            # serving the next requests (the fresh pool is all-empty,
+            # so no dirty-page invalidation is owed either).
             self._cache = self._init_cache()
             self._logbuf = self._init_logbuf()
         self._touch_gauges()

@@ -130,6 +130,15 @@ class LMPredictor(Predictor):
         self.use_engine = os.environ.get("KFX_LM_ENGINE", "1") != "0"
         self.chunk_tokens = int(
             os.environ.get("KFX_LM_ENGINE_CHUNK", "8"))
+        # Paged-KV knobs: page size in tokens; pool size in pages
+        # (0 = dense-equivalent HBM, n_slots x max_seq_len tokens —
+        # shrink to cap KV HBM and let admission gate on pages);
+        # prefix cache on unless disabled.
+        self.kv_page_size = int(
+            os.environ.get("KFX_LM_KV_PAGE_SIZE", "32"))
+        self.kv_pages = int(os.environ.get("KFX_LM_KV_PAGES", "0"))
+        self.prefix_cache = \
+            os.environ.get("KFX_LM_PREFIX_CACHE", "1") != "0"
         self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
@@ -151,7 +160,10 @@ class LMPredictor(Predictor):
             self._engine = DecodeEngine(
                 cfg, params, n_slots=self.max_batch_size,
                 chunk_tokens=self.chunk_tokens, name=self.name,
-                registry=lambda: self.metrics)
+                registry=lambda: self.metrics,
+                kv_page_size=self.kv_page_size,
+                kv_pages=self.kv_pages or None,
+                prefix_cache=self.prefix_cache)
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".

@@ -513,7 +513,9 @@ class ModelServer:
         out: Dict[str, Dict[str, float]] = {}
         for family, field in (("kfx_lm_queue_depth", "queue_depth"),
                               ("kfx_lm_slot_occupancy", "slot_occupancy"),
-                              ("kfx_lm_slots", "slots")):
+                              ("kfx_lm_slots", "slots"),
+                              ("kfx_lm_kv_pages", "kv_pages"),
+                              ("kfx_lm_kv_pages_free", "kv_pages_free")):
             for labels, value in self.metrics.gauge(family).samples():
                 model = labels.get("model", "")
                 out.setdefault(model, {})[field] = value

@@ -81,6 +81,18 @@ class TestBoxGuard:
         assert report["load_avg_max"] >= 0
         assert {"serving", "end"} <= set(report["box_sections"])
 
+    def test_paged_kv_keys_in_contract(self):
+        """The paged-KV acceptance numbers ride the compact
+        BENCH_CONTRACT line (the truncation-proof artifact); a key
+        dropped from the set would read as "budget cut this section"
+        forever after, so the set is pinned here."""
+        for key in ("lm_engine_prefill_skipped_frac",
+                    "lm_engine_kv_bytes_per_token",
+                    "lm_engine_prefix_tokens_per_s",
+                    "lm_engine_concurrent_tokens_per_s",
+                    "lm_engine_speedup"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_own_descendants_are_not_strays(self):
         # A gang worker tree spawned by THIS process is measurement, not
         # contamination — at any depth (mpi ranks are grandchildren).

@@ -2,7 +2,11 @@
 with the one-shot LMGenerator oracle, iteration-level admission
 (short requests retire past long ones), stop-token early retirement,
 the >=3x concurrent-throughput win, bounded-queueing overload, chaos at
-the engine.admit fault point, and the /metrics + span surfaces."""
+the engine.admit / engine.kv_alloc fault points, the /metrics + span
+surfaces, and the paged-KV layer: block-manager/prefix-cache units,
+page reuse-after-retire exactness, shared-prefix prefill skipping with
+copy-on-write, >=2x admission at a fixed KV HBM budget, and
+preempt-by-recompute on pool exhaustion."""
 
 import json
 import threading
@@ -37,7 +41,10 @@ def engine(tiny_lm):
     from kubeflow_tpu.serving.engine import DecodeEngine
 
     cfg, params = tiny_lm
-    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4, name="lm")
+    # 16-token pages over L=64 -> 4 logical blocks per slot, so the
+    # shared-prefix tests below exercise multi-page prompts.
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4, name="lm",
+                       kv_page_size=16)
     yield eng
     eng.close()
 
@@ -162,6 +169,184 @@ class TestEngineDecode:
             chaos.reset()
 
 
+class TestPagedKV:
+    """The vLLM-style block-managed cache: host bookkeeping units plus
+    engine-level exactness and capacity acceptance."""
+
+    def test_block_manager_refcounts(self):
+        from kubeflow_tpu.serving.engine import (
+            BlockManager, PageAllocError)
+
+        mgr = BlockManager(4, 16)
+        a, b = mgr.alloc(2)
+        assert mgr.n_free == 2 and mgr.ref[a] == 1
+        mgr.incref(a)
+        assert mgr.decref([a]) == []       # still slot-held
+        assert mgr.decref([a]) == [a]      # last ref -> freed + dirty
+        assert a in mgr.dirty and mgr.n_free == 3
+        with pytest.raises(PageAllocError, match="exhausted"):
+            mgr.alloc(4)
+        assert mgr.n_free == 3             # failed alloc took nothing
+
+    def test_prefix_cache_match_insert_evict(self):
+        from kubeflow_tpu.serving.engine import BlockManager, PrefixCache
+
+        mgr = BlockManager(8, 4)
+        pc = PrefixCache(mgr)
+        toks = list(range(11))  # 2 full pages of 4 + partial [8,9,10]
+        pages = mgr.alloc(3)
+        h = pc.insert_full(b"", toks[0:4], pages[0])
+        h = pc.insert_full(h, toks[4:8], pages[1])
+        pc.insert_partial(h, toks[8:11], pages[2])
+        assert mgr.ref[pages[0]] == 2  # slot + cache
+        # Full-chain match, capped at len-1 (the last token always
+        # prefills for its logits).
+        full, cow, matched, _ = pc.match(toks, len(toks) - 1)
+        assert full == pages[:2] and cow == (pages[2], 2) and matched == 10
+        # A diverging second page breaks the chain after page one.
+        full, cow, matched, _ = pc.match(toks[:4] + [99] * 7, 10)
+        assert full == pages[:1] and cow is None and matched == 4
+        # COW matches the partial prefix only as far as it agrees.
+        full, cow, matched, _ = pc.match(toks[:9] + [99, 99], 10)
+        assert full == pages[:2] and cow == (pages[2], 1) and matched == 9
+        # Eviction: pages still slot-held (ref 2) are not reclaimable;
+        # after the slot releases, children must go before parents.
+        assert not pc.evict_one()
+        mgr.decref(pages)                  # slot retires
+        assert pc.evict_one() and pc.evict_one() and pc.evict_one()
+        assert not pc.evict_one()
+        assert mgr.n_free == 8 and len(pc) == 0
+
+    def test_occupancy_is_token_weighted(self, tiny_lm):
+        """kfx_lm_slot_occupancy under paging: active slots scaled by
+        the pool fraction held, NOT the busy-slot count — an engine
+        with 90% of its pages free must not read as full to the
+        autoscaler."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                           name="occ", kv_page_size=16)
+        eng.close()  # loop stopped: safe to fabricate slot state
+        assert eng._occupancy() == 0.0
+        eng._slots[0] = object()
+        eng._slot_pages[0] = [0]           # 1 of 16 pages
+        assert eng._occupancy() == pytest.approx(4 * 1 / 16)
+        eng._slots[1] = object()
+        eng._slot_pages[1] = [1, 2, 3]
+        assert eng._occupancy() == pytest.approx(4 * 4 / 16)
+        # Prefix-shared pages appear in every sharer's list but pin ONE
+        # physical page each — occupancy counts distinct pages, so a
+        # sharing wave can't read "full" while the pool is mostly free.
+        eng._slots[2] = object()
+        eng._slot_pages[2] = [1, 2, 3, 4]   # shares 1-3, owns 4
+        assert eng._occupancy() == pytest.approx(4 * 5 / 16)
+
+    def test_shared_prefix_skips_prefill_exactly(self, tiny_lm, engine):
+        """Admissions sharing a system prompt reuse its cached pages
+        (full pages refcounted read-only, the boundary page via
+        copy-on-write) and the outputs stay byte-identical to the
+        oracle, which never shares anything."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        system = [(7 * i + 3) % 60 for i in range(36)]  # 2.25 pages
+        prompts = [system + [60 + i] for i in range(3)]
+        hits0 = engine._prefix.hits
+        reused0 = engine._prefix.tokens_reused
+        out = engine.generate(prompts, max_new_tokens=8)
+        ref = [gen.generate([p], max_new_tokens=8)[0] for p in prompts]
+        assert out == ref
+        # First admission fills the cache; the other two each reuse 2
+        # full pages + 4 COW'd boundary tokens = 36 of 37 tokens.
+        assert engine._prefix.hits - hits0 >= 2
+        assert engine._prefix.tokens_reused - reused0 >= 2 * 36
+        # Counter surface agrees with the host stats.
+        assert engine._reg().counter(
+            "kfx_lm_prefix_cache_hits_total").value(
+                model="lm") >= engine._prefix.hits
+
+    def test_reuse_after_retire_and_2x_admission(self, tiny_lm):
+        """One small-pool engine drives the three capacity behaviors:
+        (1) a pool of 8x16 tokens (dense-equivalent: TWO 64-token
+        rows) concurrently admits all 8 short requests — >= 2x the
+        dense layout (the acceptance criterion); (2) the pages those
+        waves recycle carry no stale KV into later prompts (byte
+        parity after heavy reuse); (3) when decode outgrows the pool,
+        the youngest slot is preempted and completes by recompute,
+        still byte-identical."""
+        import numpy as np
+
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = DecodeEngine(cfg, params, n_slots=8, chunk_tokens=4,
+                           name="lm", kv_page_size=16, kv_pages=8,
+                           prefix_cache=False)
+        try:
+            dense_equiv = eng.n_pages * eng.page_size // cfg.max_seq_len
+            assert dense_equiv == 2
+            prompts = [[i + 1, i + 2] for i in range(8)]
+            reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            peak, deadline = 0, time.monotonic() + 60
+            while (not all(r.done() for r in reqs)
+                   and time.monotonic() < deadline):
+                peak = max(peak, eng._active_count())
+                time.sleep(0.001)
+            outs = [r.result(60) for r in reqs]
+            assert peak >= 2 * dense_equiv, (
+                f"peak {peak} active slots < 2x dense-equivalent "
+                f"{dense_equiv} at the same KV HBM")
+            assert outs == [gen.generate([p], max_new_tokens=8)[0]
+                            for p in prompts]
+            # (2) every page in the pool has now hosted a request;
+            # recycled pages must not leak old KV into new prompts.
+            outs = eng.generate([[51, 52, 53]] * 4, max_new_tokens=8)
+            assert outs == [gen.generate([[51, 52, 53]],
+                                         max_new_tokens=8)[0]] * 4
+            # (3) 4 requests each growing to 3 pages (12 > 8): the
+            # engine preempts (recompute-requeues) rather than crash,
+            # and the completions still match the oracle.
+            prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
+            outs = eng.generate(prompts, max_new_tokens=40)
+            assert outs == [gen.generate([p], max_new_tokens=40)[0]
+                            for p in prompts]
+            pre = eng._reg().counter(
+                "kfx_lm_kv_preemptions_total").value(model="lm")
+            assert pre >= 1
+        finally:
+            eng.close()
+
+    def test_chaos_kv_alloc_degrades_to_503_contract(self, tiny_lm):
+        """Forced allocation failure on an idle engine fails the
+        request with PageAllocError — an EngineOverloaded, i.e. the
+        503 + Retry-After shed-load path — never a crashed loop; the
+        next request serves normally."""
+        from kubeflow_tpu.serving.engine import (
+            DecodeEngine, EngineOverloaded, PageAllocError)
+
+        cfg, params = tiny_lm
+        eng = DecodeEngine(cfg, params, n_slots=2, chunk_tokens=4,
+                           name="lm", kv_page_size=16)
+        try:
+            eng.warm([8])
+            chaos.install(chaos.parse_spec("engine.kv_alloc:count=1"))
+            req = eng.submit([1, 2, 3], max_new_tokens=4)
+            with pytest.raises(PageAllocError):
+                req.result(30)
+            assert issubclass(PageAllocError, EngineOverloaded)
+            assert chaos.injected_counts().get("engine.kv_alloc") >= 1
+            chaos.reset()
+            assert len(eng.generate([[1, 2, 3]],
+                                    max_new_tokens=4)[0]) == 4
+        finally:
+            chaos.reset()
+            eng.close()
+
+
 class TestEngineThroughput:
     def test_concurrent_throughput_3x(self):
         """Acceptance criterion: 8 concurrent single-prompt requests
@@ -273,7 +458,10 @@ class TestEngineServing:
                           "--require", "kfx_lm_queue_wait_seconds",
                           "--require", "kfx_lm_warm_buckets",
                           "--require", "kfx_lm_tokens_per_second",
-                          "--require", "kfx_lm_engine_chunks_total"])
+                          "--require", "kfx_lm_engine_chunks_total",
+                          "--require", "kfx_lm_kv_pages",
+                          "--require", "kfx_lm_kv_pages_free",
+                          "--require", "kfx_lm_prefix_cache_hits_total"])
         assert rc == 0
         # Windowed rate: positive after traffic (not a stale last-call
         # number), and the queue-wait histogram saw both admissions.
