@@ -38,6 +38,8 @@ CONTRACT_KEYS = (
     "serving_throughput_rps", "serving_batched_p50_ms",
     "serving_batched_p99_ms",
     "lm_mfu", "lm_best_mfu", "lm_long_mfu", "lm_long_tokens_per_s",
+    "lm_step_cv", "lm_best_step_cv", "lm_long_step_cv",
+    "lm_best_config", "lm_long_config",
     "resnet50_mfu", "resnet50_best_mfu", "resnet50_images_per_s",
     "lm_decode_base_tokens_per_s", "lm_decode_b16_tokens_per_s",
     "lm_engine_concurrent_tokens_per_s", "lm_engine_speedup",
@@ -341,34 +343,54 @@ def main() -> int:
         guard.section("lm")
         lm.update(_bench_lm(remat_policy="save_dense"))
     if have_time(300, "lm_long"):
-        # Long-context config: S=2048 rides the pallas flash-attention
+        # Long-context ladder: S=2048 rides the pallas flash-attention
         # kernel (attn_impl="auto" switches at S>=1024 since round 5;
         # measured 1.24x over the XLA dense path at this shape on the
-        # v5e).
-        # save_flash_full remat (round 5): the kernel's (o, lse)
-        # residuals are checkpoint-named and saved — with q/k/v/out/wo —
-        # so the remat backward runs only the flash backward kernels,
-        # never the forward one. Measured 864.6 -> 796.9 ms/step
-        # (+8.5% MFU) over full remat at this shape; the wider rungs of
-        # the save ladder (mlp_wi: +6.4G) exceed the 15.75G chip
-        # (BASELINE.md HBM table).
+        # v5e). Rung 1 is the round-5 incumbent: save_flash_full keeps
+        # the kernel's (o, lse) residuals + q/k/v/out/wo so the remat
+        # backward runs only the flash backward kernels (measured 864.6
+        # -> 796.9 ms/step, +8.5% MFU over full remat). Rung 2 probes
+        # the batch axis: b16 with the minimal flash save set +
+        # chunked CE (loss_chunk keeps the [B,S,vocab] f32 logits from
+        # ever materialising whole — the transient that used to cap
+        # batch) — bigger batch amortises the per-step fixed work; an
+        # HBM overflow just loses the rung, not the section.
         guard.section("lm_long")
-        lm.update(_bench_lm(batch=8, seq_len=2048, n_steps=6,
-                            remat_policy="save_flash_full",
-                            prefix="lm_long_"))
+        lm.update(_bench_lm_ladder("lm_long_", [
+            ("b8/save_flash_full",
+             dict(batch=8, seq_len=2048, n_steps=6,
+                  remat_policy="save_flash_full")),
+            ("b16/save_flash_min/chunked",
+             dict(batch=16, seq_len=2048, n_steps=6,
+                  remat_policy="save_flash_min",
+                  overrides={"loss_chunk": 256})),
+        ], have_time))
     if have_time(300, "lm_best"):
-        # Best-MFU shape (round-4 ladder, recorded in BASELINE.md):
-        # arithmetic intensity rises with d_model, so the chip's ceiling
-        # is probed at d=2048 with layers cut to fit HBM — d2048/L8
-        # (668M params, b16, S=512, save_dense) measured 0.53 MFU vs the
-        # base preset's 0.41-0.42. One notch up in any direction (L12,
-        # b20, b24, S=1024, or no-remat) fails AOT buffer assignment on
-        # the 15.75G chip — this is the measured single-chip ceiling,
-        # not the preset's.
+        # Best-MFU ladder (round-4 discipline, recorded in BASELINE.md):
+        # arithmetic intensity rises with d_model, so the chip's
+        # ceiling is probed at d=2048 with layers cut to fit HBM —
+        # d2048/L8 (668M params, b16, S=512, save_dense) measured 0.53
+        # MFU vs the base preset's 0.41-0.42. Pre-loss_chunk, one notch
+        # up in ANY direction (L12, b20, b24, S=1024, no-remat) failed
+        # AOT buffer assignment on the 15.75G chip; chunked CE frees
+        # the 1G f32 logits transient, so rung 2 re-probes no-remat
+        # (remat recompute is the one overhead MFU's accounting
+        # penalises — eliminating it is pure utilisation) and rung 3
+        # re-probes b20. Failed rungs are recorded, not fatal.
         guard.section("lm_best")
-        lm.update(_bench_lm(preset="large", overrides={"n_layers": 8},
-                            batch=16, seq_len=512, n_steps=8,
-                            remat_policy="save_dense", prefix="lm_best_"))
+        lm.update(_bench_lm_ladder("lm_best_", [
+            ("b16/save_dense",
+             dict(preset="large", overrides={"n_layers": 8}, batch=16,
+                  seq_len=512, n_steps=8, remat_policy="save_dense")),
+            ("b16/noremat/chunked",
+             dict(preset="large",
+                  overrides={"n_layers": 8, "loss_chunk": 512},
+                  batch=16, seq_len=512, n_steps=8, remat=False)),
+            ("b20/noremat/chunked",
+             dict(preset="large",
+                  overrides={"n_layers": 8, "loss_chunk": 512},
+                  batch=20, seq_len=512, n_steps=8, remat=False)),
+        ], have_time))
     if have_time(420, "baseline_configs"):
         guard.section("baseline_configs")
         lm.update(_bench_baseline_configs(
@@ -454,15 +476,18 @@ def main() -> int:
 
 def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
               n_steps: int = 12, prefix: str = "lm_",
-              remat_policy: str = "nothing",
-              overrides: dict = None) -> dict:
+              remat_policy: str = "nothing", remat: bool = True,
+              overrides: dict = None, variance_steps: int = 4) -> dict:
     """Flagship LM measurement on the real TPU: step time, tokens/s, MFU.
 
     The base preset (d=1024, 24 layers, d_ff=4096 — MXU-shaped dims,
     bf16 compute, scan-over-layers, remat) is trained for n_steps with
     back-to-back dispatch and a single host sync, then MFU is computed
     against the chip's published bf16 peak (utils.flops convention: model
-    FLOPs, remat recompute not credited)."""
+    FLOPs, remat recompute not credited). A short per-step SYNCED leg
+    afterwards measures step-time variance (cv = std/mean) — the fused
+    dispatch can't see per-step jitter, and the multichip acceptance
+    criteria require MFU gains to not regress variance."""
     try:
         import numpy as np
 
@@ -474,7 +499,7 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
 
         from kubeflow_tpu.data.lm import LMDataset
 
-        cfg = preset_config(preset, max_seq_len=seq_len, remat=True,
+        cfg = preset_config(preset, max_seq_len=seq_len, remat=remat,
                             remat_policy=remat_policy, **(overrides or {}))
         mesh, plan = make_mesh(1)
         loop = LMTrainLoop(cfg, mesh, plan,
@@ -496,12 +521,23 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
         dt = (time.perf_counter() - t0) / n_steps
         fpt = transformer_train_flops_per_token(cfg, seq_len)
         tok_s = batch * seq_len / dt
+        # Variance leg: per-step sync (the fused leg reports throughput,
+        # this one jitter; the sync overhead is why it is not the MFU
+        # source).
+        times = []
+        for _ in range(max(variance_steps, 0)):
+            tv = time.perf_counter()
+            state, _, _ = loop.train_many(state, [next(it)])
+            times.append(time.perf_counter() - tv)
+        cv = (float(np.std(times) / np.mean(times))
+              if len(times) >= 2 and np.mean(times) > 0 else 0.0)
         out = {
             "model": preset,
             "params_m": round(n_params / 1e6, 1),
             "batch": batch,
             "seq_len": seq_len,
             "step_time_ms": round(dt * 1000, 2),
+            "step_cv": round(cv, 4),
             "tokens_per_s": round(tok_s, 0),
             "flops_per_token": round(fpt, 0),
             "mfu": round(mfu(tok_s, fpt), 4),
@@ -512,6 +548,41 @@ def _bench_lm(preset: str = "base", batch: int = 16, seq_len: int = 512,
         return {prefix + k: v for k, v in out.items()}
     except Exception as e:  # secondary metric must not sink the bench
         return {prefix + "error": str(e)[:200]}
+
+
+def _bench_lm_ladder(prefix: str, candidates, have_time) -> dict:
+    """Run a short ladder of configs for one lm_* section and keep the
+    best-MFU rung's numbers under ``prefix`` (+ ``<prefix>config``
+    naming the winner, and per-rung MFUs for the trajectory). The first
+    rung is the incumbent and always runs; later rungs run only while
+    ``have_time(est, label)`` says so, and a rung that fails to compile
+    or fit HBM is recorded, not fatal — this is how the remat-policy /
+    batch / loss-chunk tuning is MEASURED per hardware instead of
+    hardcoded (BASELINE.md ladder discipline)."""
+    best: dict = {}
+    best_mfu = -1.0
+    rungs: dict = {}
+    for i, (tag, kw) in enumerate(candidates):
+        if i > 0 and not have_time(150, f"{prefix}ladder:{tag}"):
+            break
+        r = _bench_lm(prefix=prefix, **kw)
+        m = r.get(prefix + "mfu")
+        if m is None:
+            rungs[tag] = r.get(prefix + "error", "no mfu")[:80]
+            continue
+        rungs[tag] = m
+        if m > best_mfu:
+            best_mfu, best = m, r
+    if not best:
+        # Every rung failed: surface the first rung's error.
+        tag, kw = candidates[0]
+        return {prefix + "error": str(rungs.get(tag, "ladder empty"))[:200],
+                prefix + "ladder": rungs}
+    winner = max(rungs, key=lambda t: rungs[t]
+                 if isinstance(rungs[t], (int, float)) else -1.0)
+    best[prefix + "config"] = winner
+    best[prefix + "ladder"] = rungs
+    return best
 
 
 def _bench_baseline_configs(deadline: float) -> dict:

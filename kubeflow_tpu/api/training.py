@@ -201,6 +201,15 @@ class TrainingJob(Resource):
                     "containers[0].command/args required (process argv)",
                 )
 
+    def chip_count(self) -> int:
+        """Chips this job's gang reserves in the cluster scheduler's
+        capacity model. Default: one chip per replica process (the
+        process-per-chip emulation). Kinds with a declarative
+        parallelism spec (JAXJob) override this so a job whose workers
+        each drive SEVERAL chips (e.g. tensor x pipeline = 2x4 in one
+        process group) reserves its full footprint as one gang."""
+        return max(self.total_replicas(), 1)
+
     # -- status helpers used by operators ---------------------------------
     def is_finished(self) -> bool:
         return self.has_condition(JOB_SUCCEEDED) or self.has_condition(JOB_FAILED)
@@ -224,6 +233,85 @@ class JAXJob(TrainingJob):
     REPLICA_SPECS_FIELD = "jaxReplicaSpecs"
     VALID_REPLICA_TYPES = ["Worker"]
     CHIEF_PRIORITY = ["Worker"]
+
+    # spec.parallelism: the declarative mesh plan. Integer axis widths
+    # (>=1) plus boolean layout toggles; the chip footprint is the axis
+    # product, spread evenly over the Worker replicas (each worker
+    # process drives chips/replicas devices — the operator injects the
+    # matching virtual-mesh env). Example:
+    #   parallelism: {tensor: 4, pipeline: 2}     # one 8-chip gang
+    PARALLELISM_AXES = ("tensor", "pipeline", "data", "context")
+    PARALLELISM_FLAGS = ("fsdp", "sp")
+    PARALLELISM_INTS = PARALLELISM_AXES + ("microbatches",)
+
+    def parallelism(self) -> Dict[str, Any]:
+        return dict(self.spec.get("parallelism") or {})
+
+    def chip_count(self) -> int:
+        par = self.parallelism()
+        if not par:
+            return super().chip_count()
+        chips = 1
+        for axis in self.PARALLELISM_AXES:
+            try:
+                chips *= max(int(par.get(axis, 1) or 1), 1)
+            except (TypeError, ValueError):
+                pass  # validate() rejects these at the API boundary
+        return max(chips, self.total_replicas(), 1)
+
+    def validate(self) -> None:
+        super().validate()
+        par = self.spec.get("parallelism")
+        if par is None:
+            return
+        path = "spec.parallelism"
+        if not isinstance(par, dict):
+            raise ValidationError(path, "must be a mapping of axis widths")
+        if not par:
+            return  # empty mapping = no plan declared (chip_count agrees)
+        known = set(self.PARALLELISM_INTS) | set(self.PARALLELISM_FLAGS)
+        for key, val in par.items():
+            if key not in known:
+                raise ValidationError(f"{path}.{key}",
+                                      f"unknown key (have {sorted(known)})")
+            if key in self.PARALLELISM_FLAGS:
+                if not isinstance(val, bool):
+                    raise ValidationError(f"{path}.{key}",
+                                          f"{val!r} is not a boolean")
+                continue
+            # bool is an int subclass but `tensor: true` is a YAML typo,
+            # not a 1-way axis — reject it explicitly.
+            if isinstance(val, bool) or not isinstance(val, int):
+                raise ValidationError(f"{path}.{key}",
+                                      f"{val!r} is not an integer")
+            low = 0 if key == "microbatches" else 1
+            if val < low:
+                raise ValidationError(f"{path}.{key}", f"must be >= {low}")
+        if par.get("context", 1) not in (0, 1) and (
+                par.get("sp") or par.get("pipeline", 1) > 1):
+            raise ValidationError(
+                f"{path}.context",
+                "context parallelism composes with tensor/data/fsdp only "
+                "(sp shards the same sequence dim; pipeline runs the "
+                "pipelined loop)")
+        # The RAW axis product, not chip_count() (which maxes with the
+        # replica count and would let product < replicas slip through
+        # validation only to crash every worker's mesh factorisation).
+        # Flags-only specs ({fsdp: true}, no integer axes) declare no
+        # footprint — data parallelism is inferred from the workers and
+        # the check must not fire.
+        if not any(a in par for a in self.PARALLELISM_AXES):
+            return
+        product = 1
+        for axis in self.PARALLELISM_AXES:
+            product *= max(int(par.get(axis, 1) or 1), 1)
+        replicas = max(self.total_replicas(), 1)
+        if product % replicas:
+            raise ValidationError(
+                path,
+                f"axis product {product} must spread evenly over "
+                f"{replicas} Worker replica(s) (chips per worker process "
+                "must be integral)")
 
 
 @register

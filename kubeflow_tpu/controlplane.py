@@ -90,6 +90,14 @@ class ControlPlane:
         # /metrics formats render from this single snapshot path.
         self.metrics = MetricsRegistry()
         self.metrics.add_collector(self._collect_platform_metrics)
+        # Training-loop families (kfx_train_mfu, kfx_train_step_seconds,
+        # kfx_train_examples_per_second) are recorded live into the
+        # process-wide default registry by TrainLoop/LMTrainLoop; bridge
+        # them so an in-process training run (benches, notebooks, tests)
+        # is scrape-able off this plane's /metrics.
+        from .obs.metrics import default_registry
+
+        self.metrics.add_external(default_registry(), prefix="kfx_train_")
         # kfx_spans_recorded_total{component}: /metrics proof that span
         # tracing is flowing in this process.
         self.metrics.add_collector(obs_trace.collect)

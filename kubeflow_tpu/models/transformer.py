@@ -21,6 +21,7 @@ loop can build NamedShardings without flax partitioning metadata plumbing.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
@@ -74,8 +75,12 @@ class TransformerConfig:
     cp: int = 1
     # Attention implementation: "auto" uses the pallas flash kernel
     # (ops/flash_attention.py) on TPU when shapes qualify, else the XLA
-    # dense path; "flash"/"xla" force one. cp>1 always rides ring
-    # attention (its own seq-sharded kernel).
+    # dense path; "flash"/"naive" force one ("xla" is the legacy
+    # spelling of "naive" — the dense O(S^2) reference path, kept as
+    # the numerics oracle); "ring" asserts the sequence axis is sharded
+    # (requires cp>1). cp>1 always rides ring attention regardless (it
+    # is the only seq-sharded kernel), so "ring" is documentation +
+    # validation that the config really is context-parallel.
     attn_impl: str = "auto"
     # The seq-len window where "auto" picks flash. The defaults are a
     # MEASUREMENT, not a law: on this environment's v5e (base preset,
@@ -117,6 +122,15 @@ class TransformerConfig:
     kv_pages: int = 0
 
     def __post_init__(self):
+        if self.attn_impl not in ("auto", "flash", "xla", "naive", "ring"):
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r} (expected 'auto', "
+                "'flash', 'naive'/'xla' or 'ring')")
+        if self.attn_impl == "ring" and self.cp <= 1:
+            raise ValueError(
+                "attn_impl='ring' needs the sequence axis sharded: set "
+                "cp>1 (ring attention rotates K/V over the 'ctx' mesh "
+                "axis; with cp=1 there is no ring)")
         if self.kv_page_size < 0 or self.kv_pages < 0:
             raise ValueError("kv_page_size / kv_pages must be >= 0")
         if self.kv_page_size > 0:
@@ -167,16 +181,41 @@ def flash_window_ok(cfg: "TransformerConfig", seq_len: int) -> bool:
     return cfg.flash_max_seq <= 0 or seq_len < cfg.flash_max_seq
 
 
+# spmd_check hook: when set, Attention calls it as fn(name, array) on
+# its q/k/v projections and pre-projection output so the checker can
+# capture their GSPMD shardings (jax.debug.inspect_array_sharding)
+# without instrumented test doubles. None in normal operation.
+_activation_probe = None
+
+
+def _probe(name: str, x):
+    if _activation_probe is not None:
+        _activation_probe(name, x)
+    return x
+
+
+@contextlib.contextmanager
+def activation_probe(fn):
+    """Scope ``fn(name, array)`` as the attention activation probe
+    (parallel/spmd_check.py's no-accidental-replication assertion)."""
+    global _activation_probe
+    prev = _activation_probe
+    _activation_probe = fn
+    try:
+        yield
+    finally:
+        _activation_probe = prev
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     def _use_flash(self, seq_len: int) -> bool:
         cfg = self.cfg
-        if cfg.attn_impl not in ("auto", "flash", "xla"):
-            raise ValueError(
-                f"unknown attn_impl {cfg.attn_impl!r} "
-                "(expected 'auto', 'flash' or 'xla')")
-        if cfg.attn_impl == "xla":
+        if cfg.attn_impl in ("xla", "naive", "ring"):
+            # "ring" only reaches here when cp<=1, which the config
+            # rejects at construction; the dense fallback keeps a
+            # stale-config trace honest rather than crashing.
             return False
         if cfg.attn_impl == "flash" and cfg.head_dim % 64:
             raise ValueError(
@@ -244,6 +283,9 @@ class Attention(nn.Module):
         q = rope(q, jnp.maximum(positions, 0))
         k = rope(k, jnp.maximum(positions, 0))
         q = q / np.sqrt(cfg.head_dim)
+        _probe("attn_q", q)
+        _probe("attn_k", k)
+        _probe("attn_v", v)
 
         if cfg.decode:
             out = self._decode_attend(q, k, v, positions, block_tables,
@@ -317,6 +359,7 @@ class Attention(nn.Module):
             scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
             probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+        _probe("attn_mix", out)
         return checkpoint_name(
             nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
                             dtype=cfg.dtype, param_dtype=cfg.param_dtype,

@@ -224,6 +224,8 @@ class MetricsRegistry:
         self._lock = threading.RLock()
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: List[Callable[["MetricsRegistry"], None]] = []
+        # Bridged registries: (registry, name prefix). See add_external.
+        self._externals: List[Tuple["MetricsRegistry", str]] = []
 
     # -- instrument factories (get-or-create by name) -----------------------
     def _get(self, cls, name: str, help_: str, **kw) -> _Metric:
@@ -263,12 +265,39 @@ class MetricsRegistry:
             for fn in list(self._collectors):
                 fn(self)
 
+    def add_external(self, registry: "MetricsRegistry",
+                     prefix: str = "") -> None:
+        """Bridge another registry's instruments (optionally filtered by
+        name ``prefix``) into this registry's render/snapshot output.
+
+        This is how a surface that renders ONE registry (the plane's
+        /metrics) exposes families recorded live into the process-wide
+        ``default_registry()`` by in-process components — e.g. the LM
+        train loop's ``kfx_train_mfu`` / ``kfx_train_step_seconds`` —
+        without double-owning the state. Locally-registered names win on
+        collision; the external registry's collectors are NOT run (its
+        bridged families are recorded live by their owners)."""
+        with self._lock:
+            self._externals.append((registry, prefix))
+
+    def _gathered(self) -> List[_Metric]:
+        with self._lock:
+            metrics = dict(self._metrics)
+            externals = list(self._externals)
+        for reg, prefix in externals:
+            with reg._lock:
+                ext = list(reg._metrics.items())
+            for name, m in ext:
+                if prefix and not name.startswith(prefix):
+                    continue
+                metrics.setdefault(name, m)
+        return sorted(metrics.values(), key=lambda m: m.name)
+
     # -- output --------------------------------------------------------------
     def render(self) -> str:
         """Prometheus exposition text for every registered metric."""
         self._collect()
-        with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        metrics = self._gathered()
         return prom_text([(m.name, m.TYPE, m.help, m.samples())
                           for m in metrics])
 
@@ -276,8 +305,7 @@ class MetricsRegistry:
         """JSON-able view of the same state the exposition text shows —
         the single snapshot path both /metrics formats derive from."""
         self._collect()
-        with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        metrics = self._gathered()
         out: Dict[str, Dict] = {}
         for m in metrics:
             if isinstance(m, Histogram):

@@ -196,13 +196,13 @@ class TrainingControllerBase(Controller):
         Queued condition. Without a scheduler (standalone controllers)
         the legacy profile-quota check applies."""
         if self.scheduler is not None:
-            from ..sched import job_priority
+            from ..sched import job_chips, job_priority
 
             # The sched.admit span sits between this job's reconcile
             # and its gang.spawn in the `kfx trace` waterfall.
             with obs_trace.span("sched.admit", kind=self.KIND,
                                 job=job.key,
-                                chips=str(job.total_replicas()),
+                                chips=str(job_chips(job)),
                                 priority=str(job_priority(job))) as sp:
                 admitted, reason, message = self.scheduler.try_admit(job)
                 sp.attrs["admitted"] = "true" if admitted else "false"
@@ -355,10 +355,25 @@ class JAXJobController(TrainingControllerBase):
     KIND = "JAXJob"
     JOB_CLASS = T.JAXJob
 
+    def platform_for(self, job) -> str:
+        if self.worker_platform is not None:
+            return self.worker_platform
+        from ..sched import job_chips
+
+        # Any multi-CHIP footprint (not just multi-replica) needs the
+        # virtual CPU mesh: the emulated TPU is single-chip, so a 2x4
+        # tensor-by-pipeline worker gets its 8 devices from
+        # --xla_force_host_platform_device_count, not the accelerator.
+        return "cpu" if job_chips(job) > 1 else ""
+
     def build_specs(self, job, workdir):
+        import json
+
         members = self._member_layout(job)
         n = len(members)
         platform = self.platform_for(job)
+        par = job.parallelism()
+        chips_per_proc = job.chip_count() // max(n, 1)
         specs = []
         for rtype, idx, rank in members:
             rs = job.replica_specs()[rtype]
@@ -368,6 +383,27 @@ class JAXJobController(TrainingControllerBase):
                 num_processes=n, process_id=rank, rtype=rtype, index=idx,
                 workdir=workdir, platform=platform)
             env.pop(rdv.ENV_COORDINATOR)
+            if par:
+                # The declarative mesh plan travels to the runner as
+                # env (runners/jax_runner.parallelism_from_env); CLI
+                # flags in the manifest's argv still win.
+                env["KFX_PARALLELISM"] = json.dumps(par)
+            if chips_per_proc > 1 and platform == "cpu":
+                # Each worker process drives chip_count/replicas
+                # virtual devices (vmeshenv recipe; must precede the
+                # worker's first jax import, which env guarantees).
+                from ..vmeshenv import virtual_mesh_env
+
+                # (gloo collectives for n>1 already set by jax_env.)
+                env.update(virtual_mesh_env(chips_per_proc))
+            if platform and "tpu" in platform:
+                # Real-TPU workers get the collective-overlap XLA flags
+                # (parallel/overlap.py): bucketed grad all-reduces +
+                # the latency-hiding scheduler, set pre-exec so they
+                # precede the first jax import.
+                from ..parallel.overlap import apply_overlap_env
+
+                apply_overlap_env(env)
             env.update(rs.env())
             specs.append(G.ProcessSpec(
                 replica_type=rtype, index=idx,

@@ -14,6 +14,8 @@ schedule with warmup, MoE load-balance aux loss.
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -23,6 +25,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.metrics import default_registry
 from ..models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -113,6 +116,52 @@ class LMTrainLoop:
         self._state_shardings = None
         self._train_step = None
         self._eval_step = None
+        # Step-time + MFU observability on the process registry (same
+        # contract as training/loop.py's classifier TrainLoop): stdout
+        # lines stay the collector interface, the registry gives
+        # in-process consumers — and the plane's /metrics bridge — the
+        # same numbers scrape-style. MFU uses the utils.flops
+        # convention (model FLOPs, remat recompute not credited)
+        # against the attached chip's published peak, over every chip
+        # in this loop's mesh.
+        obs = default_registry()
+        self._obs_step = obs.histogram(
+            "kfx_train_step_seconds",
+            "Per-optimizer-step wall time (fused dispatches amortised).")
+        self._obs_mfu = obs.gauge(
+            "kfx_train_mfu",
+            "Model FLOPs utilisation of the most recent training "
+            "dispatch (fraction of the mesh's peak bf16 FLOP/s).")
+        # Labels resolved lazily at first record: the pipelined subclass
+        # swaps self.plan after this ctor runs, and the label must name
+        # the REAL plan (pp included).
+        self._obs_labels: Optional[Dict[str, str]] = None
+        self._flops_per_token: Optional[float] = None
+
+    def _record_steps(self, seconds: float, n_steps: int, n_tokens: int,
+                      seq_len: int) -> None:
+        if seconds <= 0 or n_steps <= 0 or n_tokens <= 0:
+            return
+        if self._obs_labels is None:
+            plan, cfg = self.plan, self.cfg
+            self._obs_labels = {
+                "job": os.environ.get("KFX_JOB_NAME", "local"),
+                "config": (f"pp{plan.pp}/dp{plan.dp}/cp{plan.cp}/"
+                           f"tp{plan.tp}"
+                           + ("/fsdp" if plan.fsdp else "")
+                           + f"-d{cfg.d_model}L{cfg.n_layers}"),
+            }
+        self._obs_step.observe(seconds / n_steps, n=n_steps,
+                               **self._obs_labels)
+        from ..utils.flops import (
+            mfu, transformer_train_flops_per_token)
+
+        if self._flops_per_token is None:
+            self._flops_per_token = transformer_train_flops_per_token(
+                self.cfg, seq_len)
+        self._obs_mfu.set(
+            round(mfu(n_tokens / seconds, self._flops_per_token,
+                      n_chips=self.mesh.size), 6), **self._obs_labels)
 
     # -- state --------------------------------------------------------------
     def _init_fn(self, rng):
@@ -185,7 +234,11 @@ class LMTrainLoop:
                 hit = (logits.argmax(-1) == t_c).astype(jnp.float32)
                 return jnp.sum(ce), jnp.sum(hit)
 
-            ce_s, hit_s = jax.checkpoint(chunk)(h_c)
+            # prevent_cse=False: the chunk body lives inside lax.scan,
+            # where CSE across iterations cannot happen anyway — the
+            # guard only blocks optimisations (same tuning as the layer
+            # stack's nn.remat in models/transformer.py).
+            ce_s, hit_s = jax.checkpoint(chunk, prevent_cse=False)(h_c)
             return (carry[0] + ce_s, carry[1] + hit_s), None
 
         init = (jnp.float32(0.0), jnp.float32(0.0))
@@ -263,16 +316,29 @@ class LMTrainLoop:
         tunneled device stalls the pipeline for a full round trip each
         step; here all steps are dispatched back-to-back and only the
         final loss is fetched."""
-        if self._train_step is None:
+        compiled_this_call = self._train_step is None
+        if compiled_this_call:
             self._train_step = self._build_train_step()
         loss = acc = None
+        n_steps = n_tokens = seq_len = 0
+        t0 = time.perf_counter()
         with jax.set_mesh(self.mesh):
             for tokens in batches:
+                seq_len = tokens.shape[1] - 1
+                n_tokens += tokens.shape[0] * seq_len
+                n_steps += 1
                 state, loss, acc = self._train_step(
                     state, self.global_batch(tokens))
             if loss is None:
                 raise ValueError("train_many needs at least one batch")
-        return state, float(loss), float(acc)
+        loss, acc = float(loss), float(acc)  # device sync before timing
+        if not compiled_this_call:
+            # The compile-paying call would poison the step-time
+            # distribution and report a near-zero MFU for a one-off
+            # cost; the steady-state windows are the signal.
+            self._record_steps(time.perf_counter() - t0, n_steps,
+                               n_tokens, seq_len)
+        return state, loss, acc
 
     def evaluate(self, state: LMTrainState, tokens: np.ndarray
                  ) -> Dict[str, float]:

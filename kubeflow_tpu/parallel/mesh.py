@@ -83,7 +83,26 @@ def _install_jax_compat() -> None:
         jax.lax.axis_size = lambda name: _core.axis_frame(name)
 
 
+def _install_partitionable_prng() -> None:
+    """Sharding-invariant PRNG (jax_threefry_partitionable).
+
+    Older jax defaults this OFF, which makes random draws inside jit
+    depend on the output sharding: initialising the SAME model with the
+    SAME seed on meshes with different dp produced different
+    fsdp-sharded params (measured 0.4 max-abs divergence on the tiny
+    config) — which is what actually broke the cross-plan parity tests
+    blamed on "GSPMD reduction order", and would equally break a
+    checkpoint-free plan-resharding comparison. Newer jax already
+    defaults True; forcing it makes init plan-invariant everywhere."""
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:  # a jax without the flag: nothing to do
+        pass
+
+
 _install_jax_compat()
+_install_partitionable_prng()
 
 AXIS_STAGE = "stage"   # pipeline (pp)
 AXIS_DATA = "data"     # batch (dp) + fsdp param shards + experts (ep)

@@ -97,10 +97,29 @@ def _build_loop(variant: str, n_devices: int):
         # devices, stage 0 IS process 0 and stage 1 IS process 1 — the
         # GPipe activation ppermutes cross the process boundary every
         # tick. n_layers=2 / pp=2 -> one layer per stage.
+        from .mesh import JAX_NATIVE_MESH_API
         from .pipeline import PipelinedLMTrainLoop
 
-        tp = 2 if n_devices % 4 == 0 else 1
-        mesh, plan = make_mesh(n_devices, pp=2, tp=tp, fsdp=True)
+        if JAX_NATIVE_MESH_API:
+            tp = 2 if n_devices % 4 == 0 else 1
+            mesh, plan = make_mesh(n_devices, pp=2, tp=tp, fsdp=True)
+        else:
+            # Hybrid manual/auto (dp/tp inside a stage) does not lower
+            # on compat-shimmed jax: go stage-only full-manual on a
+            # 2-device mesh, ONE DEVICE PER PROCESS where the run
+            # spans processes — the stage-boundary ppermutes (the
+            # transfer this variant exists to exercise) still cross
+            # the process boundary.
+            import jax
+
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            if len(per_proc) >= 2:
+                devs = [per_proc[k] for k in sorted(per_proc)][:2]
+            else:
+                devs = jax.devices()[:2]
+            mesh, plan = make_mesh(2, pp=2, devices=devs)
         return PipelinedLMTrainLoop(TransformerConfig(**kw), mesh, plan, hp)
     else:
         raise ValueError(f"unknown variant {variant!r}; have {VARIANTS}")
@@ -246,6 +265,104 @@ def check(variant: str, workdir: str, *, n_processes: int = 2,
     single = run_losses(variant)
     assert_close(single, multi)
     return multi
+
+
+def check_attention_sharding(n_devices: int = 8, tp: int = 2, cp: int = 1,
+                             fsdp: bool = True) -> dict:
+    """Assert the chosen sharding has no accidental replication of the
+    attention activations.
+
+    The Megatron layout promises q/k/v (and the pre-projection mix) are
+    sharded batch-over-"data" AND heads-over-"model" (plus seq-over-
+    "ctx" when context parallel): a broken constraint or rules-table
+    edit that lets GSPMD replicate them multiplies activation HBM by
+    the tp width — the exact failure mode that silently caps batch size
+    on real chips. The check runs the REAL ``Attention`` module (the
+    activation_probe hook captures GSPMD's chosen shardings via
+    jax.debug.inspect_array_sharding) and asserts every captured
+    activation's per-device shard is its global size over
+    dp * tp * cp. Returns {name: {"spec", "shard_fraction"}}.
+
+    Wired into ``__graft_entry__.dryrun_multichip`` and tier-1
+    (tests/test_parallel.py)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import transformer as TR
+    from .mesh import AXIS_CTX, AXIS_DATA, AXIS_MODEL, make_mesh
+
+    mesh, plan = make_mesh(n_devices, tp=tp, cp=cp, fsdp=fsdp)
+    heads = 2 * plan.tp
+    cfg_kw = dict(vocab_size=64, d_model=32, n_heads=heads, head_dim=8,
+                  n_layers=1, d_ff=64, max_seq_len=32)
+    cfg = TR.TransformerConfig(cp=plan.cp, **cfg_kw) if plan.cp > 1 \
+        else TR.TransformerConfig(**cfg_kw)
+    attn = TR.Attention(cfg)
+    B = max(2 * plan.dp * max(plan.cp, 1), 4)
+    S = 32
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.normal(size=(B, S, cfg.d_model)), np.float32)
+    positions = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S))
+    with jax.set_mesh(mesh):
+        # Under the mesh: the cp path's ring shard_map needs an ambient
+        # mesh even at init-trace time.
+        params = attn.init(jax.random.PRNGKey(0), x, positions)["params"]
+
+    embed_axis = AXIS_DATA if fsdp else None
+    qkv_sh = NamedSharding(mesh, P(embed_axis, AXIS_MODEL, None))
+    param_sh = {
+        "query": {"kernel": qkv_sh},
+        "key": {"kernel": qkv_sh},
+        "value": {"kernel": qkv_sh},
+        "out": {"kernel": NamedSharding(
+            mesh, P(AXIS_MODEL, None, embed_axis))},
+    }
+    seq_axis = AXIS_CTX if plan.cp > 1 else None
+    x_sh = NamedSharding(mesh, P(AXIS_DATA, seq_axis, None))
+    pos_sh = NamedSharding(mesh, P(AXIS_DATA, seq_axis))
+
+    captured: dict = {}
+    shapes: dict = {}
+
+    def probe(name, arr):
+        shapes[name] = tuple(arr.shape)
+        jax.debug.inspect_array_sharding(
+            arr, callback=lambda s, n=name: captured.__setitem__(n, s))
+
+    with jax.set_mesh(mesh):
+        gp = jax.device_put(params, param_sh)
+        gx = jax.device_put(x, x_sh)
+        gpos = jax.device_put(positions, pos_sh)
+        with TR.activation_probe(probe):
+            out = jax.jit(
+                lambda p, x, pos: attn.apply({"params": p}, x, pos)
+            )(gp, gx, gpos)
+        jax.block_until_ready(out)
+
+    want_ways = plan.dp * plan.tp * max(plan.cp, 1)
+    report = {}
+    problems = []
+    for name, shape in sorted(shapes.items()):
+        sh = captured.get(name)
+        if sh is None:
+            problems.append(f"{name}: sharding not captured")
+            continue
+        per = int(np.prod(sh.shard_shape(shape)))
+        frac = per / float(np.prod(shape))
+        report[name] = {"spec": str(getattr(sh, "spec", sh)),
+                        "shard_fraction": frac}
+        if frac * want_ways > 1.0 + 1e-6:
+            problems.append(
+                f"{name} {shape}: per-device shard holds {frac:.3f} of "
+                f"the global array — replicated beyond the "
+                f"1/{want_ways} the dp{plan.dp}/tp{plan.tp}/cp{plan.cp} "
+                f"layout promises (spec {report[name]['spec']})")
+    if problems:
+        raise AssertionError(
+            "attention activation replication check failed:\n  "
+            + "\n  ".join(problems))
+    return report
 
 
 def _worker_main(argv=None) -> int:

@@ -61,6 +61,27 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def parallelism_from_env() -> dict:
+    """The declarative JAXJob parallelism spec, operator-injected as the
+    ``KFX_PARALLELISM`` JSON env var (api/training.py validates it at
+    apply time): ``{"tensor": t, "pipeline": p, "data": d, "context": c,
+    "fsdp": bool, "sp": bool, "microbatches": m}`` — every key optional.
+    Runners treat it as flag defaults (explicit CLI flags win), so a
+    manifest can declare its mesh once instead of duplicating it in
+    argv. Returns {} when absent or malformed (a stale env must never
+    kill a worker that was told its plan on the command line)."""
+    import json
+
+    raw = os.environ.get("KFX_PARALLELISM", "")
+    if not raw:
+        return {}
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return {}
+    return d if isinstance(d, dict) else {}
+
+
 def initialize_distributed() -> int:
     """Rendezvous via env. Returns process_id. Must run pre-backend-init."""
     from kubeflow_tpu.runtime.rendezvous import apply_startup_chaos

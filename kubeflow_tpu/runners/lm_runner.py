@@ -16,6 +16,10 @@ import os
 import sys
 import time
 
+# Wall-clock anchor for the runner.init span (covers interpreter +
+# backend startup, same contract as jax_runner).
+_PROC_START = time.time()
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="kfx LM training runner")
@@ -45,9 +49,11 @@ def parse_args(argv=None):
                         "block recompute; needs the linear-in-S saves "
                         "to fit HBM)")
     p.add_argument("--attn-impl", default="auto",
-                   choices=["auto", "flash", "xla"],
+                   choices=["auto", "flash", "naive", "xla", "ring"],
                    help="attention path; 'auto' picks the pallas flash "
-                        "kernel inside --flash-window")
+                        "kernel inside --flash-window; 'naive' (alias "
+                        "'xla') forces the dense oracle; 'ring' asserts "
+                        "the sequence axis is sharded (--cp>1)")
     def flash_window(value: str):
         lo, _, hi = value.partition(":")
         try:
@@ -61,6 +67,13 @@ def parse_args(argv=None):
                         "flash (default: the v5e-measured 2048:4096; "
                         "MAX 0 = unbounded). Re-measure per hardware.")
     p.add_argument("--microbatches", type=int, default=0)
+    p.add_argument("--collective-overlap", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="append the async-collective + latency-hiding-"
+                        "scheduler + combiner-bucket XLA flags before "
+                        "backend init so grad all-reduces overlap the "
+                        "backward (parallel/overlap.py). auto: TPU "
+                        "platforms only; on: force regardless")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-every", type=int, default=200)
@@ -75,29 +88,82 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    from ..obs import trace as obs_trace
     from ..runtime.lifetime import install_parent_watch
 
     install_parent_watch()
-    from .jax_runner import enable_compile_cache, initialize_distributed
+    from .jax_runner import (enable_compile_cache, initialize_distributed,
+                             parallelism_from_env)
 
-    initialize_distributed()
+    # Declarative JAXJob parallelism (operator-injected env) fills flag
+    # defaults; explicit CLI flags win. Value casts are tolerant — the
+    # operator validates at apply, so a malformed value here is stale
+    # hand-set env, and parallelism_from_env's contract is that stale
+    # env never kills a worker that was told its plan on the CLI.
+    par = parallelism_from_env()
 
-    import jax
+    def par_int(key, default):
+        try:
+            return int(par.get(key, default) or default)
+        except (TypeError, ValueError):
+            print(f"warning: ignoring non-integer KFX_PARALLELISM "
+                  f"{key}={par.get(key)!r}", file=sys.stderr)
+            return default
 
-    enable_compile_cache()
+    if par:
+        if not args.tp:
+            args.tp = par_int("tensor", 0)
+        if args.pp <= 1:
+            args.pp = par_int("pipeline", 1)
+        if args.cp <= 1:
+            args.cp = par_int("context", 1)
+        if not args.fsdp:
+            args.fsdp = bool(par.get("fsdp", False))
+        if not args.sp:
+            args.sp = bool(par.get("sp", False))
+        if not args.microbatches:
+            args.microbatches = par_int("microbatches", 0)
 
-    from ..profiling import maybe_start_profiler_server
+    # Collective-overlap XLA flags must land before the first jax
+    # import (the operator injects them into TPU worker env pre-exec;
+    # this covers bare `python -m ...lm_runner` launches). On hosts
+    # whose sitecustomize pre-imports jax (the axon TPU image) the env
+    # write may come too late for this process — say so instead of
+    # silently dropping an explicit "on".
+    if args.collective_overlap != "off":
+        from ..parallel.overlap import apply_overlap_env
 
-    maybe_start_profiler_server()
+        applied = apply_overlap_env(os.environ,
+                                    force=args.collective_overlap == "on")
+        if applied and "jax" in sys.modules:
+            print("warning: --collective-overlap set XLA_FLAGS after "
+                  "jax was already imported; if the backend is already "
+                  "initialised the flags will not take effect — inject "
+                  "them via the job env instead (the JAXJob operator "
+                  "does this for TPU workers)", file=sys.stderr)
 
-    from ..data.lm import get_lm_dataset
-    from ..models.transformer import preset_config
-    from ..parallel.lm_train import LMHyperParams, LMTrainLoop
-    from ..parallel.mesh import make_mesh
-    from ..training import Checkpointer
+    with obs_trace.span("runner.init", ts=_PROC_START) as init_sp:
+        with obs_trace.span("rendezvous.wait") as rdv_sp:
+            rdv_sp.attrs["processes"] = os.environ.get(
+                "KFX_NUM_PROCESSES", "1")
+            initialize_distributed()
 
-    rank = jax.process_index()
-    world = jax.process_count()
+        import jax
+
+        enable_compile_cache()
+
+        from ..profiling import maybe_start_profiler_server
+
+        maybe_start_profiler_server()
+
+        from ..data.lm import get_lm_dataset
+        from ..models.transformer import preset_config
+        from ..parallel.lm_train import LMHyperParams, LMTrainLoop
+        from ..parallel.mesh import make_mesh
+        from ..training import Checkpointer
+
+        rank = jax.process_index()
+        world = jax.process_count()
 
     if args.sp and args.pp > 1:
         print("error: --sp with --pp>1 is not supported "
@@ -130,6 +196,15 @@ def main(argv=None) -> int:
     )
     mesh, plan = make_mesh(tp=args.tp or None, pp=args.pp, cp=args.cp,
                            fsdp=args.fsdp)
+    if par_int("data", 0) and plan.dp != par_int("data", 0):
+        # The declarative spec promised a data-parallel width the device
+        # inventory cannot deliver — fail loudly rather than silently
+        # training on a different global batch layout than declared.
+        print(f"error: parallelism.data={par['data']} but the mesh "
+              f"factorised dp={plan.dp} over {jax.device_count()} "
+              f"device(s) (tp={plan.tp}, pp={plan.pp}, cp={plan.cp})",
+              file=sys.stderr)
+        return 2
     hp = LMHyperParams(learning_rate=args.learning_rate,
                        warmup_steps=args.warmup_steps,
                        total_steps=args.steps, seed=args.seed)
@@ -175,20 +250,72 @@ def main(argv=None) -> int:
     t_last = t_start
     tokens_per_step = args.batch_size * ds.seq_len
     loss = acc = 0.0
+    compile_recorded = False
+    win_start, win_step0 = t_start, start_step
+    last_log_step = start_step
     for step in range(start_step, args.steps):
         if step == args.fail_at_step:
             if ckpt is not None:
                 ckpt.wait()
             print(f"fault_injection_crash step={step}", flush=True)
             os._exit(17)
+        t_dispatch = time.time()
         state, loss, acc = loop.train_step(state, next(it))
-        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+        now = time.time()
+        if not compile_recorded:
+            # First dispatch pays the XLA compile; the spans that follow
+            # measure steady state (same contract as jax_runner).
+            obs_trace.record_span("xla.compile", t_dispatch,
+                                  now - t_dispatch, start_step=str(step),
+                                  model=f"transformer-{args.preset}")
+            compile_recorded = True
+            win_start, win_step0 = now, step + 1
+            t_last = now
+            last_log_step = step + 1
+            # train.collective: the measured serialized cost of one
+            # gradient reduction over the mesh's "data" axis — the
+            # bound collective overlap hides. On the waterfall, compare
+            # (this x steps) against train.window to read the overlap
+            # headroom. Measured on a capped buffer and scaled
+            # linearly; skipped on single-chip meshes.
+            if plan.dp > 1:
+                from ..parallel.overlap import (
+                    grad_allreduce_bytes, measure_collective)
+
+                full = grad_allreduce_bytes(state.params, plan)
+                probe = min(full, 64 * 1024 * 1024)
+                t_coll = time.time()
+                measured = measure_collective(mesh, probe)
+                est = measured * (full / probe) if probe else 0.0
+                obs_trace.record_span(
+                    "train.collective", t_coll, measured,
+                    axis="data", ways=str(plan.dp),
+                    grad_bytes=str(full), probe_bytes=str(probe),
+                    est_step_seconds=f"{est:.6f}")
+                print(f"collective_allreduce axis=data ways={plan.dp} "
+                      f"grad_bytes={full} est_seconds_per_step={est:.6f}",
+                      flush=True)
+                # Re-stamp: the measurement's wall must not pollute the
+                # first steady-state window's step_time.
+                t_last = win_start = time.time()
+        if ((step + 1) % args.log_every == 0 or step + 1 == args.steps) \
+                and step + 1 > last_log_step:
+            # step+1 == last_log_step happens when the log boundary IS
+            # the compile step: the interval is empty (and on dp>1 it
+            # would time measure_collective), so no metric line.
             now = time.time()
-            dt = (now - t_last) / args.log_every
+            dt = (now - t_last) / (step + 1 - last_log_step)
             tps = tokens_per_step / dt if dt > 0 else 0.0
             print(f"step={step + 1} loss={loss:.6f} accuracy={acc:.6f} "
                   f"step_time={dt:.4f} tokens_per_s={tps:.0f}", flush=True)
             t_last = now
+            last_log_step = step + 1
+            if step + 1 > win_step0:
+                obs_trace.record_span(
+                    "train.window", win_start, now - win_start,
+                    start_step=str(win_step0), end_step=str(step + 1),
+                    tokens_per_s=f"{tps:.0f}")
+            win_start, win_step0 = now, step + 1
         if ckpt is not None:
             ckpt.maybe_save(step + 1, state)
 

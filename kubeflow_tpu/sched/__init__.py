@@ -13,11 +13,12 @@ from .scheduler import (
     PREEMPTED_ANNOTATION,
     PRIORITY_ANNOTATION,
     Scheduler,
+    job_chips,
     job_priority,
     slice_capacity,
 )
 
 __all__ = [
-    "Scheduler", "slice_capacity", "job_priority",
+    "Scheduler", "slice_capacity", "job_chips", "job_priority",
     "PREEMPTED_ANNOTATION", "PRIORITY_ANNOTATION",
 ]

@@ -91,6 +91,24 @@ def slice_capacity() -> int:
     return max(os.cpu_count() or 1, DEFAULT_SLICE_CHIPS)
 
 
+def job_chips(job) -> int:
+    """A training job's chip footprint in the capacity model. Kinds
+    with a declarative parallelism spec report it via ``chip_count()``
+    (a 2x4 tensor-by-pipeline JAXJob reserves 8 chips as ONE gang even
+    when a single worker process drives all 8 virtual devices);
+    everything else reserves one chip per replica process."""
+    fn = getattr(job, "chip_count", None)
+    if callable(fn):
+        try:
+            return max(int(fn()), 1)
+        except Exception:
+            pass  # fall through to the replica count
+    try:
+        return max(int(job.total_replicas()), 1)
+    except Exception:
+        return 1
+
+
 def job_priority(job) -> int:
     """A training job's scheduling priority (higher preempts lower):
     ``runPolicy.schedulingPolicy.priority``, else the
@@ -189,7 +207,7 @@ class Scheduler:
             if e is None:
                 e = _Entry(ukey=ukey, kind=job.KIND, name=job.name,
                            namespace=job.namespace,
-                           chips=max(job.total_replicas(), 1),
+                           chips=job_chips(job),
                            priority=job_priority(job), seq=self._seq,
                            enqueued_at=time.time())
                 self._seq += 1
@@ -197,7 +215,7 @@ class Scheduler:
             else:
                 # A re-apply may have resized or re-prioritised the job.
                 if e.state == _QUEUED:
-                    e.chips = max(job.total_replicas(), 1)
+                    e.chips = job_chips(job)
                     e.priority = job_priority(job)
             if e.state == _ADMITTED:
                 return True, "", ""
@@ -309,7 +327,7 @@ class Scheduler:
                 # record that this suspend was ours to undo.
                 e = _Entry(ukey=ukey, kind=job.KIND, name=job.name,
                            namespace=job.namespace,
-                           chips=max(job.total_replicas(), 1),
+                           chips=job_chips(job),
                            priority=job_priority(job), seq=self._seq,
                            enqueued_at=time.time(), preempted=True)
                 self._seq += 1

@@ -169,6 +169,80 @@ spec:
 """)
 
 
+class TestJAXJobParallelism:
+    """spec.parallelism: the declarative mesh plan (ISSUE 8) — chip
+    accounting for the scheduler plus field-path validation."""
+
+    def _job(self, par, replicas=1):
+        job = from_manifest({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "tp-pp"},
+            "spec": {
+                "parallelism": par,
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": replicas,
+                    "template": {"spec": {"containers": [
+                        {"name": "c", "command": ["python", "-c", "0"]}
+                    ]}}}}}})
+        job.validate()  # the admission gate load_manifests/apply runs
+        return job
+
+    def test_chip_count_is_axis_product(self):
+        job = self._job({"tensor": 2, "pipeline": 2, "data": 2})
+        assert job.chip_count() == 8
+        assert job.total_replicas() == 1  # one process drives 8 chips
+        assert job.parallelism()["tensor"] == 2
+
+    def test_chip_count_spreads_over_replicas(self):
+        job = self._job({"tensor": 2, "data": 4}, replicas=2)
+        assert job.chip_count() == 8  # 4 chips per worker process
+
+    def test_no_parallelism_defaults_to_replicas(self):
+        job = self._job(None, replicas=3)
+        job.spec.pop("parallelism")
+        assert job.chip_count() == 3
+        assert self._job({}, replicas=3).chip_count() == 3  # {} = absent
+
+    def test_product_smaller_than_replicas_rejected(self):
+        # chip_count() maxes with the replica count, so the spread
+        # check must test the RAW axis product — {tensor: 2} over 3
+        # workers would otherwise pass validation and crash every
+        # worker's mesh factorisation at startup.
+        with pytest.raises(ValidationError, match="spread evenly"):
+            self._job({"tensor": 2}, replicas=3)
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValidationError, match="parallelism.expert"):
+            self._job({"expert": 2})
+
+    def test_bool_masquerading_as_int_rejected(self):
+        with pytest.raises(ValidationError, match="parallelism.tensor"):
+            self._job({"tensor": True})
+
+    def test_non_integer_rejected(self):
+        with pytest.raises(ValidationError, match="parallelism.pipeline"):
+            self._job({"pipeline": "two"})
+
+    def test_fsdp_must_be_boolean(self):
+        with pytest.raises(ValidationError, match="parallelism.fsdp"):
+            self._job({"fsdp": 1})
+
+    def test_product_must_spread_over_replicas(self):
+        with pytest.raises(ValidationError, match="spread evenly"):
+            self._job({"tensor": 3}, replicas=2)
+
+    def test_context_composes_with_tensor_only(self):
+        with pytest.raises(ValidationError, match="parallelism.context"):
+            self._job({"context": 2, "pipeline": 2})
+        self._job({"context": 2, "tensor": 2})  # valid
+
+    def test_scheduler_chips_helper_uses_chip_count(self):
+        from kubeflow_tpu.sched import job_chips
+
+        assert job_chips(self._job({"tensor": 4, "pipeline": 2})) == 8
+        assert job_chips(self._job(None, replicas=2)) == 2
+
+
 class TestConditions:
     def test_set_preserves_transition_time(self):
         job = JAXJob.from_dict({"metadata": {"name": "j"}})

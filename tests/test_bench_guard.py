@@ -93,6 +93,18 @@ class TestBoxGuard:
                     "lm_engine_speedup"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_lm_mfu_keys_in_contract(self):
+        """The training-MFU acceptance numbers (ISSUE 8: lm_best_mfu >=
+        0.60, lm_long_mfu >= 0.45, no step-time-variance regression)
+        ride the compact BENCH_CONTRACT line; pin every lm_* MFU,
+        variance and ladder-winner key so a dropped one reads as
+        "budget cut this section", never silent coverage loss."""
+        for key in ("lm_mfu", "lm_best_mfu", "lm_long_mfu",
+                    "lm_step_cv", "lm_best_step_cv", "lm_long_step_cv",
+                    "lm_best_config", "lm_long_config",
+                    "lm_long_tokens_per_s"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_own_descendants_are_not_strays(self):
         # A gang worker tree spawned by THIS process is measurement, not
         # contamination — at any depth (mpi ranks are grandchildren).

@@ -264,6 +264,48 @@ class TestApiServerMetrics:
         assert rec and rec["count"] >= 1 and rec["p50_ms"] is not None
         server.cp.store.delete("JAXJob", "scrape-job")
 
+    def test_train_mfu_bridged_and_require_scrapeable(self, server):
+        """kfx_train_mfu{job,config} + kfx_train_step_seconds are
+        recorded live into the process default registry by LMTrainLoop
+        and bridged onto the plane's /metrics (MetricsRegistry
+        add_external), so `scrape_metrics --require kfx_train_mfu` pins
+        the family in CI — the ISSUE-8 satellite contract."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        import scrape_metrics
+
+        from kubeflow_tpu.data.lm import LMDataset
+        from kubeflow_tpu.models.transformer import TransformerConfig
+        from kubeflow_tpu.parallel.lm_train import (
+            LMHyperParams, LMTrainLoop)
+        from kubeflow_tpu.parallel.mesh import make_mesh
+
+        cfg = TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                head_dim=8, n_layers=1, d_ff=32,
+                                max_seq_len=16)
+        mesh, plan = make_mesh(1)
+        loop = LMTrainLoop(cfg, mesh, plan,
+                           LMHyperParams(total_steps=4, warmup_steps=1))
+        state = loop.init_state()
+        ds = LMDataset(vocab_size=64, seq_len=16)
+        it = ds.batches(4)
+        state, _, _ = loop.train_many(state, [next(it)])  # compile call
+        state, _, _ = loop.train_many(state, [next(it)])  # recorded call
+
+        assert scrape_metrics.main(
+            [f"{server.url}/metrics",
+             "--require", "kfx_train_mfu",
+             "--require", "kfx_train_step_seconds"]) == 0
+        with urllib.request.urlopen(f"{server.url}/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert validate_exposition(text) == []
+        assert 'kfx_train_mfu{' in text
+        assert 'job="local"' in text
+        assert 'config="pp1/dp1/cp1/tp1-d16L1"' in text
+        assert "kfx_train_step_seconds_bucket" in text
+
     def test_trace_header_adopted(self, server):
         body = ("apiVersion: kubeflow.org/v1\nkind: Profile\n"
                 "metadata:\n  name: tr-prof\n"

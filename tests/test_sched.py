@@ -446,6 +446,63 @@ class TestSchedulerInPlane:
             assert any(s["name"] == "gang.spawn" for s in spans)
 
 
+class TestParallelismGang:
+    """ISSUE 8 acceptance: a pipeline+tensor JAXJob declared via
+    spec.parallelism is admitted through the scheduler as ONE gang
+    reserving its full chip footprint (a 2x2x2 job takes all 8 chips of
+    the slice even though a single worker process drives them), and the
+    operator delivers the plan + virtual-mesh env to the worker."""
+
+    def test_tensor_pipeline_job_reserves_full_footprint(
+            self, tmp_path, monkeypatch):
+        from kubeflow_tpu.api import training as T
+        from kubeflow_tpu.api.base import from_manifest
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        monkeypatch.setenv("KFX_SLICE_CHIPS", "8")
+        monkeypatch.delenv("KFX_WORKER_PLATFORM", raising=False)
+        worker = [PY, "-c", (
+            "import json, os, re, time\n"
+            "p = json.loads(os.environ['KFX_PARALLELISM'])\n"
+            "assert p == {'tensor': 2, 'pipeline': 2, 'data': 2}, p\n"
+            "m = re.search(r'--xla_force_host_platform_device_count=(\\d+)',"
+            " os.environ.get('XLA_FLAGS', ''))\n"
+            "assert m and m.group(1) == '8', os.environ.get('XLA_FLAGS')\n"
+            "assert os.environ.get('JAX_PLATFORMS') == 'cpu'\n"
+            "time.sleep(1.2)\n"
+            "print('parallelism_env_ok', flush=True)\n")]
+        tp_job = from_manifest({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "tp-pp", "namespace": "default"},
+            "spec": {
+                "parallelism": {"tensor": 2, "pipeline": 2, "data": 2},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 1, "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [
+                        {"name": "main", "command": worker}]}}}}}})
+        with ControlPlane(home=str(tmp_path / "home"),
+                          worker_platform=None) as cp:
+            assert cp.sched.capacity == 8
+            cp.apply([tp_job, _job("tail", replicas=1, command=[
+                PY, "-c", "print('tail done')"])])
+            _wait(lambda: cp.store.get("JAXJob", "tp-pp")
+                  .has_condition(T.JOB_RUNNING), what="tp-pp running")
+            # The 2x2x2 footprint holds ALL 8 chips as one gang: the
+            # 1-chip tail job queues behind it even though only one
+            # PROCESS is running.
+            row = [r for r in cp.sched.snapshot()["running"]
+                   if r["name"] == "tp-pp"]
+            assert row and row[0]["chips"] == 8, row
+            _wait(lambda: cp.store.get("JAXJob", "tail")
+                  .has_condition(T.JOB_QUEUED), what="tail queued")
+            f1 = cp.wait_for_job("JAXJob", "tp-pp", timeout=60)
+            assert f1.has_condition(T.JOB_SUCCEEDED), f1.conditions
+            assert "parallelism_env_ok" in cp.job_logs("JAXJob", "tp-pp")
+            f2 = cp.wait_for_job("JAXJob", "tail", timeout=60)
+            assert f2.has_condition(T.JOB_SUCCEEDED)
+            assert f1.status["startTime"] <= f2.status["startTime"]
+
+
 class TestHPOCapacity:
     def test_trials_queue_instead_of_failing_when_slice_full(
             self, tmp_path, monkeypatch):
