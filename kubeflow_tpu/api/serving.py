@@ -220,6 +220,30 @@ class InferenceService(Resource):
                     raise ValidationError(
                         f"spec.{rev}.device",
                         f"{dev!r} not one of auto/default/cpu")
+                sp = spec.get("speculative")
+                if sp is not None:
+                    if not isinstance(sp, dict):
+                        raise ValidationError(
+                            f"spec.{rev}.speculative",
+                            "must be an object "
+                            "{draftLayers, proposeTokens}")
+                    for field in ("draftLayers", "proposeTokens"):
+                        v = sp.get(field)
+                        if v is None:
+                            continue
+                        # bool subclasses int: `draftLayers: true` must
+                        # be a 400 at apply, not layer count 1 at
+                        # revision startup.
+                        if isinstance(v, bool) or not isinstance(v, int) \
+                                or v < 1:
+                            raise ValidationError(
+                                f"spec.{rev}.speculative.{field}",
+                                "must be an integer >= 1")
+                    en = sp.get("enabled")
+                    if en is not None and not isinstance(en, bool):
+                        raise ValidationError(
+                            f"spec.{rev}.speculative.enabled",
+                            "must be a boolean")
         tr = self.spec.get("transformer")
         if tr is not None and not tr.get("module"):
             raise ValidationError(

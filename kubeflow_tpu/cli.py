@@ -458,8 +458,9 @@ def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
 def _serving_top_rows(isvcs) -> List[List[str]]:
     """Per-revision replica lines for `kfx top`: ready/spawned against
     the autoscaler's desired count and concurrency target, the decode
-    engine's KV-page pool utilization (paged LM revisions; "-" for
-    classifiers), plus the canary traffic split."""
+    engine's KV-page pool utilization and speculative-decode accept
+    rate (paged LM revisions; "-" for classifiers and engines with the
+    draft off), plus the canary traffic split."""
     rows = []
     for isvc in isvcs:
         repl = isvc.status.get("replicas") or {}
@@ -473,12 +474,14 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
             a = auto.get(rev) or {}
             panic = " (panic)" if a.get("panic") else ""
             kv = a.get("kvUtil")
+            acc = a.get("specAcceptRate")
             rows.append([
                 isvc.name, isvc.namespace, rev,
                 f"{int(ready.get(rev) or 0)}/{int(repl.get(rev) or 0)}",
                 f"{a.get('desired', '-')}{panic}",
                 str(a.get("target", "-")),
                 f"{kv * 100:.0f}%" if kv is not None else "-",
+                f"{acc * 100:.0f}%" if acc is not None else "-",
                 f"{pct}%" if rev == "canary" else "-"])
     return rows
 
@@ -488,7 +491,7 @@ def _print_serving_top(rows: List[List[str]]) -> None:
         return
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
-                        "DESIRED", "TARGET", "KV%", "CANARY%"])
+                        "DESIRED", "TARGET", "KV%", "ACC%", "CANARY%"])
 
 
 def _print_rollouts(isvcs) -> int:

@@ -401,7 +401,18 @@ class Attention(nn.Module):
         gathered entries from unallocated blocks read as position -1
         (masked). Page recycling across requests relies on the pool
         owner invalidating freed pages' position ids — see
-        serving/engine.py."""
+        serving/engine.py.
+
+        Multi-token query windows (S > 1 with explicit, per-token
+        ``write_locations``) are first-class, not just a prefill
+        special case: writes land before the gather and the mask is
+        causal BY POSITION (``kp <= qp``), so query i of a window
+        attends the window's own earlier tokens plus the cache — the
+        contract speculative decoding's verify dispatch relies on (the
+        engine feeds the pending token + k draft proposals as one
+        window and reads k+1 next-token distributions back; rejection
+        rolls the cursor back and stamps the tail's position ids to
+        -1, no page copies)."""
         cfg = self.cfg
         B, S, H, D = q.shape
         L = cfg.max_seq_len
@@ -789,6 +800,28 @@ def make_transformer(**kw) -> TransformerLM:
     registry: LMs take int token inputs and run through lm_runner /
     LMTrainLoop, not the image-classifier TrainLoop."""
     return TransformerLM(TransformerConfig(**kw))
+
+
+def truncate_layers(params, n_layers: int):
+    """Layer-truncated parameter view: the first ``n_layers`` of the
+    scanned layer stack, with embed / ln_f / lm_head shared verbatim.
+    This is the serving engine's DRAFT model for speculative decoding
+    (Leviathan et al., ICML'23): a same-tokenizer, same-vocab prefix of
+    the target whose early-exit logits propose tokens the full model
+    verifies. Works because the params are layer-stacked by ``nn.scan``
+    (one leading "layers" axis per leaf) — no per-layer module surgery.
+    The slices are views; callers device_put their own copy."""
+    if "layers" not in params:
+        raise ValueError("params have no scanned 'layers' collection")
+    stacked = jax.tree_util.tree_leaves(params["layers"])
+    depth = stacked[0].shape[0] if stacked else 0
+    if not 1 <= n_layers <= depth:
+        raise ValueError(
+            f"draft n_layers {n_layers} not in [1, {depth}]")
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda x: x[:n_layers], params["layers"])
+    return out
 
 
 # Named size presets (flagship ladder).

@@ -75,7 +75,8 @@ class _Revision:
                  workdir: str, batcher: Optional[dict],
                  device: str = "auto", role: str = "predictor",
                  graph: Optional[dict] = None,
-                 container: Optional[dict] = None):
+                 container: Optional[dict] = None,
+                 speculative: Optional[dict] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -84,6 +85,11 @@ class _Revision:
         self.device = device
         self.role = role
         self.graph = graph or {}
+        # Speculative-decode spec ({draftLayers, proposeTokens,
+        # enabled}, api/serving.py) — exported to the replica as the
+        # KFX_LM_SPEC_* knobs the LMPredictor reads; classifier
+        # frameworks ignore them.
+        self.speculative = speculative
         # KFServing custom-predictor parity: a user-provided container
         # command serves the port instead of a framework server. The
         # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
@@ -93,10 +99,12 @@ class _Revision:
         self.restarts = 0
         self.spawn_error = ""  # last custom-container launch failure
         # Decode-engine queue sampling state (autoscaler load signal),
-        # plus the paged-KV pool totals for `kfx top`'s KV% column.
+        # plus the paged-KV pool totals for `kfx top`'s KV% column and
+        # the speculative accept rate for its ACC% column.
         self.engine_queue = 0.0
         self.engine_kv_pages = 0.0
         self.engine_kv_free = 0.0
+        self.engine_spec_rate: Optional[float] = None
         self.engine_sampled = float("-inf")
         self.engine_absent = False
 
@@ -168,12 +176,28 @@ class _Revision:
         os.makedirs(self.workdir, exist_ok=True)
         env = inject_pythonpath(dict(os.environ))
         self._span_env(env)
+        self._spec_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
                                 stderr=subprocess.STDOUT)
         logf.close()
         self.replicas.append(_Replica(proc=proc, port=port))
+
+    def _spec_env(self, env: dict) -> None:
+        """spec.<rev>.speculative -> the LMPredictor's KFX_LM_SPEC_*
+        env knobs. Only explicit fields are exported (the predictor
+        owns the defaults); ``enabled: false`` exports KFX_LM_SPEC=0 —
+        the manifest-level escape hatch."""
+        sp = self.speculative
+        if sp is None or self.role != "predictor":
+            return
+        if sp.get("enabled") is False:
+            env["KFX_LM_SPEC"] = "0"
+        if sp.get("draftLayers") is not None:
+            env["KFX_LM_SPEC_LAYERS"] = str(int(sp["draftLayers"]))
+        if sp.get("proposeTokens") is not None:
+            env["KFX_LM_SPEC_TOKENS"] = str(int(sp["proposeTokens"]))
 
     def _span_env(self, env: dict) -> None:
         """Point the replica's span log (obs.trace auto-sink) at this
@@ -408,9 +432,11 @@ class InferenceServiceController(Controller):
                     os.path.join(self.home, "storage-cache"))
             batcher = spec.get("batcher")
             device = str(spec.get("device", "auto"))
+            speculative = spec.get("speculative")
             if rev is None or rev.model_dir != model_dir \
                     or rev.device != device or rev.batcher != batcher \
-                    or rev.container != container:
+                    or rev.container != container \
+                    or rev.speculative != speculative:
                 if rev is not None:
                     rev.teardown()
                 rev = _Revision(
@@ -422,6 +448,7 @@ class InferenceServiceController(Controller):
                     batcher=batcher,
                     device=device,
                     container=container,
+                    speculative=speculative,
                 )
                 rt.revisions[rev_name] = rev
                 self.record_event(isvc, "Normal", "RevisionCreated",
@@ -685,6 +712,11 @@ class InferenceServiceController(Controller):
             # occupancy signal the dense slot count used to hide):
             # surfaced in `kfx top`'s per-isvc table.
             status["kvUtil"] = round(kv_util, 3)
+        if rev.engine_spec_rate is not None:
+            # Trailing-window draft acceptance (replica mean) —
+            # `kfx top`'s ACC% column: the live signal for whether
+            # speculative decoding is paying for its draft.
+            status["specAcceptRate"] = round(rev.engine_spec_rate, 3)
         rt.autoscaling_status[rev_name] = status
         return decision.desired
 
@@ -702,6 +734,7 @@ class InferenceServiceController(Controller):
         rev.engine_sampled = now
         total, answered, saw_engine = 0.0, False, False
         kv_pages, kv_free = 0.0, 0.0
+        spec_rates: List[float] = []
         for r in rev.replicas:
             if not r.ready:
                 continue
@@ -718,11 +751,15 @@ class InferenceServiceController(Controller):
                 total += float(row.get("queue_depth", 0.0))
                 kv_pages += float(row.get("kv_pages", 0.0))
                 kv_free += float(row.get("kv_pages_free", 0.0))
+                if "spec_accept_rate" in row:
+                    spec_rates.append(float(row["spec_accept_rate"]))
         if answered and not saw_engine:
             rev.engine_absent = True  # classifier server: stop polling
         rev.engine_queue = total
         rev.engine_kv_pages = kv_pages
         rev.engine_kv_free = kv_free
+        rev.engine_spec_rate = (sum(spec_rates) / len(spec_rates)
+                                if spec_rates else None)
         return total
 
     def _finish_cold_start(self, isvc: InferenceService, rt: _IsvcRuntime,

@@ -48,13 +48,42 @@ request that cannot be placed at all fails with ``PageAllocError``
 (an ``EngineOverloaded``), which the model server answers with
 503 + Retry-After — bounded queueing, never a crash mid-chunk.
 
+Speculative decoding (``draft_layers > 0``, Leviathan et al. ICML'23):
+a layer-truncated DRAFT model (the target's first ``draft_layers``
+layers + shared embed/head — same tokenizer, same vocab) proposes
+``propose_tokens`` tokens per active slot from its OWN page pool (a
+second BlockManager mirroring the target's block geometry), and the
+target scores all proposals + the pending token as ONE multi-token
+verify window per iteration instead of one dispatch per token — the
+weight-streaming-bound small-batch regime reads the full weights once
+per k+1 candidate tokens. One fused compiled step per iteration:
+draft-propose scan -> target verify -> distribution-preserving accept
+-> rejected-tail KV invalidation (cursor rollback + position-id stamp,
+no page copies). Greedy acceptance is the temperature->0 limit of the
+residual-sampling rule (one-hot target probs), so greedy engine output
+stays BYTE-identical to the ``KFX_LM_ENGINE=0`` oracle — the standing
+parity contract — and sampled output preserves the target distribution
+exactly (accept d_i with min(1, p_i(d)/q_i(d)); on rejection sample
+the normalized residual max(p_i - q_i, 0); the bonus token after k
+accepts samples p_{k+1} directly, i.e. the q==0 case of the same
+rule). Draft-pool exhaustion degrades THAT SLOT to non-speculative
+(1 token/iteration through the same verify window) instead of failing
+admission; target-pool pressure keeps the preempt-youngest recompute
+path, which frees BOTH pools' pages.
+
 Observability: ``kfx_lm_kv_pages`` / ``kfx_lm_kv_pages_free`` gauges,
 ``kfx_lm_prefix_cache_hits_total`` counter, token-weighted
 ``kfx_lm_slot_occupancy`` (slot capacity scaled by the pool fraction
 active slots hold, distinct pages — an engine with 90% of its pages
 free reads as mostly idle even with every slot busy), plus the PR-5
-families.
-Chaos points ``engine.admit`` and ``engine.kv_alloc`` (docs/chaos.md).
+families; speculation adds ``kfx_lm_spec_proposed_total`` /
+``kfx_lm_spec_accepted_total`` counters, the trailing-window
+``kfx_lm_spec_accept_rate`` gauge and the per-iteration
+``engine.verify`` span.
+Chaos points ``engine.admit``, ``engine.kv_alloc`` and
+``engine.spec_verify`` (a full-rejection wave: every proposal treated
+as rejected for that iteration — throughput falls to the
+non-speculative floor, correctness untouched; docs/chaos.md).
 
 jax is imported lazily (inside methods): server.py imports this module
 for ``EngineOverloaded`` on its own import path.
@@ -347,7 +376,10 @@ class DecodeEngine:
                  request_timeout_s: float = 50.0,
                  kv_page_size: int = 32,
                  kv_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 draft_layers: int = 0,
+                 propose_tokens: int = 4,
+                 draft_kv_pages: Optional[int] = None):
         import jax
 
         from ..models.generate import decode_config
@@ -357,6 +389,11 @@ class DecodeEngine:
             raise ValueError("n_slots must be >= 1")
         if chunk_tokens < 1:
             raise ValueError("chunk_tokens must be >= 1")
+        if draft_layers < 0:
+            raise ValueError("draft_layers must be >= 0 (0 = no "
+                             "speculative decoding)")
+        if draft_layers > 0 and propose_tokens < 1:
+            raise ValueError("propose_tokens must be >= 1")
         base = decode_config(cfg)
         L = base.max_seq_len
         ps = min(int(kv_page_size), L)
@@ -383,6 +420,14 @@ class DecodeEngine:
         self.name = name
         self.n_slots = n_slots
         self.chunk_tokens = chunk_tokens
+        if draft_layers >= base.n_layers:
+            raise ValueError(
+                f"draft_layers {draft_layers} must be < the target's "
+                f"n_layers {base.n_layers} (a draft as deep as the "
+                "target proposes at the target's cost — no win)")
+        self.spec = draft_layers > 0
+        self.draft_layers = draft_layers
+        self.propose_tokens = propose_tokens
         self.max_queue = max_queue if max_queue is not None else 4 * n_slots
         # Below the router's 60s backend timeout: a queue-starved
         # request fails with a clean engine error, never a router 502.
@@ -407,9 +452,44 @@ class DecodeEngine:
             PrefixCache(self._mgr) if prefix_cache else None
         self._prompt_tokens = 0  # prompt tokens admitted (for skip frac)
 
+        # -- speculative-decode state: a layer-truncated draft sharing
+        # the target's tokenizer/vocab/page geometry, proposing from
+        # its OWN pool so draft KV never competes with target KV for a
+        # page (and a draft shortfall degrades the slot, never the
+        # admission).
+        if self.spec:
+            from ..models.transformer import truncate_layers
+
+            self.draft_n_pages = int(draft_kv_pages) if draft_kv_pages \
+                else self.n_pages
+            if self.draft_n_pages < 1:
+                raise ValueError("draft_kv_pages must be >= 1")
+            self.draft_cfg = dataclasses.replace(
+                self.cfg, n_layers=draft_layers,
+                kv_pages=self.draft_n_pages)
+            self.draft_model = TransformerLM(self.draft_cfg)
+            self.draft_params = jax.device_put(
+                truncate_layers(params, draft_layers))
+            self._draft_mgr = BlockManager(self.draft_n_pages, ps)
+        else:
+            self.draft_n_pages = 0
+            self.draft_model = self.draft_params = None
+            self._draft_mgr = None
+        # Cumulative spec counters (host truth; the registry counters
+        # mirror them) + the trailing accept-rate window. The window
+        # lock covers the deque: the gauge is read from server threads
+        # (on_metrics_attached) while the loop thread appends.
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_degraded = 0
+        self._spec_lock = threading.Lock()
+        self._spec_window: "deque[Tuple[float, int, int]]" = deque()
+
         # -- device state (touched only by the loop thread after start)
         self._cache = self._init_cache()
         self._logbuf = self._init_logbuf()
+        self._draft_cache = self._init_cache(draft=True) if self.spec \
+            else None
         # -- host slot state (numpy mirrors round-tripped per chunk)
         B = n_slots
         self._tables = np.full((B, self.n_blocks), -1, np.int32)
@@ -425,13 +505,27 @@ class DecodeEngine:
         self._stop = np.full((B,), -1, np.int32)
         self._max_new = np.zeros((B,), np.int32)
         self._slots: List[Optional[Request]] = [None] * B
+        # Per-slot speculative state: the slot's draft block-table row
+        # and pages, whether it still speculates (draft-pool shortfall
+        # flips it off for the request's lifetime in this slot), and
+        # the PENDING token — emitted to the client but not yet in
+        # either KV pool; the next verify window writes it first.
+        # -1 = no pending token yet (fresh admission samples one from
+        # the prefill logits).
+        self._draft_tables = np.full((B, self.n_blocks), -1, np.int32)
+        self._draft_slot_pages: List[List[int]] = [[] for _ in range(B)]
+        self._spec_ok = np.zeros((B,), np.bool_)
+        self._pending = np.full((B,), -1, np.int32)
 
         # -- compiled executables (AOT, so a background warm populates
         # the same table the admission path reads — no jit-cache games)
         self._exec_lock = threading.Lock()
         self._prefill_exec: Dict[int, Any] = {}
+        self._draft_prefill_exec: Dict[int, Any] = {}
         self._decode_exec: Any = None
+        self._spec_exec: Any = None
         self._reset_exec: Any = None
+        self._draft_reset_exec: Any = None
         self._copy_exec: Any = None
 
         self._cond = threading.Condition()
@@ -466,6 +560,29 @@ class DecodeEngine:
             else 0
         return {"tokens_reused": reused,
                 "prompt_tokens": self._prompt_tokens}
+
+    def spec_stats(self) -> Dict[str, int]:
+        """Cumulative speculative-decode counters (zeros with the
+        draft off): draft tokens proposed, proposals the target
+        accepted, and slots degraded to non-speculative on draft-pool
+        shortfall. Public surface for per-window deltas (the bench
+        speculative leg computes its accept rate from these)."""
+        return {"proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "degraded": self._spec_degraded}
+
+    def _spec_accept_rate(self, window_s: float = 30.0) -> float:
+        """Accepted/proposed over the trailing window (0 when idle or
+        speculation is off) — a gauge, so a stale burst must decay
+        instead of a last-iteration ratio sticking to /metrics."""
+        now = time.monotonic()
+        with self._spec_lock:
+            while self._spec_window and \
+                    self._spec_window[0][0] < now - window_s:
+                self._spec_window.popleft()
+            prop = sum(p for _, p, _ in self._spec_window)
+            acc = sum(a for _, _, a in self._spec_window)
+        return acc / prop if prop else 0.0
 
     def _occupancy(self) -> float:
         """Token-weighted occupancy: slot capacity (``n_slots``) scaled
@@ -503,6 +620,22 @@ class DecodeEngine:
         reg.counter("kfx_lm_prefix_cache_hits_total",
                     "Admissions that reused cached prefix pages.").inc(
                         0, model=self.name)
+        # Speculative families are seeded iff the engine HAS a draft —
+        # their absence is the signal (the server's JSON engine block
+        # omits spec_accept_rate and `kfx top` renders "-", never a
+        # "0%" indistinguishable from a draft accepting nothing).
+        if self.spec:
+            reg.counter("kfx_lm_spec_proposed_total",
+                        "Draft tokens proposed to the verify dispatch."
+                        ).inc(0, model=self.name)
+            reg.counter("kfx_lm_spec_accepted_total",
+                        "Draft proposals the target model accepted."
+                        ).inc(0, model=self.name)
+            reg.gauge("kfx_lm_spec_accept_rate",
+                      "Draft acceptance rate over the trailing 30s "
+                      "window (0 when idle).").set(
+                          round(self._spec_accept_rate(), 4),
+                          model=self.name)
 
     def _active_count(self) -> int:
         return sum(1 for r in self._slots if r is not None)
@@ -513,23 +646,27 @@ class DecodeEngine:
             return len(self._queue)
 
     # -- cache / compiled functions ------------------------------------------
-    def _init_cache(self):
+    def _init_cache(self, draft: bool = False):
         """Zeros of the paged cache pytree (positions -1 = every page
         empty), built from eval_shape — no compile, no dispatch. The
         pool is batch-independent, so the B used here is irrelevant to
-        the shapes."""
+        the shapes. ``draft=True`` builds the draft model's pool
+        (fewer layers, its own page count, same page geometry)."""
         import jax
         import jax.numpy as jnp
+
+        model = self.draft_model if draft else self.model
+        params = self.draft_params if draft else self.params
 
         def mk(p):
             toks = jnp.zeros((1, 1), jnp.int32)
             pos = jnp.full((1, 1), -1, jnp.int32)
             bt = jnp.full((1, self.n_blocks), -1, jnp.int32)
-            return self.model.apply({"params": p}, toks, positions=pos,
-                                    block_tables=bt,
-                                    mutable=["cache"])[1]["cache"]
+            return model.apply({"params": p}, toks, positions=pos,
+                               block_tables=bt,
+                               mutable=["cache"])[1]["cache"]
 
-        shapes = jax.eval_shape(mk, self.params)
+        shapes = jax.eval_shape(mk, params)
         flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
         leaves = []
         for path, s in flat:
@@ -545,11 +682,12 @@ class DecodeEngine:
 
         return jnp.zeros((self.n_slots, self.cfg.vocab_size), np.float32)
 
-    def _cache_specs(self):
+    def _cache_specs(self, draft: bool = False):
         import jax
 
+        cache = self._draft_cache if draft else self._cache
         return jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._cache)
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), cache)
 
     def _prefill_for(self, P: int):
         """The AOT-compiled prefill executable for prompt-tail bucket P
@@ -693,13 +831,15 @@ class DecodeEngine:
         )
         return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
 
-    def _reset_fn(self):
+    def _reset_fn(self, draft: bool = False):
         """Compiled page invalidation: sets cached position ids to -1
-        for every page selected by a [n_pages] mask (ONE compile; the
-        mask is data). Recycled pages pass through here before reuse,
-        so a new tenant can never attend a previous request's KV."""
+        for every page selected by a [n_pages] mask (ONE compile per
+        pool; the mask is data). Recycled pages pass through here
+        before reuse, so a new tenant can never attend a previous
+        request's KV — in either pool."""
+        attr = "_draft_reset_exec" if draft else "_reset_exec"
         with self._exec_lock:
-            fn = self._reset_exec
+            fn = getattr(self, attr)
         if fn is not None:
             return fn
         import jax
@@ -715,14 +855,15 @@ class DecodeEngine:
                 leaves.append(leaf)
             return jax.tree_util.tree_unflatten(treedef, leaves)
 
+        n = self.draft_n_pages if draft else self.n_pages
         donate = (0,) if self._donate else ()
-        specs = (self._cache_specs(),
-                 jax.ShapeDtypeStruct((self.n_pages,), np.bool_))
+        specs = (self._cache_specs(draft),
+                 jax.ShapeDtypeStruct((n,), np.bool_))
         fn = jax.jit(run, donate_argnums=donate).lower(*specs).compile()
         with self._exec_lock:
-            if self._reset_exec is None:
-                self._reset_exec = fn
-            return self._reset_exec
+            if getattr(self, attr) is None:
+                setattr(self, attr, fn)
+            return getattr(self, attr)
 
     def _copy_fn(self):
         """Compiled copy-on-write: clones page ``src`` into ``dst``
@@ -761,13 +902,324 @@ class DecodeEngine:
                 self._copy_exec = fn
             return self._copy_exec
 
+    def _draft_prefill_for(self, P: int):
+        """The draft-pool prefill executable for FULL-prompt bucket P.
+        The draft shares no prefix cache (its pages die with the slot),
+        so it always prefills the whole prompt — cheap at draft depth,
+        and it keeps the two pools' logical layouts identical."""
+        with self._exec_lock:
+            fn = self._draft_prefill_exec.get(P)
+        if fn is not None:
+            return fn
+        fn = self._build_draft_prefill(P)
+        with self._exec_lock:
+            return self._draft_prefill_exec.setdefault(P, fn)
+
+    def _build_draft_prefill(self, P: int):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.draft_model
+
+        def run(dparams, dcache, tokens, table, true_len):
+            """tokens [1, P] right-padded FULL prompt. Writes the
+            prompt's draft KV through the slot's draft block table; no
+            logits are kept — the propose scan always starts by
+            feeding the pending token, so the draft never samples from
+            its prefill logits."""
+            pos = jnp.arange(P, dtype=jnp.int32)[None, :]
+            pos = jnp.where(pos < true_len, pos, -1)
+            _, vars_ = model.apply(
+                {"params": dparams, "cache": dcache}, tokens,
+                positions=pos, block_tables=table, mutable=["cache"])
+            return vars_["cache"]
+
+        donate = (1,) if self._donate else ()
+        specs = (
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                self.draft_params),
+            self._cache_specs(draft=True),
+            jax.ShapeDtypeStruct((1, P), np.int32),
+            jax.ShapeDtypeStruct((1, self.n_blocks), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+        )
+        return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+
+    def _spec_step(self):
+        with self._exec_lock:
+            fn = self._spec_exec
+        if fn is not None:
+            return fn
+        fn = self._build_spec_step()
+        with self._exec_lock:
+            if self._spec_exec is None:
+                self._spec_exec = fn
+            return self._spec_exec
+
+    def _build_spec_step(self):
+        """ONE fused compiled iteration of speculative decode (one
+        device dispatch per k+1 candidate tokens):
+
+          1. draft-propose: k single-token draft steps from the
+             pending token, sampling with each slot's own knobs/RNG
+             stream and writing draft KV at the dense-equivalent
+             locations;
+          2. verify: the target scores [pending, d_1..d_k] as ONE
+             multi-token window against the paged cache (writes land
+             before the gather; the position-causal mask makes window
+             self-attention exact — models/transformer.py);
+          3. accept: Leviathan residual sampling per slot — accept d_i
+             while U_i < min(1, p_i(d_i)/q_i(d_i)); the first
+             rejection (or the k+1 bonus) samples the normalized
+             residual max(p - q, 0), with q == 0 for the bonus, for
+             non-speculating slots and for capacity-forced
+             boundaries, making plain target sampling the same code
+             path. temperature<=0 turns p into one-hot argmax, so
+             greedy acceptance IS exact-match and the emitted tokens
+             are the target's greedy chain, byte-identical to the
+             oracle;
+          4. rollback: rejected-tail entries (window index > accepted)
+             have their cached position ids stamped -1 in BOTH pools —
+             the same location math as the writes, so every
+             speculative write is either kept or dead, never stale;
+          5. draft catch-up: a masked draft step writes whatever the
+             new cursor's last token is missing from the draft pool so
+             the two pools stay validity-identical.
+
+        Returns (cache, draft_cache, rngs, proposals [B,k],
+        accepted [B], bonus [B])."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import _sample
+
+        model, draft_model = self.model, self.draft_model
+        B, k = self.n_slots, self.propose_tokens
+        V = self.cfg.vocab_size
+
+        def sample_slots(logits, keys, temp, topk):
+            return jax.vmap(
+                lambda l, kk, t, tk: _sample(l[None], kk, t, tk)[0]
+            )(logits, keys, temp, topk)
+
+        def warp(logits, temp, topk):
+            """Per-slot warped next-token probs [B, S, V]: temperature
+            + top-k masking, softmax; temperature<=0 -> one-hot argmax
+            (the greedy limit — what makes greedy acceptance an exact
+            argmax match). Mirrors models/generate._sample exactly."""
+            greedy = jax.nn.one_hot(jnp.argmax(logits, -1), V,
+                                    dtype=jnp.float32)
+            scaled = logits / jnp.maximum(temp, 1e-6)[:, None, None]
+            srt = jnp.sort(scaled, axis=-1)
+            idx = jnp.maximum(V - topk, 0).astype(jnp.int32)
+            kth = jnp.take_along_axis(
+                srt, jnp.broadcast_to(idx[:, None, None],
+                                      scaled.shape[:-1] + (1,)), axis=-1)
+            masked = jnp.where((topk > 0)[:, None, None]
+                               & (scaled < kth), -jnp.inf, scaled)
+            probs = jax.nn.softmax(masked.astype(jnp.float32), -1)
+            return jnp.where((temp <= 0.0)[:, None, None], greedy, probs)
+
+        def invalidate(cache, tables, locs):
+            """Stamp cached position ids -1 at per-slot locations
+            ``locs`` [B, k+1] (-1 = skip) — identical location math to
+            the writes (same table lookup, same clamping), so exactly
+            the entries the window wrote are killed."""
+            P = self.page_size
+            ok = locs >= 0
+            blk = jnp.where(ok, locs // P, 0)
+            page = jnp.take_along_axis(tables, blk, axis=1)
+            pg = jnp.where(ok & (page >= 0), page, -1)
+            sl = jnp.where(ok, locs % P, 0)
+            flat_pg = pg.reshape(-1)
+            flat_sl = sl.reshape(-1)
+            flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+            leaves = []
+            for path, leaf in flat:
+                name = getattr(path[-1], "key", str(path[-1]))
+                if name == "cached_pos":  # [layers, N, P]
+                    n = leaf.shape[1]
+                    tgt = jnp.where(flat_pg >= 0, flat_pg, n)
+                    leaf = leaf.at[:, tgt, flat_sl].set(-1, mode="drop")
+                leaves.append(leaf)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        def run(params, dparams, cache, dcache, tables, dtables,
+                pending, pos, loc, max_loc, spec_on, draft_live,
+                active, rngs, temp, topk):
+            # spec_on: this iteration proposes/accepts for the slot;
+            # draft_live: the slot HOLDS draft pages (spec_on implies
+            # draft_live; a chaos full-rejection wave clears spec_on
+            # only, and the catch-up step below keeps the draft pool's
+            # validity aligned with the target's so the wave costs
+            # throughput, never accept-rate after it ends).
+            steps = jnp.arange(k + 1, dtype=jnp.int32)
+
+            # -- 1. draft propose (k steps; masked for non-spec slots)
+            def dstep(carry, _):
+                dcache, tok, dpos, dloc, rngs = carry
+                split = jax.vmap(jax.random.split)(rngs)
+                next_rngs, sub = split[:, 0], split[:, 1]
+                # Writes are capped at max_loc. Past it the block
+                # index runs off the table — today's jax fills OOB
+                # gathers with INT_MIN so the write already drops, but
+                # under "clip" gather semantics (other jax versions)
+                # it would land on the request's OWN last page and
+                # destroy valid KV. The cap makes correctness
+                # independent of gather OOB behavior; acceptance is
+                # capacity-clamped there anyway.
+                on = active & spec_on & (dloc <= max_loc)
+                feed = jnp.where(active, tok, 0)
+                eff_pos = jnp.where(on, dpos, -1).astype(jnp.int32)
+                eff_loc = jnp.where(on, dloc, -1).astype(jnp.int32)
+                logits, vars_ = draft_model.apply(
+                    {"params": dparams, "cache": dcache}, feed[:, None],
+                    positions=eff_pos[:, None], block_tables=dtables,
+                    write_locations=eff_loc[:, None], mutable=["cache"])
+                lg = logits[:, 0]
+                nxt = sample_slots(lg, sub, temp, topk)
+                return ((vars_["cache"], nxt, dpos + 1, dloc + 1,
+                         next_rngs), (nxt, lg))
+
+            carry = (dcache, pending, pos, loc, rngs)
+            carry, (d_t, q_t) = jax.lax.scan(dstep, carry, None, length=k)
+            dcache, _, _, _, rngs = carry
+            D = d_t.T                      # [B, k]
+            Q = jnp.swapaxes(q_t, 0, 1)    # [B, k, V]
+
+            # -- 2. verify: one k+1-token window through the target
+            win = jnp.concatenate([pending[:, None], D], axis=1)
+            wpos = pos[:, None] + steps[None, :]
+            wloc = loc[:, None] + steps[None, :]
+            # Same max_loc write cap as the draft scan (and the
+            # rollback below reuses the mask, so write and invalidate
+            # always target the same entries). Logits at capped
+            # indices are garbage, but acceptance can't reach them
+            # (`within` below).
+            writable = active[:, None] & (wloc <= max_loc[:, None])
+            feed = jnp.where(active[:, None], win, 0)
+            eff_pos = jnp.where(writable, wpos, -1)
+            eff_loc = jnp.where(writable, wloc, -1)
+            logits, vars_ = model.apply(
+                {"params": params, "cache": cache}, feed,
+                positions=eff_pos, block_tables=tables,
+                write_locations=eff_loc, mutable=["cache"])
+            cache = vars_["cache"]
+
+            # -- 3. accept (rngs: one split for uniforms, one for the
+            # residual/bonus categorical — fixed consumption per
+            # iteration, so the per-slot stream is deterministic)
+            Pw = warp(logits, temp, topk)          # [B, k+1, V]
+            Qw = warp(Q, temp, topk)               # [B, k, V]
+            within = wloc[:, 1:] <= max_loc[:, None]
+            Qpad = jnp.concatenate(
+                [Qw, jnp.zeros_like(Qw[:, :1])], axis=1)
+            # q is zeroed wherever the accept test below is NOT a real
+            # U-vs-p/q draw — non-speculating slots AND capacity-forced
+            # boundaries (`within`): a forced rejection must sample the
+            # plain target at that position (the q==0 path), not the
+            # residual, or the last token of budget-capped sampled
+            # requests would over-represent tokens with p > q.
+            Qpad = jnp.where(
+                spec_on[:, None, None]
+                & jnp.concatenate(
+                    [within, jnp.zeros_like(within[:, :1])],
+                    axis=1)[..., None],
+                Qpad, 0.0)
+            split = jax.vmap(jax.random.split)(rngs)
+            rngs, sub_u = split[:, 0], split[:, 1]
+            U = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(sub_u)
+            pd = jnp.take_along_axis(
+                Pw[:, :k], D[..., None], axis=-1)[..., 0]
+            qd = jnp.take_along_axis(
+                Qpad[:, :k], D[..., None], axis=-1)[..., 0]
+            ratio = pd / jnp.maximum(qd, 1e-30)
+            acc = (U < jnp.minimum(ratio, 1.0)) & spec_on[:, None] \
+                & within & active[:, None]
+            cum = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+            a = jnp.sum(cum, axis=1)               # [B] accepted count
+            p_sel = jnp.take_along_axis(
+                Pw, a[:, None, None], axis=1)[:, 0]
+            q_sel = jnp.take_along_axis(
+                Qpad, a[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(p_sel - q_sel, 0.0)
+            rsum = jnp.sum(resid, -1, keepdims=True)
+            resid = jnp.where(rsum > 0, resid / jnp.maximum(rsum, 1e-30),
+                              p_sel)
+            split = jax.vmap(jax.random.split)(rngs)
+            rngs, sub_b = split[:, 0], split[:, 1]
+            bonus = jax.vmap(
+                lambda kk, rr: jax.random.categorical(kk, jnp.log(rr))
+            )(sub_b, resid).astype(jnp.int32)
+
+            # -- 4. rollback: kill every window entry past the accept
+            # point in both pools (the draft wrote indices 0..k-1)
+            past = steps[None, :] > a[:, None]
+            t_locs = jnp.where(writable & past, wloc, -1)
+            d_locs = jnp.where(writable & past
+                               & (steps[None, :] < k)
+                               & spec_on[:, None], wloc, -1)
+            cache = invalidate(cache, tables, t_locs)
+            dcache = invalidate(dcache, dtables, d_locs)
+
+            # -- 5. draft catch-up: the draft pool must stay valid
+            # through the new cursor's last token (window index a) —
+            # the propose scan wrote indices 0..k-1 when it ran, so
+            # the gap is index k after a k-for-k sweep, or index a==0
+            # (the pending token) when the scan was masked off (chaos
+            # wave). One masked step writes it; its logits are unused.
+            on = active & draft_live & ((a == k) | ~spec_on)
+            last = jnp.take_along_axis(win, a[:, None], axis=1)[:, 0]
+            eff_pos = jnp.where(on, pos + a, -1).astype(jnp.int32)
+            eff_loc = jnp.where(on, loc + a, -1).astype(jnp.int32)
+            _, vars_ = draft_model.apply(
+                {"params": dparams, "cache": dcache},
+                jnp.where(active, last, 0)[:, None],
+                positions=eff_pos[:, None], block_tables=dtables,
+                write_locations=eff_loc[:, None], mutable=["cache"])
+            dcache = vars_["cache"]
+            return cache, dcache, rngs, D, a, bonus
+
+        donate = (2, 3) if self._donate else ()
+        sds = jax.ShapeDtypeStruct
+        specs = (
+            jax.tree_util.tree_map(lambda x: sds(x.shape, x.dtype),
+                                   self.params),
+            jax.tree_util.tree_map(lambda x: sds(x.shape, x.dtype),
+                                   self.draft_params),
+            self._cache_specs(),
+            self._cache_specs(draft=True),
+            sds((B, self.n_blocks), np.int32),  # target block tables
+            sds((B, self.n_blocks), np.int32),  # draft block tables
+            sds((B,), np.int32),      # pending token
+            sds((B,), np.int32),      # pos
+            sds((B,), np.int32),      # loc
+            sds((B,), np.int32),      # max_loc
+            sds((B,), np.bool_),      # spec_on
+            sds((B,), np.bool_),      # draft_live
+            sds((B,), np.bool_),      # active
+            sds((B, 2), np.uint32),   # rngs
+            sds((B,), np.float32),    # temp
+            sds((B,), np.int32),      # topk
+        )
+        return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+
     def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
-        """Compile the decode chunk and the prefill for ``buckets``
-        (default: every configured prompt bucket). Returns the number
-        of compiled executables now available. Safe to call from a
-        background thread: it only populates the AOT tables, never the
-        live slot state."""
-        self._decode()
+        """Compile the hot step (the decode chunk, or the fused
+        speculative step when the draft is on) and the prefill(s) for
+        ``buckets`` (default: every configured prompt bucket). Returns
+        the number of compiled executables now available. Safe to call
+        from a background thread: it only populates the AOT tables,
+        never the live slot state."""
+        if self.spec:
+            # Spec engines never dispatch decode_chunk — every slot
+            # (speculating or degraded) advances through the fused
+            # verify step — so its compile is skipped entirely.
+            self._spec_step()
+            self._reset_fn(draft=True)
+        else:
+            self._decode()
         # The cold helpers too: the page-invalidate runs on the first
         # page reuse and the COW copy on the first partial prefix hit —
         # both would otherwise pay their one-time compile inside a
@@ -777,8 +1229,11 @@ class DecodeEngine:
             self._copy_fn()
         for b in buckets if buckets is not None else self.prompt_buckets:
             self._prefill_for(int(b))
+            if self.spec:
+                self._draft_prefill_for(int(b))
         with self._exec_lock:
-            return len(self._prefill_exec) + 1
+            return (len(self._prefill_exec)
+                    + len(self._draft_prefill_exec) + 1)
 
     # -- submission ----------------------------------------------------------
     def _make_request(self, prompt: Sequence[int], max_new_tokens: int,
@@ -871,14 +1326,38 @@ class DecodeEngine:
             self._mgr.dirty.clear()
         return pages
 
+    def _alloc_draft_pages(self, n: int) -> List[int]:
+        """Take ``n`` pages from the DRAFT pool, invalidating recycled
+        pages' position ids first. No prefix cache to reclaim from and
+        no chaos point: a draft shortfall is not a failure — the
+        caller degrades the slot to non-speculative decode."""
+        pages = self._draft_mgr.alloc(n)
+        if self._draft_mgr.dirty:
+            mask = np.zeros((self.draft_n_pages,), np.bool_)
+            mask[list(self._draft_mgr.dirty)] = True
+            self._draft_cache = self._reset_fn(draft=True)(
+                self._draft_cache, mask)
+            self._draft_mgr.dirty.clear()
+        return pages
+
     def _release_slot(self, slot: int) -> None:
         """Return a slot's page references to the pool (pages still
         pinned by the prefix cache or other slots survive; the rest go
-        back to the free list and will be invalidated before reuse)."""
+        back to the free list and will be invalidated before reuse).
+        Draft pages are slot-private, so they always free whole."""
         self._mgr.decref(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._tables[slot, :] = -1
         self._active[slot] = False
+        self._release_draft(slot)
+        self._pending[slot] = -1
+
+    def _release_draft(self, slot: int) -> None:
+        if self._draft_mgr is not None and self._draft_slot_pages[slot]:
+            self._draft_mgr.decref(self._draft_slot_pages[slot])
+        self._draft_slot_pages[slot] = []
+        self._draft_tables[slot, :] = -1
+        self._spec_ok[slot] = False
 
     # -- the decode loop -----------------------------------------------------
     def _loop(self) -> None:
@@ -1078,7 +1557,55 @@ class DecodeEngine:
         self._topk[slot] = req.top_k
         self._stop[slot] = req.stop
         self._max_new[slot] = req.max_new
+        self._pending[slot] = -1  # next iteration samples from logbuf
         self._slots[slot] = req
+        if self.spec:
+            self._admit_draft(req, slot, full, n)
+
+    def _admit_draft(self, req: Request, slot: int, full: List[int],
+                     n: int) -> None:
+        """Prefill the FULL prompt into the slot's draft pages. Any
+        failure — draft-pool exhaustion or a broken dispatch — degrades
+        this slot to non-speculative decode (it still completes through
+        the verify window at one token per iteration) instead of
+        failing an admission the TARGET pool already accepted."""
+        from ..models.generate import pow2_bucket
+
+        ps, L = self.page_size, self.cfg.max_seq_len
+        try:
+            Pf = pow2_bucket(n, L)
+            fn = self._draft_prefill_for(Pf)  # compile outside mutation
+            pages = self._alloc_draft_pages((n - 1) // ps + 1)
+        except PageAllocError:
+            self._spec_degraded += 1
+            self._spec_ok[slot] = False
+            return
+        row = np.full((self.n_blocks,), -1, np.int32)
+        for b, pg in enumerate(pages):
+            row[b] = pg
+        tokens = np.zeros((1, Pf), np.int32)
+        tokens[0, :n] = full
+        try:
+            self._draft_cache = fn(self.draft_params, self._draft_cache,
+                                   tokens, row[None, :], np.int32(n))
+        except BaseException:
+            if self._donate:
+                # The donated draft cache may be dead — every slot's
+                # draft KV with it. Rebuild and degrade them all; the
+                # TARGET pool is untouched, so decode stays correct.
+                for s in range(self.n_slots):
+                    self._release_draft(s)
+                self._draft_mgr = BlockManager(self.draft_n_pages,
+                                               self.page_size)
+                self._draft_cache = self._init_cache(draft=True)
+            else:
+                self._draft_mgr.decref(pages)
+            self._spec_degraded += self.n_slots if self._donate else 1
+            self._spec_ok[slot] = False
+            return
+        self._draft_tables[slot] = row
+        self._draft_slot_pages[slot] = pages
+        self._spec_ok[slot] = True
 
     def _ensure_chunk_pages(self) -> None:
         """Allocate, at the chunk boundary, every page the next chunk
@@ -1125,7 +1652,219 @@ class DecodeEngine:
         with self._cond:
             self._queue.appendleft(req)
 
+    def _ensure_spec_pages(self) -> None:
+        """Spec-mode page budget for the next verify window, at the
+        iteration boundary: a speculating slot writes target locations
+        loc..loc+k (pending + k proposals) and the same span in the
+        draft pool (k proposals + the catch-up token); a degraded slot
+        only ever writes the pending token at loc. Target-pool
+        exhaustion preempts the youngest slot (both pools freed, PR-7
+        semantics); DRAFT-pool exhaustion just degrades the slot —
+        speculation is an optimization, never a capacity constraint."""
+        while True:
+            try:
+                for slot, req in enumerate(self._slots):
+                    if req is None or not self._active[slot]:
+                        continue
+                    lo = int(self._loc[slot])
+                    hi = lo
+                    if self._spec_ok[slot]:
+                        hi = min(lo + self.propose_tokens,
+                                 int(self._max_loc[slot]))
+                    for b in range(lo // self.page_size,
+                                   hi // self.page_size + 1):
+                        if self._tables[slot, b] < 0:
+                            pg = self._alloc_pages(1)[0]
+                            self._tables[slot, b] = pg
+                            self._slot_pages[slot].append(pg)
+                break
+            except PageAllocError:
+                victims = [s for s, r in enumerate(self._slots)
+                           if r is not None and self._active[s]]
+                if len(victims) <= 1:
+                    raise
+                self._preempt(max(
+                    victims, key=lambda s: self._slots[s].t_enqueue))
+        for slot, req in enumerate(self._slots):
+            if req is None or not self._active[slot] \
+                    or not self._spec_ok[slot]:
+                continue
+            lo = int(self._loc[slot])
+            hi = min(lo + self.propose_tokens, int(self._max_loc[slot]))
+            try:
+                for b in range(lo // self.page_size,
+                               hi // self.page_size + 1):
+                    if self._draft_tables[slot, b] < 0:
+                        pg = self._alloc_draft_pages(1)[0]
+                        self._draft_tables[slot, b] = pg
+                        self._draft_slot_pages[slot].append(pg)
+            except PageAllocError:
+                self._release_draft(slot)
+                self._spec_degraded += 1
+
+    def _sample_host(self, logits: np.ndarray, req: Request,
+                     rng: np.ndarray) -> Tuple[int, np.ndarray]:
+        """One host-side sample from a [V] logits row with the
+        request's knobs, mirroring models/generate._sample semantics:
+        greedy is argmax (same first-max tie-break as jnp.argmax, so
+        parity holds bitwise); sampled draws inverse-CDF from the
+        warped distribution with a uniform from the slot's jax PRNG
+        stream (deterministic per seed). Returns (token, next_rng)."""
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits)), rng
+        import jax
+
+        nxt, sub = jax.random.split(jax.numpy.asarray(rng))
+        u = float(jax.random.uniform(sub))
+        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
+        if req.top_k > 0:
+            kth = np.sort(scaled)[max(logits.shape[-1] - req.top_k, 0)]
+            scaled = np.where(scaled < kth, -np.inf, scaled)
+        probs = np.exp(scaled - np.max(scaled))
+        probs /= probs.sum()
+        tok = int(np.searchsorted(np.cumsum(probs), u))
+        return min(tok, logits.shape[-1] - 1), np.asarray(nxt, np.uint32)
+
+    def _emit_host(self, slot: int, toks: List[int]) -> int:
+        """Append emitted tokens to the slot's request, honoring the
+        stop-token and max_new contracts exactly as the chunked path
+        does (the stop token itself is never emitted; the slot retires
+        at the first hit or when the budget fills). Returns how many
+        tokens actually landed in the KV-valid prefix (the cursor
+        advance); retires the slot itself when done."""
+        req = self._slots[slot]
+        landed = 0
+        done = False
+        for t in toks:
+            if req.stop >= 0 and t == req.stop:
+                done = True
+                break
+            req.tokens.append(int(t))
+            landed += 1
+            if len(req.tokens) >= req.max_new:
+                done = True
+                break
+        if done:
+            self._slots[slot] = None
+            self._release_slot(slot)
+            req._finish()
+        return landed
+
+    def _spec_once(self) -> None:
+        """One speculative iteration: host-sample pending tokens for
+        fresh admissions, budget the window's pages, dispatch the
+        fused propose+verify+accept step, then apply the accept
+        verdicts to the per-slot bookkeeping."""
+        import jax
+
+        # Fresh admissions (and requeued preempts) have no pending
+        # token: sample it from the prefill logits — the same token
+        # the chunked path's first decode step would produce.
+        fresh = [s for s, r in enumerate(self._slots)
+                 if r is not None and self._pending[s] < 0]
+        if fresh:
+            logbuf = np.asarray(self._logbuf)
+            emitted0 = 0
+            for s in fresh:
+                req = self._slots[s]
+                tok, self._rngs[s] = self._sample_host(
+                    logbuf[s], req, self._rngs[s])
+                emitted0 += self._emit_host(s, [tok])
+                if self._slots[s] is not None:
+                    self._pending[s] = tok
+            if emitted0:
+                self._reg().counter(
+                    "kfx_lm_generated_tokens_total",
+                    "Tokens generated since startup.").inc(
+                        emitted0, model=self.name)
+        if not self._active_count():
+            self._touch_gauges()
+            return
+        # Chaos: a full-rejection wave — every slot verifies as if its
+        # draft proposed garbage. Throughput falls to the
+        # non-speculative floor; outputs stay exact (the bonus token
+        # is the target's own sample either way).
+        wave_off = False
+        inj = chaos.draw("engine.spec_verify", target=self.name)
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                wave_off = True
+        self._ensure_spec_pages()
+        if not self._active_count():
+            self._touch_gauges()
+            return
+        k = self.propose_tokens
+        draft_live = self._spec_ok & self._active
+        spec_on = np.zeros_like(draft_live) if wave_off else draft_live
+        oldest = min((r for r in self._slots if r is not None),
+                     key=lambda r: r.t_enqueue)
+        n_active = self._active_count()
+        with obs_trace.span("engine.verify", trace_id=oldest.trace_id,
+                            parent_id=oldest.span_id, model=self.name,
+                            slots=str(n_active), k=str(k)) as sp:
+            out = self._spec_step()(
+                self.params, self.draft_params, self._cache,
+                self._draft_cache, np.ascontiguousarray(self._tables),
+                np.ascontiguousarray(self._draft_tables),
+                self._pending, self._pos, self._loc, self._max_loc,
+                spec_on, draft_live, self._active, self._rngs,
+                self._temp, self._topk)
+            (self._cache, self._draft_cache, rngs, D, A, bonus) = out
+            D = np.asarray(D)          # [B, k]
+            A = np.asarray(A)          # [B]
+            bonus = np.asarray(bonus)  # [B]
+            self._rngs = np.array(rngs)
+            sp.attrs["accepted"] = str(int(
+                sum(int(A[s]) for s in range(self.n_slots)
+                    if spec_on[s])))
+        reg = self._reg()
+        # The verify window IS spec mode's decode-chunk dispatch: one
+        # family for "hot decode dispatches" in both engine modes.
+        reg.counter("kfx_lm_engine_chunks_total",
+                    "Decode-chunk / verify dispatches.").inc(
+                        1, model=self.name)
+        proposed = int(np.sum(spec_on))
+        accepted = 0
+        emitted = 0
+        for slot in range(self.n_slots):
+            req = self._slots[slot]
+            if req is None or not self._active[slot]:
+                continue
+            a = int(A[slot])
+            if spec_on[slot]:
+                accepted += a
+            toks = [int(t) for t in D[slot, :a]] + [int(bonus[slot])]
+            landed = self._emit_host(slot, toks)
+            emitted += landed
+            if self._slots[slot] is not None:
+                # Cursor advance = pending + accepted proposals now in
+                # both pools; the bonus becomes the new pending token.
+                self._pos[slot] += a + 1
+                self._loc[slot] += a + 1
+                self._pending[slot] = int(bonus[slot])
+        if proposed:
+            self._spec_proposed += proposed * k
+            self._spec_accepted += accepted
+            with self._spec_lock:
+                self._spec_window.append(
+                    (time.monotonic(), proposed * k, accepted))
+            reg.counter("kfx_lm_spec_proposed_total",
+                        "Draft tokens proposed to the verify dispatch."
+                        ).inc(proposed * k, model=self.name)
+            reg.counter("kfx_lm_spec_accepted_total",
+                        "Draft proposals the target model accepted."
+                        ).inc(accepted, model=self.name)
+        if emitted:
+            reg.counter("kfx_lm_generated_tokens_total",
+                        "Tokens generated since startup.").inc(
+                            emitted, model=self.name)
+        self._touch_gauges()
+
     def _decode_once(self) -> None:
+        if self.spec:
+            return self._spec_once()
         self._ensure_chunk_pages()
         if not self._active_count():
             return  # every slot preempted away
@@ -1183,6 +1922,13 @@ class DecodeEngine:
         self._mgr = BlockManager(self.n_pages, self.page_size)
         if self._prefix is not None:
             self._prefix = PrefixCache(self._mgr)
+        self._draft_tables[:, :] = -1
+        self._draft_slot_pages = [[] for _ in range(self.n_slots)]
+        self._spec_ok[:] = False
+        self._pending[:] = -1
+        if self.spec:
+            self._draft_mgr = BlockManager(self.draft_n_pages,
+                                           self.page_size)
         if not self._stopped:
             # A dispatch that died mid-donation leaves the carried
             # device buffers invalidated — rebuild so the engine keeps
@@ -1190,6 +1936,8 @@ class DecodeEngine:
             # so no dirty-page invalidation is owed either).
             self._cache = self._init_cache()
             self._logbuf = self._init_logbuf()
+            if self.spec:
+                self._draft_cache = self._init_cache(draft=True)
         self._touch_gauges()
 
     # -- lifecycle -----------------------------------------------------------

@@ -14,7 +14,10 @@ Two decode backends share the same model and the same HTTP contract:
   * the continuous-batching DecodeEngine (serving/engine.py, default) —
     each prompt becomes its own slotted request, admitted mid-flight
     between decode chunks, so concurrent traffic batches on-device and
-    short requests retire past long ones;
+    short requests retire past long ones; speculative decoding rides on
+    top by default (a layer-truncated draft proposes, the target
+    verifies multi-token windows — ``KFX_LM_SPEC*`` knobs below,
+    ``KFX_LM_SPEC=0`` to disable, docs/serving.md for sizing);
   * the one-shot LMGenerator (models/generate.py, ``KFX_LM_ENGINE=0``)
     — run-to-completion; kept as the greedy-parity oracle and escape
     hatch (it does not support ``stop_token``).
@@ -139,6 +142,16 @@ class LMPredictor(Predictor):
         self.kv_pages = int(os.environ.get("KFX_LM_KV_PAGES", "0"))
         self.prefix_cache = \
             os.environ.get("KFX_LM_PREFIX_CACHE", "1") != "0"
+        # Speculative decoding (docs/serving.md): on by default — the
+        # engine falls back per slot when the draft can't help, and
+        # greedy output is byte-identical either way. KFX_LM_SPEC=0 is
+        # the escape hatch; layers 0 = auto (n_layers // 4, >= 1);
+        # tokens = proposals per verify window; pages 0 = same count
+        # as the target pool.
+        self.spec = os.environ.get("KFX_LM_SPEC", "1") != "0"
+        self.spec_layers = int(os.environ.get("KFX_LM_SPEC_LAYERS", "0"))
+        self.spec_tokens = int(os.environ.get("KFX_LM_SPEC_TOKENS", "4"))
+        self.spec_pages = int(os.environ.get("KFX_LM_SPEC_PAGES", "0"))
         self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
@@ -154,6 +167,14 @@ class LMPredictor(Predictor):
         if self.use_engine:
             from .engine import DecodeEngine
 
+            # Draft depth: explicit KFX_LM_SPEC_LAYERS, else a quarter
+            # of the target (floored at 1), always strictly shallower
+            # than the target — a 1-layer model has nothing to
+            # truncate, so speculation silently stays off there.
+            draft = 0
+            if self.spec and cfg.n_layers > 1:
+                draft = self.spec_layers or max(1, cfg.n_layers // 4)
+                draft = min(draft, cfg.n_layers - 1)
             # registry as a thunk: register() swaps self.metrics for
             # the hosting server's registry AFTER load; the engine must
             # follow it, not pin whatever was current at construction.
@@ -163,7 +184,10 @@ class LMPredictor(Predictor):
                 registry=lambda: self.metrics,
                 kv_page_size=self.kv_page_size,
                 kv_pages=self.kv_pages or None,
-                prefix_cache=self.prefix_cache)
+                prefix_cache=self.prefix_cache,
+                draft_layers=draft,
+                propose_tokens=max(1, self.spec_tokens),
+                draft_kv_pages=self.spec_pages or None)
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".

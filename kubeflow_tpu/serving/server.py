@@ -515,7 +515,9 @@ class ModelServer:
                               ("kfx_lm_slot_occupancy", "slot_occupancy"),
                               ("kfx_lm_slots", "slots"),
                               ("kfx_lm_kv_pages", "kv_pages"),
-                              ("kfx_lm_kv_pages_free", "kv_pages_free")):
+                              ("kfx_lm_kv_pages_free", "kv_pages_free"),
+                              ("kfx_lm_spec_accept_rate",
+                               "spec_accept_rate")):
             for labels, value in self.metrics.gauge(family).samples():
                 model = labels.get("model", "")
                 out.setdefault(model, {})[field] = value

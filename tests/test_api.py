@@ -364,6 +364,37 @@ spec:
         with pytest.raises(ValidationError, match="canaryTrafficPercent"):
             load_manifests(bad)
 
+    def test_speculative_field_paths(self):
+        """spec.predictor.speculative {draftLayers, proposeTokens}:
+        validated with field paths, and a bool masquerading as an int
+        (bool subclasses int) is a 400 at apply — not draft depth 1 at
+        revision startup."""
+        ok = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    speculative: {draftLayers: 2, "
+            "proposeTokens: 4}\n", 1)
+        (isvc,) = load_manifests(ok)
+        assert isvc.predictor()["speculative"]["draftLayers"] == 2
+        for bad_val, path in (
+                ("{draftLayers: 0}", "speculative.draftLayers"),
+                ("{draftLayers: true}", "speculative.draftLayers"),
+                ("{proposeTokens: false}", "speculative.proposeTokens"),
+                ("{proposeTokens: 1.5}", "speculative.proposeTokens"),
+                ("{enabled: 1}", "speculative.enabled"),
+                ("3", r"spec\.predictor\.speculative")):
+            bad = self.ISVC_YAML.replace(
+                "predictor:\n",
+                f"predictor:\n    speculative: {bad_val}\n", 1)
+            with pytest.raises(ValidationError, match=path):
+                load_manifests(bad)
+        # The canary revision is validated on its own field path.
+        bad = self.ISVC_YAML + (
+            "  canary:\n    speculative: {draftLayers: -1}\n"
+            "    jax: {storageUri: 'file:///tmp/models/resnet'}\n")
+        with pytest.raises(ValidationError,
+                           match=r"spec\.canary\.speculative"):
+            load_manifests(bad)
+
     def test_custom_predictor_requires_command(self):
         """A command-less custom container would crash the operator's
         spawn loop; it must be a 400 at apply time."""

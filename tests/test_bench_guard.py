@@ -93,6 +93,15 @@ class TestBoxGuard:
                     "lm_engine_speedup"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_speculative_keys_in_contract(self):
+        """The speculative-decode acceptance numbers (ISSUE 10:
+        lm_spec_accept_rate reported, >= 1.5x lm_spec_tokens_per_s
+        over the non-speculative engine at batch 1) ride the compact
+        BENCH_CONTRACT line; pinned here like the paged-KV keys."""
+        for key in ("lm_spec_accept_rate", "lm_spec_tokens_per_s",
+                    "lm_spec_speedup", "lm_spec_b4_speedup"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_lm_mfu_keys_in_contract(self):
         """The training-MFU acceptance numbers (ISSUE 8: lm_best_mfu >=
         0.60, lm_long_mfu >= 0.45, no step-time-variance regression)

@@ -352,6 +352,318 @@ class TestPagedKV:
             eng.close()
 
 
+@pytest.fixture(scope="module")
+def spec_engine(tiny_lm):
+    """Module-scoped speculative engine: 1-layer draft off the 2-layer
+    target, 4-token proposals. Every test drains its requests, so the
+    ~6s AOT warm (fused propose+verify step + two prefills) is paid
+    once."""
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg, params = tiny_lm
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                       name="lm-spec", kv_page_size=16,
+                       draft_layers=1, propose_tokens=4)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def spec_pool_engine(tiny_lm):
+    """Small-pool speculative engine (8 pages = TWO dense rows, prefix
+    cache off) for the page-pressure tests: recycling, preemption and
+    leak accounting are all observable against exact pool totals."""
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg, params = tiny_lm
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                       name="lm-sp", kv_page_size=16, kv_pages=8,
+                       prefix_cache=False, draft_layers=1,
+                       propose_tokens=4)
+    yield eng
+    eng.close()
+
+
+class TestSpeculative:
+    """Draft-model speculative decoding: the accept rule must preserve
+    the target exactly — greedy output byte-identical to the oracle
+    through every pool behavior (recycling, preemption, draft
+    degradation, chaos rejection waves), sampled output deterministic
+    per seed."""
+
+    def test_greedy_parity_and_stop(self, tiny_lm, spec_engine):
+        """Mixed prompt lengths, speculation on: byte-identical to the
+        one-shot oracle (the acceptance criterion), and the stop-token
+        contract survives proposals crossing the stop (the stop may
+        land mid-window — emitted tokens still end exactly before
+        it)."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        prompts = [[5, 9, 11, 3, 7], [2], [1, 2, 3, 4, 5, 6, 7, 8, 9],
+                   [13, 14]]
+        st0 = spec_engine.spec_stats()
+        out = spec_engine.generate(prompts, max_new_tokens=12)
+        ref = [gen.generate([p], max_new_tokens=12)[0] for p in prompts]
+        assert out == ref
+        st1 = spec_engine.spec_stats()
+        assert st1["proposed"] > st0["proposed"]  # it really speculated
+        ref0 = ref[0]
+        cut = next(j for j in range(2, len(ref0))
+                   if ref0[j] not in ref0[:j])
+        out = spec_engine.generate([prompts[0]], max_new_tokens=12,
+                                   stop_token=ref0[cut])[0]
+        assert out == ref0[:cut]
+        assert spec_engine._active_count() == 0
+
+    def test_parity_at_cache_capacity_boundary(self, tiny_lm,
+                                               spec_engine):
+        """A request whose budget reaches max_seq_len exactly: the
+        final verify windows extend past the last cache location —
+        regime coverage for the max_loc write cap (the state-level
+        test below pins the cache invariant directly)."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        prompt = [5, 9, 11, 3, 7]   # bucket 8 + 56 new = L exactly
+        out = spec_engine.generate([prompt], max_new_tokens=56)
+        assert out == [gen.generate([prompt], max_new_tokens=56)[0]]
+
+    def test_boundary_write_cap_protects_last_page(self, spec_engine):
+        """Drive the fused step directly with a slot whose window
+        crosses max_seq_len (loc=61, k=4 -> wloc reaches 65 > L-1):
+        every pre-existing cache entry must survive the boundary
+        window. Pins the max_loc write cap against gather-semantics
+        drift: today's jax FILLS out-of-table block gathers (INT_MIN
+        -> the write drops on the page >= 0 guard), but under "clip"
+        semantics the OOB location would land on the request's own
+        last page at slots 0/1 — logical locations 48/49 — and
+        destroy valid KV there, which output parity on tiny models
+        cannot discriminate (measured: zero argmax flips across 16
+        boundary scenarios with the cap removed)."""
+        import numpy as np
+
+        eng = spec_engine   # L=64, page 16, n_blocks 4, k=4
+        fn = eng._spec_step()
+        assert not eng._donate  # CPU: safe to drive the exec directly
+
+        def seed_pos(cache):
+            out = []
+            flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+            for path, leaf in flat:
+                if getattr(path[-1], "key", "") == "cached_pos":
+                    arr = np.array(leaf)
+                    # prompt 5 tokens at locs 0..4, decode cursor
+                    # history at locs 8..60 (pos = loc - 3): the
+                    # dense-equivalent layout of a bucket-8 request.
+                    for l in range(5):
+                        arr[:, l // 16, l % 16] = l
+                    for l in range(8, 61):
+                        arr[:, l // 16, l % 16] = l - 3
+                    leaf = jnp.asarray(arr)
+                out.append(leaf)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        cache = seed_pos(eng._init_cache())
+        dcache = seed_pos(eng._init_cache(draft=True))
+        B, nb = eng.n_slots, eng.n_blocks
+        tables = np.full((B, nb), -1, np.int32)
+        tables[0] = np.arange(nb)
+        pending = np.full((B,), -1, np.int32)
+        pending[0] = 7
+        pos = np.zeros((B,), np.int32)
+        pos[0] = 58            # pending's position (loc - 3 + 1)
+        loc = np.zeros((B,), np.int32)
+        loc[0] = 61            # window wloc 61..65 crosses L=64
+        max_loc = np.zeros((B,), np.int32)
+        max_loc[0] = 63
+        on = np.zeros((B,), np.bool_)
+        on[0] = True
+        rngs = np.tile(np.asarray(jax.random.PRNGKey(0), np.uint32),
+                       (B, 1))
+        out = fn(eng.params, eng.draft_params, cache, dcache,
+                 tables, np.array(tables), pending, pos, loc, max_loc,
+                 on, on, on, rngs, np.zeros((B,), np.float32),
+                 np.zeros((B,), np.int32))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                out[0])[0]:
+            if getattr(path[-1], "key", "") == "cached_pos":
+                got = np.asarray(leaf)
+                # Location 48 (page 3, slot 0) and 49 (slot 1): the
+                # clamp targets of wloc 64/65. Valid entries survive.
+                assert got[0, 3, 0] == 45, got[0, 3, :4]
+                assert got[0, 3, 1] == 46, got[0, 3, :4]
+
+    def test_sampling_deterministic_per_request(self, spec_engine):
+        """Same seed -> same sampled output with speculation on (the
+        accept uniforms and residual draws ride the slot's PRNG
+        stream); different seed diverges."""
+        a = spec_engine.generate([[1, 2, 3]], max_new_tokens=12,
+                                 temperature=1.0, seed=1)
+        b = spec_engine.generate([[1, 2, 3]], max_new_tokens=12,
+                                 temperature=1.0, seed=1)
+        c = spec_engine.generate([[1, 2, 3]], max_new_tokens=12,
+                                 temperature=1.0, seed=2)
+        assert a == b
+        assert a != c
+
+    def test_parity_under_recycle_and_preemption(self, tiny_lm,
+                                                 spec_pool_engine):
+        """The PR-7 pool behaviors with the draft in play: every page
+        of both pools recycles across waves without leaking stale KV,
+        and target-pool exhaustion preempts-by-recompute (freeing BOTH
+        pools' pages) with completions still byte-identical."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = spec_pool_engine
+        outs = eng.generate([[i + 1, i + 2] for i in range(4)],
+                            max_new_tokens=8)
+        assert outs == [gen.generate([[i + 1, i + 2]],
+                                     max_new_tokens=8)[0]
+                        for i in range(4)]
+        # Growth past the pool: preemption while slots speculate.
+        prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
+        outs = eng.generate(prompts, max_new_tokens=40)
+        assert outs == [gen.generate([p], max_new_tokens=40)[0]
+                        for p in prompts]
+        assert eng._reg().counter(
+            "kfx_lm_kv_preemptions_total").value(model="lm-sp") >= 1
+        # Both pools drain whole — no page leaks under preemption.
+        assert eng._mgr.n_free == eng.n_pages
+        assert eng._draft_mgr.n_free == eng.draft_n_pages
+
+    def test_draft_pool_exhaustion_degrades_not_fails(self, tiny_lm):
+        """A draft pool too small for the prompt degrades THAT SLOT to
+        non-speculative decode — admission (gated on the TARGET pool)
+        succeeds and output stays byte-identical; a same-wave short
+        prompt still speculates."""
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = DecodeEngine(cfg, params, n_slots=2, chunk_tokens=4,
+                           name="lm-dx", kv_page_size=16,
+                           draft_layers=1, propose_tokens=4,
+                           draft_kv_pages=1)
+        try:
+            eng.warm([8])
+            # 20 tokens need 2 draft pages; the pool has 1 -> degrade.
+            long_p = [(3 * i + 1) % 60 for i in range(20)]
+            out = eng.generate([long_p], max_new_tokens=8)
+            assert out == [gen.generate([long_p], max_new_tokens=8)[0]]
+            assert eng.spec_stats()["degraded"] >= 1
+            # A short prompt fits the 1-page draft pool and speculates.
+            st0 = eng.spec_stats()["proposed"]
+            out = eng.generate([[5, 9, 11]], max_new_tokens=8)
+            assert out == [gen.generate([[5, 9, 11]],
+                                        max_new_tokens=8)[0]]
+            assert eng.spec_stats()["proposed"] > st0
+        finally:
+            eng.close()
+
+    def test_chaos_spec_verify_full_rejection(self, tiny_lm,
+                                              spec_pool_engine):
+        """The engine.spec_verify fault point forces full-rejection
+        waves: throughput falls to the non-speculative floor (accepted
+        counter frozen) but output stays byte-identical and no page
+        leaks from either pool; when the budget drains the engine
+        speculates again."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = spec_pool_engine
+        ref = gen.generate([[5, 9, 11, 3, 7]], max_new_tokens=12)[0]
+        acc0 = eng.spec_stats()["accepted"]
+        chaos.install(chaos.parse_spec("engine.spec_verify:count=100"))
+        try:
+            out = eng.generate([[5, 9, 11, 3, 7]], max_new_tokens=12)
+            assert out == [ref]  # degradation, never a parity break
+            assert chaos.injected_counts().get(
+                "engine.spec_verify", 0) >= 1
+            assert eng.spec_stats()["accepted"] == acc0
+        finally:
+            chaos.reset()
+        assert eng._mgr.n_free == eng.n_pages          # no page leak
+        assert eng._draft_mgr.n_free == eng.draft_n_pages
+        st0 = eng.spec_stats()
+        out = eng.generate([[5, 9, 11, 3, 7]], max_new_tokens=12)
+        assert out == [ref]
+        st1 = eng.spec_stats()
+        assert st1["accepted"] > st0["accepted"]  # speculating again
+
+    def test_verify_span_and_metrics(self, spec_engine, tmp_path):
+        """engine.verify lands in the span log under the submitting
+        request's trace (schema-valid for `kfx trace`), and the
+        proposed/accepted counters + trailing accept-rate gauge are
+        live on the engine's registry."""
+        from kubeflow_tpu.obs import trace as obs_trace
+        import scripts.scrape_metrics as scrape
+
+        path = obs_trace.set_span_sink(str(tmp_path / "spans"), "spec")
+        with obs_trace.span("client.generate",
+                            trace_id="trace-spec-test") as root:
+            spec_engine.generate([[5, 9, 11]], max_new_tokens=6)
+        recs = [json.loads(ln) for ln in
+                open(path).read().splitlines() if ln.strip()]
+        verify = [r for r in recs if r["name"] == "engine.verify"]
+        assert verify
+        assert verify[0]["trace"] == "trace-spec-test"
+        assert verify[0]["parent"] == root.span_id
+        assert "accepted" in verify[0]["attrs"]
+        assert scrape.main(["--spans", str(path)]) == 0
+        reg = spec_engine._reg()
+        proposed = reg.counter("kfx_lm_spec_proposed_total").value(
+            model="lm-spec")
+        accepted = reg.counter("kfx_lm_spec_accepted_total").value(
+            model="lm-spec")
+        assert proposed > 0 and 0 <= accepted <= proposed
+        rate = reg.gauge("kfx_lm_spec_accept_rate").value(model="lm-spec")
+        assert 0.0 <= rate <= 1.0
+
+
+@pytest.mark.slow
+class TestSpeculativeDistribution:
+    def test_residual_sampling_preserves_target_distribution(
+            self, tiny_lm, engine, spec_engine):
+        """Leviathan residual sampling: the spec engine's SAMPLED
+        output distribution must equal the non-speculative engine's
+        (both sample the exact target). Empirical marginals over many
+        seeds at each emitted position must agree within sampling
+        noise — a broken accept rule (e.g. emitting raw draft
+        proposals) skews total variation far past the bound."""
+        import numpy as np
+
+        V, N, T = 64, 600, 3
+        prompt = [5, 9, 11]
+
+        def marginals(eng):
+            counts = np.zeros((T, V))
+            s = 0
+            while s < N:
+                outs = eng.generate([prompt] * 4, max_new_tokens=T,
+                                    temperature=1.0, seed=10_000 + s)
+                for ids in outs:
+                    for t, tok in enumerate(ids):
+                        counts[t, tok] += 1
+                s += 4
+            return counts / counts.sum(axis=1, keepdims=True)
+
+        base = marginals(engine)
+        spec = marginals(spec_engine)
+        for t in range(T):
+            tv = 0.5 * np.abs(base[t] - spec[t]).sum()
+            # Two empirical distributions over V=64 with N=600 each
+            # have E[TV] ~ 0.13; a distribution-breaking accept rule
+            # measures >= 0.4 (verified by skewing the rule).
+            assert tv < 0.25, (t, tv)
+
+
 class TestEngineThroughput:
     def test_concurrent_throughput_3x(self):
         """Acceptance criterion: 8 concurrent single-prompt requests
@@ -466,7 +778,10 @@ class TestEngineServing:
                           "--require", "kfx_lm_engine_chunks_total",
                           "--require", "kfx_lm_kv_pages",
                           "--require", "kfx_lm_kv_pages_free",
-                          "--require", "kfx_lm_prefix_cache_hits_total"])
+                          "--require", "kfx_lm_prefix_cache_hits_total",
+                          "--require", "kfx_lm_spec_proposed_total",
+                          "--require", "kfx_lm_spec_accepted_total",
+                          "--require", "kfx_lm_spec_accept_rate"])
         assert rc == 0
         # Windowed rate: positive after traffic (not a stale last-call
         # number), and the queue-wait histogram saw both admissions.

@@ -132,6 +132,35 @@ class TestRender:
 
 
 class TestKfxVerbs:
+    def test_serving_top_rows_kv_and_accept(self):
+        """`kfx top`'s per-isvc table renders the engine's KV-pool
+        utilization and speculative accept rate when the operator
+        sampled them, and "-" for classifier revisions without them."""
+        from kubeflow_tpu.api.serving import InferenceService
+        from kubeflow_tpu.cli import _serving_top_rows
+
+        lm = InferenceService.from_dict({
+            "metadata": {"name": "lm", "namespace": "default"},
+            "spec": {"predictor": {"jax": {"storageUri": "file:///m"}}},
+        })
+        lm.status = {
+            "replicas": {"default": 2},
+            "readyReplicas": {"default": 2},
+            "autoscaling": {"default": {
+                "desired": 2, "target": 8,
+                "kvUtil": 0.42, "specAcceptRate": 0.87}},
+        }
+        clf = InferenceService.from_dict({
+            "metadata": {"name": "clf", "namespace": "default"},
+            "spec": {"predictor": {"jax": {"storageUri": "file:///m"}}},
+        })
+        clf.status = {"replicas": {"default": 1},
+                      "autoscaling": {"default": {"desired": 1,
+                                                  "target": 8}}}
+        rows = _serving_top_rows([lm, clf])
+        assert rows[0][6] == "42%" and rows[0][7] == "87%"
+        assert rows[1][6] == "-" and rows[1][7] == "-"
+
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main
 

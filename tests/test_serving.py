@@ -507,6 +507,35 @@ class TestRouter:
 
 @pytest.mark.slow
 class TestInferenceServiceE2E:
+    def test_speculative_spec_exports_env(self):
+        """spec.predictor.speculative -> the replica's KFX_LM_SPEC_*
+        env (the knobs LMPredictor reads at load); classifier-graph
+        roles and absent blocks export nothing, and enabled:false is
+        the manifest-level escape hatch."""
+        from kubeflow_tpu.operators.serving import _Revision
+
+        rev = _Revision(name="default", model_name="m", model_dir="d",
+                        workdir="w", batcher=None,
+                        speculative={"draftLayers": 3,
+                                     "proposeTokens": 6})
+        env: dict = {}
+        rev._spec_env(env)
+        assert env == {"KFX_LM_SPEC_LAYERS": "3",
+                       "KFX_LM_SPEC_TOKENS": "6"}
+        env = {}
+        rev.speculative = {"enabled": False}
+        rev._spec_env(env)
+        assert env == {"KFX_LM_SPEC": "0"}
+        env = {}
+        rev.speculative = None
+        rev._spec_env(env)
+        assert env == {}
+        rev.speculative = {"draftLayers": 3}
+        rev.role = "transformer"
+        env = {}
+        rev._spec_env(env)
+        assert env == {}
+
     def test_apply_predict_canary_update(self, export_dir, tmp_path):
         from kubeflow_tpu.api.manifest import load_manifests
         from kubeflow_tpu.controlplane import ControlPlane
