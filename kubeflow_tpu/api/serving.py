@@ -244,6 +244,25 @@ class InferenceService(Resource):
                         raise ValidationError(
                             f"spec.{rev}.speculative.enabled",
                             "must be a boolean")
+                q = spec.get("quantization")
+                if q is not None:
+                    if not isinstance(q, dict):
+                        raise ValidationError(
+                            f"spec.{rev}.quantization",
+                            "must be an object {weights, kv}")
+                    for field in ("weights", "kv"):
+                        v = q.get(field)
+                        if v is None:
+                            continue
+                        # `weights: true` (a bool) or `weights: 8`
+                        # (an int) must be a 400 at apply, not a
+                        # stringified surprise at revision startup.
+                        if isinstance(v, bool) or \
+                                not isinstance(v, str) or \
+                                v not in ("int8", "f32"):
+                            raise ValidationError(
+                                f"spec.{rev}.quantization.{field}",
+                                "must be 'int8' or 'f32'")
         tr = self.spec.get("transformer")
         if tr is not None and not tr.get("module"):
             raise ValidationError(

@@ -88,7 +88,7 @@ KNOWN_POINTS = frozenset({
     "store.read", "store.write", "workqueue.requeue",
     "checkpoint.save", "checkpoint.restore",
     "serving.request", "serving.predict", "engine.admit",
-    "engine.kv_alloc", "engine.spec_verify",
+    "engine.kv_alloc", "engine.spec_verify", "engine.kv_quant",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
 })

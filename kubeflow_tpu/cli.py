@@ -458,9 +458,10 @@ def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
 def _serving_top_rows(isvcs) -> List[List[str]]:
     """Per-revision replica lines for `kfx top`: ready/spawned against
     the autoscaler's desired count and concurrency target, the decode
-    engine's KV-page pool utilization and speculative-decode accept
-    rate (paged LM revisions; "-" for classifiers and engines with the
-    draft off), plus the canary traffic split."""
+    engine's KV-page pool utilization, speculative-decode accept rate
+    and quantization mode (Q column: "w8"/"kv8"/"w8+kv8"/"d8"/"f32";
+    paged LM revisions — "-" for classifiers and engines with the
+    signal absent), plus the canary traffic split."""
     rows = []
     for isvc in isvcs:
         repl = isvc.status.get("replicas") or {}
@@ -482,6 +483,7 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
                 str(a.get("target", "-")),
                 f"{kv * 100:.0f}%" if kv is not None else "-",
                 f"{acc * 100:.0f}%" if acc is not None else "-",
+                str(a.get("quant") or "-"),
                 f"{pct}%" if rev == "canary" else "-"])
     return rows
 
@@ -491,7 +493,8 @@ def _print_serving_top(rows: List[List[str]]) -> None:
         return
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
-                        "DESIRED", "TARGET", "KV%", "ACC%", "CANARY%"])
+                        "DESIRED", "TARGET", "KV%", "ACC%", "Q",
+                        "CANARY%"])
 
 
 def _print_rollouts(isvcs) -> int:

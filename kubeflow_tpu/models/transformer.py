@@ -120,6 +120,25 @@ class TransformerConfig:
     # view is exactly [B, max_seq_len] and stays bit-identical to dense.
     kv_page_size: int = 0
     kv_pages: int = 0
+    # Weight quantization: "int8" switches the attention/MLP/lm_head
+    # projections to per-output-channel symmetric int8 kernels with f32
+    # scales (QuantDenseGeneral below; params produced by
+    # ``quantize_params_int8``). The matmul consumes the int8 kernel
+    # directly and the scale is applied to the OUTPUT — mathematically
+    # identical to dequantizing the kernel for symmetric per-channel
+    # scales, and the weights stream from HBM as int8. Embeddings,
+    # norms and MoE experts stay in param_dtype. "" = unquantized (the
+    # f32 oracle path, byte-identical to pre-quantization builds).
+    quant: str = ""
+    # KV-cache quantization (paged layout only): "int8" stores the
+    # paged pool's K/V entries as int8 with one f32 scale per cached
+    # token per pool (scale planes [kv_pages, page_size] beside the
+    # pool) — quantize-on-write in the scatter, dequant-on-gather.
+    # Halves (vs bf16; 4x vs f32) the pool's HBM per token, so the
+    # same byte budget admits ~2x the concurrent requests. Independent
+    # of ``quant``. Requires kv_page_size > 0 (the dense one-shot
+    # oracle stays full-precision).
+    kv_quant: str = ""
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "flash", "xla", "naive", "ring"):
@@ -142,6 +161,17 @@ class TransformerConfig:
             if self.kv_pages < 1:
                 raise ValueError(
                     "kv_pages must be >= 1 when kv_page_size > 0")
+        if self.quant not in ("", "int8"):
+            raise ValueError(
+                f"unknown quant {self.quant!r} (expected '' or 'int8')")
+        if self.kv_quant not in ("", "int8"):
+            raise ValueError(
+                f"unknown kv_quant {self.kv_quant!r} "
+                "(expected '' or 'int8')")
+        if self.kv_quant and self.kv_page_size == 0:
+            raise ValueError(
+                "kv_quant requires the paged cache (kv_page_size > 0): "
+                "the dense one-shot layout is the full-precision oracle")
 
     @property
     def qkv_features(self) -> int:
@@ -207,6 +237,165 @@ def activation_probe(fn):
         _activation_probe = prev
 
 
+class QuantDenseGeneral(nn.Module):
+    """Per-output-channel symmetric int8 projection: an int8 ``kernel``
+    plus an f32 ``scale`` of the output-feature shape, with the scale
+    applied to the MATMUL OUTPUT — ``y = (x @ W_q) * s`` — never to the
+    kernel. For symmetric per-output-channel scales the two are
+    mathematically identical (``x @ (W_q * s) == (x @ W_q) * s`` when
+    ``s`` varies only over output channels), but this form lets the
+    weights stream from HBM as int8: the int8→dtype convert rides the
+    dot's operand fusion on TPU (the MXU reads converted tiles from
+    registers, HBM traffic is the int8 bytes). On XLA:CPU the convert
+    materializes, so there is no wall-clock win there — docs/serving.md
+    records the measurement.
+
+    Param init is STRUCTURAL (zero kernel, unit scales): real
+    quantized params come from ``quantize_params_int8`` over a trained
+    f32 tree; a from-scratch init of a quant model is shape-correct
+    but degenerate, which is fine for eval_shape/cache plumbing."""
+
+    features: Tuple[int, ...]
+    axis: Tuple[int, ...] = (-1,)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        axis = tuple(a % x.ndim for a in self.axis)
+        in_shape = tuple(x.shape[a] for a in axis)
+        kernel = self.param("kernel", nn.initializers.zeros,
+                            in_shape + tuple(self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           tuple(self.features), jnp.float32)
+        y = jax.lax.dot_general(
+            x, kernel.astype(self.dtype),
+            ((axis, tuple(range(len(axis)))), ((), ())))
+        # Scale in f32 (a per-channel rescale must not round through
+        # bf16 twice), then back to the compute dtype.
+        return (y.astype(jnp.float32) * scale).astype(self.dtype)
+
+
+# Module paths quantize_params_int8 rewrites (and QuantDenseGeneral
+# consumes when cfg.quant == "int8"): path suffix -> number of
+# OUTPUT-channel axes in that kernel (the scale's shape; every other
+# non-layer axis is a contraction axis the per-channel max reduces
+# over). Embeddings and norms stay full-precision (they are gathers /
+# elementwise, not weight-streaming matmuls); MoE expert weights are
+# not covered (quant + n_experts serves unquantized experts).
+_QUANT_SUFFIXES: Dict[Tuple[str, ...], int] = {
+    ("attn", "query"): 2,
+    ("attn", "key"): 2,
+    ("attn", "value"): 2,
+    ("attn", "out"): 1,
+    ("mlp", "wi"): 1,
+    ("mlp", "wo"): 1,
+    ("lm_head",): 1,
+}
+
+
+def _quant_suffix(path: Tuple[str, ...]) -> Optional[int]:
+    for suffix, n_out in _QUANT_SUFFIXES.items():
+        if path[-len(suffix):] == suffix:
+            return n_out
+    return None
+
+
+def quantize_leaf_int8(w, n_out: int, lead: int = 0):
+    """THE per-channel symmetric int8 scheme, in one place: reduce
+    max|w| over the contraction axes (everything between ``lead``
+    layer-stack axes and the last ``n_out`` output-channel axes),
+    ``scale = amax / 127`` (all-zero channels get scale 1 so dequant
+    is exact), values round-clip to [-127, 127]. Returns
+    ``(q int8, scale f32)``. Shared by the transformer param
+    transform below and the generic classifier-export quantizer
+    (serving/export.py) — one formula, no drift."""
+    w = jnp.asarray(w, jnp.float32)
+    red = tuple(range(lead, w.ndim - n_out))
+    amax = jnp.max(jnp.abs(w), axis=red)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / jnp.expand_dims(scale, red)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_leaf_int8(q, scale, n_out: int, lead: int = 0):
+    """Inverse of ``quantize_leaf_int8`` (up to quantization error):
+    ``q * scale`` with the scale broadcast back over the contraction
+    axes. Returns f32."""
+    q = jnp.asarray(q)
+    red = tuple(range(lead, q.ndim - n_out))
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(jnp.asarray(scale, jnp.float32), red))
+
+
+def quantize_params_int8(params):
+    """f32/bf16 TransformerLM params -> the ``quant="int8"`` structure:
+    each covered projection's ``{"kernel": w}`` becomes
+    ``{"kernel": int8, "scale": f32}`` with one symmetric scale per
+    output channel (``scale = max|w| / 127`` over the contraction
+    axes). Layer-stacked kernels (under the nn.scan "layers"
+    collection) quantize per layer per channel — exactly the leading
+    axis the scanned QuantDenseGeneral params carry. Everything else
+    (embed, norms, MoE) passes through unchanged; the input tree is
+    not mutated."""
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            p = path + (k,)
+            n_out = _quant_suffix(p)
+            if (isinstance(v, dict) and "kernel" in v
+                    and n_out is not None
+                    and jnp.asarray(v["kernel"]).dtype != jnp.int8):
+                q, scale = quantize_leaf_int8(
+                    v["kernel"], n_out, lead=1 if "layers" in p else 0)
+                nv = {kk: vv for kk, vv in v.items() if kk != "kernel"}
+                nv["kernel"] = q
+                nv["scale"] = scale
+                out[k] = nv
+            else:
+                out[k] = walk(v, p)
+        return out
+
+    return walk(params, ())
+
+
+def dequantize_params_int8(params):
+    """Inverse of ``quantize_params_int8`` (up to the quantization
+    error): int8 kernels expand back to f32 ``kernel = q * scale`` and
+    the scale leaves disappear — the ``KFX_LM_QUANT=0`` escape hatch
+    that serves an int8 export through the full-precision path."""
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            p = path + (k,)
+            n_out = _quant_suffix(p)
+            if (isinstance(v, dict) and "kernel" in v and "scale" in v
+                    and n_out is not None
+                    and jnp.asarray(v["kernel"]).dtype == jnp.int8):
+                w = dequantize_leaf_int8(
+                    v["kernel"], v["scale"], n_out,
+                    lead=1 if "layers" in p else 0)
+                out[k] = {kk: vv for kk, vv in v.items()
+                          if kk not in ("kernel", "scale")}
+                out[k]["kernel"] = w
+            else:
+                out[k] = walk(v, p)
+        return out
+
+    return walk(params, ())
+
+
+def params_quantized(params) -> bool:
+    """Whether a param tree carries int8 kernels (the load-time
+    auto-detection the export's quant block corroborates)."""
+    return any(jnp.asarray(x).dtype == jnp.int8
+               for x in jax.tree_util.tree_leaves(params))
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -240,9 +429,14 @@ class Attention(nn.Module):
                  write_locations=None):
         cfg = self.cfg
         B, S, _ = x.shape
-        proj = lambda name, feats: nn.DenseGeneral(
-            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype, name=name)
+        if cfg.quant == "int8":
+            proj = lambda name, feats: QuantDenseGeneral(
+                feats if isinstance(feats, tuple) else (feats,),
+                axis=(-1,), dtype=cfg.dtype, name=name)
+        else:
+            proj = lambda name, feats: nn.DenseGeneral(
+                feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name=name)
         # checkpoint_name tags mark the fat matmul outputs for the
         # "save_dense"/"save_flash" remat policies: keep these, recompute
         # only the cheap elementwise chain and the attention internals.
@@ -360,10 +554,14 @@ class Attention(nn.Module):
             probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
         _probe("attn_mix", out)
-        return checkpoint_name(
-            nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
-                            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                            name="out")(out), "attn_out")
+        if cfg.quant == "int8":
+            mix = QuantDenseGeneral((x.shape[-1],), axis=(-2, -1),
+                                    dtype=cfg.dtype, name="out")
+        else:
+            mix = nn.DenseGeneral(x.shape[-1], axis=(-2, -1),
+                                  use_bias=False, dtype=cfg.dtype,
+                                  param_dtype=cfg.param_dtype, name="out")
+        return checkpoint_name(mix(out), "attn_out")
 
     def _decode_attend(self, q, k, v, positions, block_tables=None,
                        write_locations=None):
@@ -421,12 +619,28 @@ class Attention(nn.Module):
             if block_tables is None:
                 raise ValueError(
                     "paged decode (kv_page_size > 0) requires block_tables")
+            int8_kv = cfg.kv_quant == "int8"
+            kv_dtype = jnp.int8 if int8_kv else cfg.dtype
             ck = self.variable("cache", "cached_key",
-                               lambda: jnp.zeros((N, P, H, D), cfg.dtype))
+                               lambda: jnp.zeros((N, P, H, D), kv_dtype))
             cv = self.variable("cache", "cached_value",
-                               lambda: jnp.zeros((N, P, H, D), cfg.dtype))
+                               lambda: jnp.zeros((N, P, H, D), kv_dtype))
             cpos = self.variable("cache", "cached_pos",
                                  lambda: jnp.full((N, P), -1, jnp.int32))
+            if int8_kv:
+                # Per-token symmetric scales, stored as one f32 plane
+                # per pool beside the pages ([N, P]: page x slot). The
+                # scale is derived from each written token's own K/V
+                # row at write time (scale = max|k| / 127), so there is
+                # no calibration pass and page recycling needs no
+                # rescale — a recycled entry's stale scale is dead the
+                # moment its position id is -1.
+                ksc = self.variable(
+                    "cache", "key_scale",
+                    lambda: jnp.zeros((N, P), jnp.float32))
+                vsc = self.variable(
+                    "cache", "value_scale",
+                    lambda: jnp.zeros((N, P), jnp.float32))
             pos = positions  # [B, S]
             loc = pos if write_locations is None else write_locations
             ok = (pos >= 0) & (loc >= 0)
@@ -437,18 +651,48 @@ class Attention(nn.Module):
             # mode="drop" discards the update.
             page = jnp.where(ok & (page >= 0), page, N)
             slot = jnp.where(ok, loc % P, 0)
-            ck.value = ck.value.at[page, slot].set(
-                k.astype(cfg.dtype), mode="drop")
-            cv.value = cv.value.at[page, slot].set(
-                v.astype(cfg.dtype), mode="drop")
+            if int8_kv:
+                # Quantize-on-write: round each token's K/V row to int8
+                # against its own max-abs scale. A zero row quantizes
+                # to zeros with scale 0 (dequant exact).
+                def q8(x):
+                    xf = x.astype(jnp.float32)
+                    s = jnp.max(jnp.abs(xf), axis=(-2, -1)) / 127.0
+                    q = jnp.clip(
+                        jnp.round(xf
+                                  / jnp.maximum(s, 1e-30)[..., None, None]),
+                        -127, 127).astype(jnp.int8)
+                    return q, s
+                kq, ks = q8(k)
+                vq, vs = q8(v)
+                ck.value = ck.value.at[page, slot].set(kq, mode="drop")
+                cv.value = cv.value.at[page, slot].set(vq, mode="drop")
+                ksc.value = ksc.value.at[page, slot].set(ks, mode="drop")
+                vsc.value = vsc.value.at[page, slot].set(vs, mode="drop")
+            else:
+                ck.value = ck.value.at[page, slot].set(
+                    k.astype(cfg.dtype), mode="drop")
+                cv.value = cv.value.at[page, slot].set(
+                    v.astype(cfg.dtype), mode="drop")
             cpos.value = cpos.value.at[page, slot].set(pos, mode="drop")
             # Gather each row's logical view [L] through its table.
             # Unallocated blocks clamp to page 0 for K/V (their scores
             # are masked to exactly-0 probability via position -1, so
             # the garbage never contributes) and force position -1.
             pt = jnp.clip(block_tables, 0, N - 1)        # [B, nblk]
-            gk = ck.value[pt].reshape(B, L, H, D)
-            gv = cv.value[pt].reshape(B, L, H, D)
+            if int8_kv:
+                # Dequant-on-gather: int8 entries x the per-token scale
+                # plane, in f32 (one multiply per gathered element),
+                # then the compute dtype.
+                gks = ksc.value[pt].reshape(B, L)[..., None, None]
+                gvs = vsc.value[pt].reshape(B, L)[..., None, None]
+                gk = (ck.value[pt].reshape(B, L, H, D).astype(jnp.float32)
+                      * gks).astype(cfg.dtype)
+                gv = (cv.value[pt].reshape(B, L, H, D).astype(jnp.float32)
+                      * gvs).astype(cfg.dtype)
+            else:
+                gk = ck.value[pt].reshape(B, L, H, D)
+                gv = cv.value[pt].reshape(B, L, H, D)
             gp = jnp.where((block_tables >= 0)[..., None],
                            cpos.value[pt], -1).reshape(B, L)
         else:
@@ -484,14 +728,17 @@ class DenseFFN(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        wi = checkpoint_name(
-            nn.Dense(2 * cfg.d_ff, use_bias=False, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="wi")(x), "mlp_wi")
+        if cfg.quant == "int8":
+            dense = lambda name, feats: QuantDenseGeneral(
+                (feats,), axis=(-1,), dtype=cfg.dtype, name=name)
+        else:
+            dense = lambda name, feats: nn.Dense(
+                feats, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name=name)
+        wi = checkpoint_name(dense("wi", 2 * cfg.d_ff)(x), "mlp_wi")
         gate, up = jnp.split(wi, 2, axis=-1)
         h = nn.silu(gate) * up  # SwiGLU
-        return checkpoint_name(
-            nn.Dense(x.shape[-1], use_bias=False, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="wo")(h), "mlp_wo")
+        return checkpoint_name(dense("wo", x.shape[-1])(h), "mlp_wo")
 
 
 class MoEFFN(nn.Module):
@@ -744,9 +991,14 @@ class TransformerLM(nn.Module):
             # whole. lm_head params still exist (created at init via the
             # normal path); the train loop consumes them directly.
             return x
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          param_dtype=cfg.param_dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        if cfg.quant == "int8":
+            head = QuantDenseGeneral((cfg.vocab_size,), axis=(-1,),
+                                     dtype=cfg.dtype, name="lm_head")
+        else:
+            head = nn.Dense(cfg.vocab_size, use_bias=False,
+                            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            name="lm_head")
+        return head(x).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -76,7 +76,8 @@ class _Revision:
                  device: str = "auto", role: str = "predictor",
                  graph: Optional[dict] = None,
                  container: Optional[dict] = None,
-                 speculative: Optional[dict] = None):
+                 speculative: Optional[dict] = None,
+                 quantization: Optional[dict] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -90,6 +91,10 @@ class _Revision:
         # KFX_LM_SPEC_* knobs the LMPredictor reads; classifier
         # frameworks ignore them.
         self.speculative = speculative
+        # Quantization spec ({weights, kv}, api/serving.py) — exported
+        # as the KFX_LM_QUANT / KFX_LM_KV_QUANT knobs the LMPredictor
+        # reads at load; classifier frameworks ignore them.
+        self.quantization = quantization
         # KFServing custom-predictor parity: a user-provided container
         # command serves the port instead of a framework server. The
         # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
@@ -105,6 +110,7 @@ class _Revision:
         self.engine_kv_pages = 0.0
         self.engine_kv_free = 0.0
         self.engine_spec_rate: Optional[float] = None
+        self.engine_quant: Optional[str] = None
         self.engine_sampled = float("-inf")
         self.engine_absent = False
 
@@ -177,6 +183,7 @@ class _Revision:
         env = inject_pythonpath(dict(os.environ))
         self._span_env(env)
         self._spec_env(env)
+        self._quant_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
@@ -198,6 +205,26 @@ class _Revision:
             env["KFX_LM_SPEC_LAYERS"] = str(int(sp["draftLayers"]))
         if sp.get("proposeTokens") is not None:
             env["KFX_LM_SPEC_TOKENS"] = str(int(sp["proposeTokens"]))
+
+    def _quant_env(self, env: dict) -> None:
+        """spec.<rev>.quantization -> the LMPredictor's quantization
+        env knobs. ``weights: int8`` quantizes an f32 export at load
+        (or keeps an int8 export as-is); ``weights: f32`` is the
+        manifest-level escape hatch that dequantizes an int8 export;
+        ``kv: int8`` switches the engine's paged KV pools to int8."""
+        q = self.quantization
+        if q is None or self.role != "predictor":
+            return
+        w = q.get("weights")
+        if w == "int8":
+            env["KFX_LM_QUANT"] = "int8"
+        elif w == "f32":
+            env["KFX_LM_QUANT"] = "0"
+        k = q.get("kv")
+        if k == "int8":
+            env["KFX_LM_KV_QUANT"] = "int8"
+        elif k == "f32":
+            env["KFX_LM_KV_QUANT"] = "0"
 
     def _span_env(self, env: dict) -> None:
         """Point the replica's span log (obs.trace auto-sink) at this
@@ -433,10 +460,12 @@ class InferenceServiceController(Controller):
             batcher = spec.get("batcher")
             device = str(spec.get("device", "auto"))
             speculative = spec.get("speculative")
+            quantization = spec.get("quantization")
             if rev is None or rev.model_dir != model_dir \
                     or rev.device != device or rev.batcher != batcher \
                     or rev.container != container \
-                    or rev.speculative != speculative:
+                    or rev.speculative != speculative \
+                    or rev.quantization != quantization:
                 if rev is not None:
                     rev.teardown()
                 rev = _Revision(
@@ -449,6 +478,7 @@ class InferenceServiceController(Controller):
                     device=device,
                     container=container,
                     speculative=speculative,
+                    quantization=quantization,
                 )
                 rt.revisions[rev_name] = rev
                 self.record_event(isvc, "Normal", "RevisionCreated",
@@ -717,6 +747,10 @@ class InferenceServiceController(Controller):
             # `kfx top`'s ACC% column: the live signal for whether
             # speculative decoding is paying for its draft.
             status["specAcceptRate"] = round(rev.engine_spec_rate, 3)
+        if rev.engine_quant is not None:
+            # Engine quantization mode ("w8", "kv8", "w8+kv8", "d8",
+            # "f32") — `kfx top`'s Q column.
+            status["quant"] = rev.engine_quant
         rt.autoscaling_status[rev_name] = status
         return decision.desired
 
@@ -735,6 +769,7 @@ class InferenceServiceController(Controller):
         total, answered, saw_engine = 0.0, False, False
         kv_pages, kv_free = 0.0, 0.0
         spec_rates: List[float] = []
+        quants: List[str] = []
         for r in rev.replicas:
             if not r.ready:
                 continue
@@ -753,6 +788,8 @@ class InferenceServiceController(Controller):
                 kv_free += float(row.get("kv_pages_free", 0.0))
                 if "spec_accept_rate" in row:
                     spec_rates.append(float(row["spec_accept_rate"]))
+                if row.get("quant"):
+                    quants.append(str(row["quant"]))
         if answered and not saw_engine:
             rev.engine_absent = True  # classifier server: stop polling
         rev.engine_queue = total
@@ -760,6 +797,7 @@ class InferenceServiceController(Controller):
         rev.engine_kv_free = kv_free
         rev.engine_spec_rate = (sum(spec_rates) / len(spec_rates)
                                 if spec_rates else None)
+        rev.engine_quant = quants[0] if quants else None
         return total
 
     def _finish_cold_start(self, isvc: InferenceService, rt: _IsvcRuntime,

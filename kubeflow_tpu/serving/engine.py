@@ -80,10 +80,26 @@ families; speculation adds ``kfx_lm_spec_proposed_total`` /
 ``kfx_lm_spec_accepted_total`` counters, the trailing-window
 ``kfx_lm_spec_accept_rate`` gauge and the per-iteration
 ``engine.verify`` span.
-Chaos points ``engine.admit``, ``engine.kv_alloc`` and
+Quantization (PR 11): ``kv_quant="int8"`` stores both pools' K/V
+entries as int8 with per-token f32 scale planes beside the pages
+(quantize-on-write / dequant-on-gather in ``_decode_attend``) — the
+same byte budget holds ~2x (vs bf16; ~3.5x vs f32) the tokens, so
+page-gated admission takes proportionally more concurrent requests;
+``draft_quant="int8"`` quantizes only the DRAFT's weights (per-channel
+int8 via ``quantize_params_int8``), risking nothing but accept rate.
+Weight-quantized TARGETS arrive as already-quantized params + a
+``cfg.quant="int8"`` knob from the export layer. Quantized paths are
+bounded-drift, not byte-exact — the f32 engine remains the parity
+oracle, and ``kfx_lm_kv_bytes_per_token`` / ``kfx_lm_quant_mode``
+gauges make the mode scrape-visible.
+
+Chaos points ``engine.admit``, ``engine.kv_alloc``,
 ``engine.spec_verify`` (a full-rejection wave: every proposal treated
 as rejected for that iteration — throughput falls to the
-non-speculative floor, correctness untouched; docs/chaos.md).
+non-speculative floor, correctness untouched) and ``engine.kv_quant``
+(int8 KV only: crushes the cached scale planes to the worst case —
+quality/accept-rate degrade observably, never a crash or page leak;
+docs/chaos.md).
 
 jax is imported lazily (inside methods): server.py imports this module
 for ``EngineOverloaded`` on its own import path.
@@ -111,6 +127,22 @@ from ..obs.metrics import MetricsRegistry, default_registry
 QUEUE_WAIT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def quant_mode_string(weights: str, kv: str) -> str:
+    """Render the `kfx top` Q-column mode string from the
+    ``kfx_lm_quant_mode`` gauge's label values (``int8`` /
+    ``draft-int8`` / ``f32``): ONE mapping shared by the engine's
+    ``quant_mode`` property and the model server's JSON engine block,
+    so the two surfaces cannot drift."""
+    parts = []
+    if weights == "int8":
+        parts.append("w8")
+    elif weights == "draft-int8":
+        parts.append("d8")
+    if kv == "int8":
+        parts.append("kv8")
+    return "+".join(parts) or "f32"
 
 
 class EngineOverloaded(RuntimeError):
@@ -361,6 +393,18 @@ class PrefixCache:
                 return True
         return False
 
+    def drop_all(self) -> List[int]:
+        """Drop every entry, releasing the cache's page refs (pages a
+        live slot still reads survive until that slot retires). The
+        ``engine.kv_quant`` chaos path uses this: a scale-plane crush
+        corrupts CACHED prompt pages too, and cached pages are never
+        rewritten while cached — serving them to future admissions
+        would extend the injected fault past its budget."""
+        freed: List[int] = []
+        for e in list(self._lru.values()):
+            freed += self._drop(e)
+        return freed
+
 
 class DecodeEngine:
     """Owns the paged KV pool, the block tables, the prefix cache, the
@@ -379,7 +423,9 @@ class DecodeEngine:
                  prefix_cache: bool = True,
                  draft_layers: int = 0,
                  propose_tokens: int = 4,
-                 draft_kv_pages: Optional[int] = None):
+                 draft_kv_pages: Optional[int] = None,
+                 kv_quant: str = "",
+                 draft_quant: str = ""):
         import jax
 
         from ..models.generate import decode_config
@@ -415,8 +461,21 @@ class DecodeEngine:
             raise ValueError(
                 f"kv_pages {self.n_pages} < blocks per max-length "
                 f"request {self.n_blocks}")
-        self.cfg = dataclasses.replace(base, kv_page_size=ps,
-                                       kv_pages=self.n_pages)
+        if kv_quant not in ("", "int8"):
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r} (expected '' or 'int8')")
+        if draft_quant not in ("", "int8"):
+            raise ValueError(
+                f"unknown draft_quant {draft_quant!r} "
+                "(expected '' or 'int8')")
+        # int8 paged KV (kv_quant="int8"): the pool's K/V entries store
+        # as int8 with per-token f32 scale planes beside the pages —
+        # models/transformer.py quantize-on-write / dequant-on-gather.
+        # Independent of weight quant; both the target and draft pools
+        # follow it (the draft cfg derives from self.cfg below).
+        self.cfg = dataclasses.replace(
+            base, kv_page_size=ps, kv_pages=self.n_pages,
+            kv_quant=kv_quant or base.kv_quant)
         self.name = name
         self.n_slots = n_slots
         self.chunk_tokens = chunk_tokens
@@ -467,9 +526,20 @@ class DecodeEngine:
             self.draft_cfg = dataclasses.replace(
                 self.cfg, n_layers=draft_layers,
                 kv_pages=self.draft_n_pages)
+            draft_params = truncate_layers(params, draft_layers)
+            if draft_quant == "int8" and self.cfg.quant != "int8":
+                # Draft-only weight quantization — the natural first
+                # customer (ROADMAP item 2): a wrong draft risks only
+                # accept rate, which kfx_lm_spec_accept_rate already
+                # measures, while the full-precision target keeps
+                # output quality bit-for-bit.
+                from ..models.transformer import quantize_params_int8
+
+                self.draft_cfg = dataclasses.replace(
+                    self.draft_cfg, quant="int8")
+                draft_params = quantize_params_int8(draft_params)
             self.draft_model = TransformerLM(self.draft_cfg)
-            self.draft_params = jax.device_put(
-                truncate_layers(params, draft_layers))
+            self.draft_params = jax.device_put(draft_params)
             self._draft_mgr = BlockManager(self.draft_n_pages, ps)
         else:
             self.draft_n_pages = 0
@@ -527,6 +597,8 @@ class DecodeEngine:
         self._reset_exec: Any = None
         self._draft_reset_exec: Any = None
         self._copy_exec: Any = None
+        self._quant_chaos_exec: Any = None
+        self._draft_quant_chaos_exec: Any = None
 
         self._cond = threading.Condition()
         self._queue: "deque[Request]" = deque()
@@ -546,10 +618,39 @@ class DecodeEngine:
     @property
     def kv_bytes_per_token(self) -> int:
         """KV HBM per cached token: 2 (K+V) x layers x heads x head_dim
-        x dtype bytes, plus the page's position-id word amortized."""
+        x entry bytes, plus the page's position-id word amortized.
+        Under int8 KV the entries are 1 byte each and the per-token K/V
+        scale planes add 2 x layers f32 words — ~2x fewer bytes than
+        bf16 entries, ~3.5-4x fewer than f32, which is exactly the
+        concurrent-admission multiplier at a fixed pool byte budget
+        (docs/serving.md HBM accounting)."""
         c = self.cfg
+        if c.kv_quant == "int8":
+            return (2 * c.n_layers * c.n_heads * c.head_dim
+                    + 2 * c.n_layers * 4 + 4)
         item = np.dtype(c.dtype).itemsize
         return 2 * c.n_layers * c.n_heads * c.head_dim * item + 4
+
+    def _quant_labels(self) -> Tuple[str, str]:
+        """(weights, kv) label values for the ``kfx_lm_quant_mode``
+        info gauge: ``int8``, ``draft-int8`` (only the speculative
+        draft's weights are quantized) or ``f32``."""
+        if self.cfg.quant == "int8":
+            weights = "int8"
+        elif self.spec and self.draft_cfg.quant == "int8":
+            weights = "draft-int8"
+        else:
+            weights = "f32"
+        return weights, self.cfg.kv_quant or "f32"
+
+    @property
+    def quant_mode(self) -> str:
+        """Human-readable quantization mode: "w8" (int8 weights),
+        "kv8" (int8 paged KV), "d8" (int8 draft only), joined with
+        "+", or "f32" when nothing is quantized — the Q column in
+        ``kfx top`` and the ``quant`` field of the server's JSON
+        engine block."""
+        return quant_mode_string(*self._quant_labels())
 
     def prefix_stats(self) -> Dict[str, int]:
         """Cumulative prefix-cache counters (zeros while the cache is
@@ -615,6 +716,20 @@ class DecodeEngine:
         reg.gauge("kfx_lm_kv_pages_free",
                   "KV cache pages on the free list.").set(
                       self._mgr.n_free, model=self.name)
+        # Engine truth, not a bench-derived number: capacity planning
+        # reads pool bytes = kv_pages x page_size x this gauge.
+        reg.gauge("kfx_lm_kv_bytes_per_token",
+                  "KV-cache bytes per cached token (entries + "
+                  "quantization scales + position id).").set(
+                      self.kv_bytes_per_token, model=self.name)
+        # Info-style gauge: constant 1, the mode rides the labels (the
+        # Prometheus _info idiom) — alerts join on weights/kv instead
+        # of parsing a free-form string.
+        wmode, kvmode = self._quant_labels()
+        reg.gauge("kfx_lm_quant_mode",
+                  "Quantization mode info gauge (value is constant 1; "
+                  "weights/kv labels carry the mode).").set(
+                      1, model=self.name, weights=wmode, kv=kvmode)
         # Seed the hit counter (inc 0) so --require scrapes see the
         # family before the first warm-cache admission.
         reg.counter("kfx_lm_prefix_cache_hits_total",
@@ -864,6 +979,72 @@ class DecodeEngine:
             if getattr(self, attr) is None:
                 setattr(self, attr, fn)
             return getattr(self, attr)
+
+    def _quant_chaos_fn(self, draft: bool = False):
+        """Compiled worst-case-scale injection for the
+        ``engine.kv_quant`` chaos point (int8 KV only): zeroes the
+        pool's K/V scale planes, so every already-cached entry
+        dequantizes to 0 — the maximum possible quantization error, as
+        if the write-time scales had collapsed. Structured state
+        (position ids, block tables, page refcounts) is untouched:
+        quality and accept rate degrade observably, but nothing can
+        crash or leak, and entries written AFTER the injection carry
+        fresh correct scales, so the engine self-heals as decode
+        advances (the caller also drops the prefix cache: cached
+        prompt pages are never rewritten while cached, so they would
+        otherwise stay corrupted past the injection budget)."""
+        attr = "_draft_quant_chaos_exec" if draft else "_quant_chaos_exec"
+        with self._exec_lock:
+            fn = getattr(self, attr)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        def run(cache):
+            flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+            leaves = []
+            for path, leaf in flat:
+                name = getattr(path[-1], "key", str(path[-1]))
+                if name in ("key_scale", "value_scale"):
+                    leaf = jnp.zeros_like(leaf)
+                leaves.append(leaf)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        donate = (0,) if self._donate else ()
+        fn = jax.jit(run, donate_argnums=donate).lower(
+            self._cache_specs(draft)).compile()
+        with self._exec_lock:
+            if getattr(self, attr) is None:
+                setattr(self, attr, fn)
+            return getattr(self, attr)
+
+    def _maybe_kv_quant_chaos(self) -> None:
+        """Draw the ``engine.kv_quant`` point once per hot iteration
+        while the pool is int8 — a hit crushes BOTH pools' scale
+        planes (docs/chaos.md)."""
+        if self.cfg.kv_quant != "int8":
+            return
+        inj = chaos.draw("engine.kv_quant", target=self.name)
+        if inj is None:
+            return
+        if inj.delay > 0:
+            time.sleep(inj.delay)
+        if inj.mode == "delay":
+            return
+        self._cache = self._quant_chaos_fn()(self._cache)
+        if self._prefix is not None:
+            # The crush corrupts CACHED prompt pages too, and a cached
+            # page is never rewritten while cached — drop the whole
+            # prefix cache so the corruption cannot outlive the
+            # injection through future admissions (freed pages land on
+            # the dirty set and are position-invalidated before reuse;
+            # live slots keep their own refs and stay degraded only
+            # for their own lifetime, which IS the injected fault).
+            self._prefix.drop_all()
+        if self.spec:
+            self._draft_cache = self._quant_chaos_fn(draft=True)(
+                self._draft_cache)
 
     def _copy_fn(self):
         """Compiled copy-on-write: clones page ``src`` into ``dst``
@@ -1795,6 +1976,7 @@ class DecodeEngine:
         if not self._active_count():
             self._touch_gauges()
             return
+        self._maybe_kv_quant_chaos()
         k = self.propose_tokens
         draft_live = self._spec_ok & self._active
         spec_on = np.zeros_like(draft_live) if wave_off else draft_live
@@ -1868,6 +2050,7 @@ class DecodeEngine:
         self._ensure_chunk_pages()
         if not self._active_count():
             return  # every slot preempted away
+        self._maybe_kv_quant_chaos()
         oldest = min((r for r in self._slots if r is not None),
                      key=lambda r: r.t_enqueue)
         n_active = self._active_count()

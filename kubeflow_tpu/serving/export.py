@@ -2,8 +2,20 @@
 
 A directory with:
   config.json   — model name + input shape + classes (enough to rebuild the
-                  flax module via the registry)
+                  flax module via the registry), a ``format_version``
+                  (missing = v1, the pre-versioning layout) and, when the
+                  export is quantized, a ``quant`` block describing the
+                  scheme
   params.msgpack — flax-serialized {params, batch_stats}
+
+``quantize="int8"`` stores every kernel as per-output-channel symmetric
+int8 (the int8 tensor plus an f32 scale per output channel ride the
+msgpack payload as a ``{"q", "scale"}`` pair) — a ~4x smaller artifact
+for f32 params. Classifier servers rebuild full-precision modules, so
+``load_exported`` dequantizes transparently on load (auto-detected from
+the quant block; an f32 export round-trips byte-identically, untouched).
+The LM export (serving/lm_server.py) instead keeps its quantized params
+AS int8 for the transformer's dequant-fused matmul path.
 
 The reference's storage-initializer downloads from GCS/S3/PVC
 (SURVEY.md §2.1 KFServing controller); here `file://` paths cover the
@@ -15,37 +27,120 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
+# v1: unversioned {model, input_shape, num_classes} config. v2: adds
+# format_version + the optional quant block. Loaders treat a missing
+# field as v1 — every pre-versioning export stays loadable.
+FORMAT_VERSION = 2
+
+
+def quantize_tree_int8(tree: Any) -> Any:
+    """Per-output-channel symmetric int8 quantization of a generic
+    param tree: every array leaf NAMED "kernel" with >= 2 dims becomes
+    a ``{"q": int8, "scale": f32[out]}`` marker dict (the last axis is
+    the output-channel axis for Dense [in, out] and Conv
+    [kh, kw, cin, cout] kernels alike). Biases, norm scales and
+    batch_stats pass through untouched. The input tree is not
+    mutated."""
+    from ..models.transformer import quantize_leaf_int8
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "kernel" and not isinstance(v, dict):
+                    w = np.asarray(jax.device_get(v))
+                    if w.ndim >= 2 and w.dtype != np.int8:
+                        # One scale formula for the whole repo —
+                        # models/transformer.quantize_leaf_int8.
+                        q, scale = quantize_leaf_int8(w, 1)
+                        out[k] = {"q": np.asarray(q),
+                                  "scale": np.asarray(scale)}
+                        continue
+                out[k] = walk(v)
+            return out
+        return node
+
+    return walk(tree)
+
+
+def dequantize_tree_int8(tree: Any) -> Any:
+    """Inverse of ``quantize_tree_int8`` (up to quantization error):
+    ``{"q", "scale"}`` marker dicts expand back to f32 kernels."""
+    from ..models.transformer import dequantize_leaf_int8
+
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node) == {"q", "scale"}:
+                q = np.asarray(node["q"])
+                if q.dtype == np.int8:
+                    return np.asarray(
+                        dequantize_leaf_int8(q, node["scale"], 1))
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(tree)
+
 
 def export_params(directory: str, model_name: str, input_shape, num_classes: int,
-                  state: Any) -> str:
+                  state: Any, quantize: str = "") -> str:
     """Write a servable export from a TrainState (or any object with
-    .params / .batch_stats)."""
+    .params / .batch_stats). ``quantize="int8"`` stores per-channel
+    int8 kernels + f32 scales (dequantized transparently on load);
+    the default f32 export is unchanged bytes-for-bytes apart from the
+    new ``format_version`` field."""
+    if quantize not in ("", "int8"):
+        raise ValueError(
+            f"unknown quantize {quantize!r} (expected '' or 'int8')")
     os.makedirs(directory, exist_ok=True)
+    params = jax.device_get(state.params)
+    if quantize == "int8":
+        params = quantize_tree_int8(params)
     payload = {
-        "params": jax.device_get(state.params),
+        "params": params,
         "batch_stats": jax.device_get(state.batch_stats),
     }
     with open(os.path.join(directory, "params.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(payload))
+    config: Dict[str, Any] = {"model": model_name,
+                              "input_shape": list(input_shape),
+                              "num_classes": int(num_classes),
+                              "format_version": FORMAT_VERSION}
+    if quantize == "int8":
+        config["quant"] = {"weights": "int8",
+                           "scheme": "per_channel_symmetric"}
     with open(os.path.join(directory, "config.json"), "w") as f:
-        json.dump({"model": model_name,
-                   "input_shape": list(input_shape),
-                   "num_classes": int(num_classes)}, f)
+        json.dump(config, f)
     return directory
+
+
+def export_format_version(config: Dict[str, Any]) -> int:
+    """Tolerant version read: pre-versioning exports (no field) are
+    v1; anything newer declares itself."""
+    try:
+        return int(config.get("format_version", 1))
+    except (TypeError, ValueError):
+        return 1
 
 
 def load_exported(uri: str) -> Tuple[Dict, Any]:
     """Load an export. Returns (config, variables={params, batch_stats}).
-    Accepts a bare path or file:// URI."""
+    Accepts a bare path or file:// URI. Quantized exports (the config's
+    ``quant`` block, v2+) are dequantized here: classifier servers
+    rebuild full-precision modules, so the quantization is an artifact/
+    transfer encoding at this layer, not a serving dtype."""
     path = uri[len("file://"):] if uri.startswith("file://") else uri
     with open(os.path.join(path, "config.json")) as f:
         config = json.load(f)
     with open(os.path.join(path, "params.msgpack"), "rb") as f:
         payload = serialization.msgpack_restore(f.read())
+    quant: Optional[Dict] = config.get("quant")
+    if quant and quant.get("weights") == "int8":
+        payload = dict(payload)
+        payload["params"] = dequantize_tree_int8(payload.get("params"))
     return config, payload

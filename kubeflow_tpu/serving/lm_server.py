@@ -46,22 +46,52 @@ from .server import Predictor
 CONFIG_FILE = "lm_config.json"
 PARAMS_FILE = "params.msgpack"
 
-def export_lm(directory: str, cfg, params) -> str:
-    """Write a servable LM export from train-time config + params."""
+def export_lm(directory: str, cfg, params, quantize: str = "") -> str:
+    """Write a servable LM export from train-time config + params.
+
+    ``quantize="int8"`` rewrites the attention/MLP/lm_head kernels to
+    per-output-channel symmetric int8 + f32 scales
+    (models/transformer.quantize_params_int8) and flips the exported
+    config's ``quant`` knob, so the loaded model runs the dequant-fused
+    matmul path directly on the int8 tensors — a ~4x smaller artifact
+    for f32 params AND 4x less weight HBM at serving. The config
+    carries ``format_version`` (missing = v1) and a ``quant`` block;
+    the default f32 export is unchanged and auto-detected on load."""
     import jax
 
+    from ..serving.export import FORMAT_VERSION
+
+    if quantize not in ("", "int8"):
+        raise ValueError(
+            f"unknown quantize {quantize!r} (expected '' or 'int8')")
     os.makedirs(directory, exist_ok=True)
+    if quantize == "int8" and cfg.quant != "int8":
+        from ..models.transformer import quantize_params_int8
+
+        params = quantize_params_int8(params)
+        cfg = dataclasses.replace(cfg, quant="int8")
     d = dataclasses.asdict(cfg)
     d["dtype"] = jnp.dtype(cfg.dtype).name
     d["param_dtype"] = jnp.dtype(cfg.param_dtype).name
+    meta: Dict[str, Any] = {"framework": "lm",
+                            "format_version": FORMAT_VERSION,
+                            "config": d}
+    if cfg.quant == "int8":
+        meta["quant"] = {"weights": "int8",
+                         "scheme": "per_channel_symmetric"}
     with open(os.path.join(directory, CONFIG_FILE), "w") as f:
-        json.dump({"framework": "lm", "config": d}, f)
+        json.dump(meta, f)
     with open(os.path.join(directory, PARAMS_FILE), "wb") as f:
         f.write(serialization.to_bytes(jax.device_get(params)))
     return directory
 
 
 def load_lm(directory: str):
+    """Load an LM export. Tolerant of every format generation: v1
+    exports carry neither ``format_version`` nor the quant knobs (the
+    TransformerConfig defaults reconstruct them as f32); a quantized
+    v2 export's config round-trips ``quant="int8"`` so the rebuilt
+    model expects exactly the int8+scale param structure on disk."""
     from ..models.transformer import TransformerConfig
 
     with open(os.path.join(directory, CONFIG_FILE)) as f:
@@ -152,6 +182,19 @@ class LMPredictor(Predictor):
         self.spec_layers = int(os.environ.get("KFX_LM_SPEC_LAYERS", "0"))
         self.spec_tokens = int(os.environ.get("KFX_LM_SPEC_TOKENS", "4"))
         self.spec_pages = int(os.environ.get("KFX_LM_SPEC_PAGES", "0"))
+        # Quantization knobs (docs/serving.md). KFX_LM_QUANT: "" =
+        # follow the export's quant block; "int8" = quantize an f32
+        # export's weights at load (per-channel symmetric, no
+        # re-export needed); "0" = the escape hatch — DEQUANTIZE an
+        # int8 export at load and serve the full-precision path.
+        # KFX_LM_KV_QUANT="int8" stores the engine's paged KV pools as
+        # int8 (+ per-token scale planes); engine-only — the one-shot
+        # oracle keeps its dense full-precision cache.
+        # KFX_LM_QUANT_DRAFT="int8" quantizes only the speculative
+        # DRAFT's weights (accept rate is the only thing at risk).
+        self.quant = os.environ.get("KFX_LM_QUANT", "")
+        self.kv_quant = os.environ.get("KFX_LM_KV_QUANT", "")
+        self.draft_quant = os.environ.get("KFX_LM_QUANT_DRAFT", "")
         self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
@@ -161,6 +204,20 @@ class LMPredictor(Predictor):
         import jax
 
         cfg, params = load_lm(self.model_dir)
+        if self.quant == "int8" and cfg.quant != "int8":
+            # Load-time quantization of an f32 export: same per-channel
+            # scheme as a quantized export, no re-export required.
+            from ..models.transformer import quantize_params_int8
+
+            params = quantize_params_int8(params)
+            cfg = dataclasses.replace(cfg, quant="int8")
+        elif self.quant == "0" and cfg.quant == "int8":
+            # Escape hatch: expand an int8 export back to f32 kernels
+            # and serve the full-precision path (quality triage).
+            from ..models.transformer import dequantize_params_int8
+
+            params = dequantize_params_int8(params)
+            cfg = dataclasses.replace(cfg, quant="")
         if self.device == "cpu":
             params = jax.device_put(params, jax.devices("cpu")[0])
         self.vocab_size = cfg.vocab_size
@@ -187,7 +244,9 @@ class LMPredictor(Predictor):
                 prefix_cache=self.prefix_cache,
                 draft_layers=draft,
                 propose_tokens=max(1, self.spec_tokens),
-                draft_kv_pages=self.spec_pages or None)
+                draft_kv_pages=self.spec_pages or None,
+                kv_quant="int8" if self.kv_quant == "int8" else "",
+                draft_quant="int8" if self.draft_quant == "int8" else "")
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".

@@ -37,7 +37,7 @@ from .. import chaos
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import SPAN_HEADER, TRACE_HEADER
-from .engine import EngineOverloaded
+from .engine import EngineOverloaded, quant_mode_string
 
 request_log = logging.getLogger("kfx.serving")
 
@@ -510,17 +510,27 @@ class ModelServer:
         concurrency the router's in-flight count cannot see). Empty for
         classifier servers: the operator stops polling on first sight
         of an empty block."""
-        out: Dict[str, Dict[str, float]] = {}
+        out: Dict[str, Dict[str, Any]] = {}
         for family, field in (("kfx_lm_queue_depth", "queue_depth"),
                               ("kfx_lm_slot_occupancy", "slot_occupancy"),
                               ("kfx_lm_slots", "slots"),
                               ("kfx_lm_kv_pages", "kv_pages"),
                               ("kfx_lm_kv_pages_free", "kv_pages_free"),
+                              ("kfx_lm_kv_bytes_per_token",
+                               "kv_bytes_per_token"),
                               ("kfx_lm_spec_accept_rate",
                                "spec_accept_rate")):
             for labels, value in self.metrics.gauge(family).samples():
                 model = labels.get("model", "")
                 out.setdefault(model, {})[field] = value
+        # Quantization info gauge: the mode rides the labels; the JSON
+        # block renders it as the `kfx top` Q-column string ("w8",
+        # "kv8", "w8+kv8", "d8", or "f32") via the one shared mapping.
+        for labels, _ in self.metrics.gauge(
+                "kfx_lm_quant_mode").samples():
+            model = labels.get("model", "")
+            out.setdefault(model, {})["quant"] = quant_mode_string(
+                labels.get("weights", "f32"), labels.get("kv", "f32"))
         return out
 
     def _finish_request(self, h, name: str, verb: str, t0: float) -> None:

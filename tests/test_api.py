@@ -395,6 +395,38 @@ spec:
                            match=r"spec\.canary\.speculative"):
             load_manifests(bad)
 
+    def test_quantization_field_paths(self):
+        """spec.predictor.quantization {weights, kv}: each must be the
+        string 'int8' or 'f32', with field-path errors; booleans and
+        bare ints (`weights: true`, `kv: 8`) are 400s at apply, never
+        a stringified surprise at revision startup."""
+        ok = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    quantization: {weights: int8, kv: int8}\n",
+            1)
+        (isvc,) = load_manifests(ok)
+        assert isvc.predictor()["quantization"] == {"weights": "int8",
+                                                    "kv": "int8"}
+        for bad_val, path in (
+                ("{weights: true}", "quantization.weights"),
+                ("{weights: 8}", "quantization.weights"),
+                ("{weights: int4}", "quantization.weights"),
+                ("{kv: false}", "quantization.kv"),
+                ("{kv: 1.5}", "quantization.kv"),
+                ("int8", r"spec\.predictor\.quantization")):
+            bad = self.ISVC_YAML.replace(
+                "predictor:\n",
+                f"predictor:\n    quantization: {bad_val}\n", 1)
+            with pytest.raises(ValidationError, match=path):
+                load_manifests(bad)
+        # The canary revision is validated on its own field path.
+        bad = self.ISVC_YAML + (
+            "  canary:\n    quantization: {weights: yes}\n"
+            "    jax: {storageUri: 'file:///tmp/models/resnet'}\n")
+        with pytest.raises(ValidationError,
+                           match=r"spec\.canary\.quantization"):
+            load_manifests(bad)
+
     def test_custom_predictor_requires_command(self):
         """A command-less custom container would crash the operator's
         spawn loop; it must be a 400 at apply time."""

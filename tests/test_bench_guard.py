@@ -102,6 +102,29 @@ class TestBoxGuard:
                     "lm_spec_speedup", "lm_spec_b4_speedup"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_quant_keys_in_contract(self):
+        """The quantized-serving acceptance numbers (ISSUE 11: tokens/s
+        AND a perplexity delta per variant — speed never silently buys
+        accuracy loss — plus the byte-budget admission multiplier int8
+        KV earns and the quantized-draft leg) ride the compact
+        BENCH_CONTRACT line; pinned like the paged-KV keys."""
+        # ppl_f32 is the DENOMINATOR of the documented tolerance
+        # (ppl_delta / ppl_f32 <= 0.10, docs/serving.md) — without it
+        # on the contract line the deltas are uncheckable.
+        for key in ("lm_quant_base_tokens_per_s", "lm_quant_ppl_f32",
+                    "lm_quant_w8_tokens_per_s", "lm_quant_w8_speedup",
+                    "lm_quant_w8_ppl_delta",
+                    "lm_quant_kv8_tokens_per_s",
+                    "lm_quant_kv8_ppl_delta",
+                    "lm_quant_kv8_admit_ratio",
+                    "lm_quant_w8kv8_tokens_per_s",
+                    "lm_quant_w8kv8_ppl_delta",
+                    "lm_quant_weight_bytes_ratio",
+                    "lm_quant_draft8_tokens_per_s",
+                    "lm_quant_draft8_accept_rate",
+                    "lm_quant_draft8_speedup"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_lm_mfu_keys_in_contract(self):
         """The training-MFU acceptance numbers (ISSUE 8: lm_best_mfu >=
         0.60, lm_long_mfu >= 0.45, no step-time-variance regression)

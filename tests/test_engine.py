@@ -353,6 +353,228 @@ class TestPagedKV:
 
 
 @pytest.fixture(scope="module")
+def kv8_engine(tiny_lm):
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg, params = tiny_lm
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                       name="kv8", kv_page_size=16, kv_quant="int8")
+    yield eng
+    eng.close()
+
+
+class TestInt8KV:
+    """int8 paged KV (kv_quant="int8"): quantize-on-write /
+    dequant-on-gather with per-token scale planes beside the pool.
+    The quantized engine is a DIFFERENT model than the f32 oracle —
+    drift vs the oracle is BOUNDED, not byte-exact — but the
+    quantization round trip is deterministic per written token, so
+    everything the page machinery does (prefix sharing, COW,
+    recycling, preemption-by-recompute, speculative windows) must be
+    INVISIBLE: byte-identical outputs against an int8 engine that
+    never exercised that machinery."""
+
+    def test_greedy_drift_bounded_vs_oracle(self, tiny_lm, kv8_engine):
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        prompts = [[5, 9, 11, 3, 7], [2], [1, 2, 3, 4, 5, 6, 7, 8, 9],
+                   [13, 14]]
+        out = kv8_engine.generate(prompts, max_new_tokens=12)
+        ref = [gen.generate([p], max_new_tokens=12)[0] for p in prompts]
+        # Bounded drift: every rollout completes, starts on the
+        # oracle's token, and tracks it for most of the window (int8
+        # KV error can flip a near-tie argmax mid-rollout, after
+        # which greedy trajectories legitimately diverge).
+        assert [len(o) for o in out] == [12] * 4
+        agrees = [sum(a == b for a, b in zip(o, r)) / 12
+                  for o, r in zip(out, ref)]
+        assert all(o[0] == r[0] for o, r in zip(out, ref))
+        assert sum(agrees) / len(agrees) >= 0.5, agrees
+        # Deterministic: the quantized engine agrees with itself.
+        assert kv8_engine.generate(prompts, max_new_tokens=12) == out
+        # The pool really is int8 + scale planes, and the accounting
+        # gauge reflects it (entries 1 byte + 2 scale words + pos).
+        import jax
+
+        names = {getattr(p[-1], "key", "") for p, _ in
+                 jax.tree_util.tree_flatten_with_path(
+                     kv8_engine._cache)[0]}
+        assert {"key_scale", "value_scale"} <= names
+        c = kv8_engine.cfg
+        assert kv8_engine.kv_bytes_per_token == \
+            2 * c.n_layers * c.n_heads * c.head_dim \
+            + 2 * c.n_layers * 4 + 4
+        assert kv8_engine.quant_mode == "kv8"
+
+    def test_admits_1_8x_on_same_pool_bytes(self, tiny_lm):
+        """The acceptance criterion: at the SAME page-pool byte
+        budget, int8 KV admits >= 1.8x the concurrent requests of the
+        f32 pool (page-gated admission — fewer bytes per token means
+        more pages in the budget, and admission follows pages)."""
+        import numpy as np
+
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+
+        def peak_admission(kv_quant, n_pages):
+            eng = DecodeEngine(cfg, params, n_slots=8, chunk_tokens=4,
+                               name="lm", kv_page_size=16,
+                               kv_pages=n_pages, prefix_cache=False,
+                               kv_quant=kv_quant)
+            try:
+                # 20-token prompts (bucket 32) + 8 new tokens: 3 pages
+                # per request, so the pool, not n_slots, is the limit.
+                prompts = [[(7 * i + j) % 60 for j in range(20)]
+                           for i in range(8)]
+                reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+                peak, deadline = 0, time.monotonic() + 60
+                while (not all(r.done() for r in reqs)
+                       and time.monotonic() < deadline):
+                    peak = max(peak, eng._active_count())
+                    time.sleep(0.001)
+                for r in reqs:
+                    assert len(r.result(60)) == 8
+                return peak, eng.kv_bytes_per_token
+            finally:
+                eng.close()
+
+        f32_pages = 8
+        peak_f32, bpt_f32 = peak_admission("", f32_pages)
+        budget = f32_pages * 16 * bpt_f32  # the f32 pool's bytes
+        # Same byte budget buys ~3.5x the pages at int8 (f32 entries).
+        probe = DecodeEngine(cfg, params, n_slots=1, kv_page_size=16,
+                             kv_pages=4, name="probe", kv_quant="int8")
+        try:
+            int8_pages = budget // (16 * probe.kv_bytes_per_token)
+        finally:
+            probe.close()
+        peak_i8, _ = peak_admission("int8", int(int8_pages))
+        assert peak_i8 >= 1.8 * peak_f32, (
+            f"int8 KV admitted {peak_i8} concurrent vs f32 {peak_f32} "
+            f"on the same {budget}-byte pool — < 1.8x")
+
+    def test_page_machinery_invisible_under_int8(self, tiny_lm):
+        """Prefix sharing (incl. COW boundary pages), page recycling
+        and preemption-by-recompute all write/rewrite the SAME
+        quantized values a machinery-free engine writes, so outputs
+        are byte-identical to a big-pool, cache-off int8 engine — the
+        int8 analogue of the PR-7 oracle-parity contract, plus leak
+        accounting for the pool (scale planes live in the cache
+        pytree, pages are the only allocation unit)."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        plain = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                             name="plain8", kv_page_size=16,
+                             prefix_cache=False, kv_quant="int8")
+        system = [(7 * i + 3) % 60 for i in range(36)]  # 2.25 pages
+        shared = [system + [60 + i] for i in range(3)]
+        grow = [[i + 1, i + 2, i + 3] for i in range(4)]
+        try:
+            ref_shared = plain.generate(shared, max_new_tokens=8)
+            ref_grow = plain.generate(grow, max_new_tokens=40)
+        finally:
+            plain.close()
+        # (1) prefix cache + COW: byte-identical to the cache-off run.
+        cache_on = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                                name="cow8", kv_page_size=16,
+                                kv_quant="int8")
+        try:
+            assert cache_on.generate(shared, max_new_tokens=8) == \
+                ref_shared
+            hits = cache_on._prefix.hits
+            assert cache_on.generate(shared, max_new_tokens=8) == \
+                ref_shared  # second wave rides fully cached pages
+            assert cache_on._prefix.hits > hits
+        finally:
+            cache_on.close()
+        # (2) recycle + preemption: a small pool (8 pages) forces both
+        # across these waves; outputs must match the big-pool engine.
+        small = DecodeEngine(cfg, params, n_slots=8, chunk_tokens=4,
+                             name="small8", kv_page_size=16, kv_pages=8,
+                             prefix_cache=False, kv_quant="int8")
+        try:
+            assert small.generate(shared, max_new_tokens=8) == ref_shared
+            assert small.generate(grow, max_new_tokens=40) == ref_grow
+            assert small._reg().counter(
+                "kfx_lm_kv_preemptions_total").value(model="small8") >= 1
+            # Leak accounting: every page (and with it every scale
+            # plane entry) is back on the free list after the drain.
+            assert small._mgr.n_free == small.n_pages
+        finally:
+            small.close()
+
+    def test_spec_verify_parity_under_int8(self, tiny_lm):
+        """Speculative decode under int8 KV: the verify window writes
+        and reads the same quantized entries sequential decode would,
+        so greedy spec output is byte-identical to the NON-speculative
+        int8 engine (the standing parity contract, one level down),
+        with both pools drained leak-free — including a quantized
+        draft (draft_quant), which may only move the accept rate."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        prompts = [[5, 9, 11, 3, 7], [2], [13, 14]]
+        base = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                            name="b8", kv_page_size=16, kv_quant="int8")
+        try:
+            ref = base.generate(prompts, max_new_tokens=12)
+        finally:
+            base.close()
+        spec = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                            name="s8", kv_page_size=16, kv_quant="int8",
+                            draft_layers=1, draft_quant="int8")
+        try:
+            assert spec.quant_mode == "d8+kv8"
+            assert spec.draft_cfg.quant == "int8"
+            assert spec.draft_cfg.kv_quant == "int8"
+            assert spec.generate(prompts, max_new_tokens=12) == ref
+            assert spec._mgr.n_free == spec.n_pages - 1  # prefix pin
+            assert spec._draft_mgr.n_free == spec.draft_n_pages
+        finally:
+            spec.close()
+
+    def test_chaos_kv_quant_degrades_never_crashes(self, tiny_lm):
+        """The engine.kv_quant point crushes the cached scale planes
+        (worst-case quantization error: history dequantizes to 0).
+        Quality visibly degrades — the outputs change — but every
+        request completes full-length, nothing leaks, and the engine
+        self-heals once the budget drains — INCLUDING the prefix
+        cache, whose pinned pages are never rewritten while cached and
+        are therefore dropped on a hit rather than served corrupted to
+        future admissions."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                           name="c8", kv_page_size=16, kv_quant="int8")
+        prompts = [[5, 9, 11, 3, 7], [1, 2, 3, 4]]
+        try:
+            eng.warm([8])
+            clean = eng.generate(prompts, max_new_tokens=12)
+            assert len(eng._prefix) > 0  # prompts are cached
+            chaos.install(chaos.parse_spec("engine.kv_quant:count=2"))
+            hit = eng.generate(prompts, max_new_tokens=12)
+            assert chaos.injected_counts().get("engine.kv_quant") >= 1
+            chaos.reset()
+            assert [len(o) for o in hit] == [12, 12]
+            assert hit != clean  # degradation is observable
+            # The crush dropped the cache: no future admission can
+            # match a corrupted page (the fault dies with its budget).
+            assert len(eng._prefix) == 0
+            assert eng._mgr.n_free == eng.n_pages  # no leak
+            # Self-healed: the next run re-prefills fresh pages and
+            # reproduces the clean outputs byte-for-byte.
+            assert eng.generate(prompts, max_new_tokens=12) == clean
+        finally:
+            chaos.reset()
+            eng.close()
+
+
+@pytest.fixture(scope="module")
 def spec_engine(tiny_lm):
     """Module-scoped speculative engine: 1-layer draft off the 2-layer
     target, 4-token proposals. Every test drains its requests, so the
@@ -778,6 +1000,8 @@ class TestEngineServing:
                           "--require", "kfx_lm_engine_chunks_total",
                           "--require", "kfx_lm_kv_pages",
                           "--require", "kfx_lm_kv_pages_free",
+                          "--require", "kfx_lm_kv_bytes_per_token",
+                          "--require", "kfx_lm_quant_mode",
                           "--require", "kfx_lm_prefix_cache_hits_total",
                           "--require", "kfx_lm_spec_proposed_total",
                           "--require", "kfx_lm_spec_accepted_total",
@@ -817,6 +1041,44 @@ class TestEngineServing:
                                  "stop_token": 3})
         finally:
             engine.close()
+
+    def test_quantized_predictor_env_to_engine_block(self, tiny_lm,
+                                                     tmp_path,
+                                                     monkeypatch):
+        """KFX_LM_QUANT=int8 + KFX_LM_KV_QUANT=int8 on an f32 export:
+        the predictor quantizes at load (no re-export), the engine
+        runs w8+kv8, :generate serves, and the mode surfaces in the
+        server's JSON engine block (what the operator samples for
+        `kfx top`'s Q column)."""
+        from kubeflow_tpu.serving.lm_server import LMPredictor, export_lm
+        from kubeflow_tpu.serving.server import ModelServer
+
+        cfg, params = tiny_lm
+        export_lm(str(tmp_path / "lm"), cfg, params)
+        monkeypatch.setenv("KFX_LM_ENGINE", "1")
+        monkeypatch.setenv("KFX_LM_QUANT", "int8")
+        monkeypatch.setenv("KFX_LM_KV_QUANT", "int8")
+        p = LMPredictor(str(tmp_path / "lm"), name="lm",
+                        warm_buckets=[8])
+        p.load()
+        srv = ModelServer(port=0)
+        srv.register(p)
+        srv.start()
+        try:
+            assert p._engine.cfg.quant == "int8"
+            assert p._engine.quant_mode == "w8+kv8"
+            body = self._generate(srv.port,
+                                  {"prompt_tokens": [[5, 9, 11]],
+                                   "max_new_tokens": 6})
+            assert len(body["generated_tokens"][0]) == 6
+            blk = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics?format=json",
+                timeout=30))["engine"]["lm"]
+            assert blk["quant"] == "w8+kv8"
+            assert blk["kv_bytes_per_token"] == \
+                p._engine.kv_bytes_per_token
+        finally:
+            srv.stop()
 
     def test_overload_is_503_with_retry_after(self, tiny_lm, tmp_path,
                                               monkeypatch):

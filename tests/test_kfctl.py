@@ -148,7 +148,8 @@ class TestKfxVerbs:
             "readyReplicas": {"default": 2},
             "autoscaling": {"default": {
                 "desired": 2, "target": 8,
-                "kvUtil": 0.42, "specAcceptRate": 0.87}},
+                "kvUtil": 0.42, "specAcceptRate": 0.87,
+                "quant": "w8+kv8"}},
         }
         clf = InferenceService.from_dict({
             "metadata": {"name": "clf", "namespace": "default"},
@@ -159,7 +160,11 @@ class TestKfxVerbs:
                                                   "target": 8}}}
         rows = _serving_top_rows([lm, clf])
         assert rows[0][6] == "42%" and rows[0][7] == "87%"
+        # Q column: the engine's quantization mode; "-" when the
+        # operator never sampled one (classifier revisions).
+        assert rows[0][8] == "w8+kv8"
         assert rows[1][6] == "-" and rows[1][7] == "-"
+        assert rows[1][8] == "-"
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main

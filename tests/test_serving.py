@@ -505,6 +505,41 @@ class TestRouter:
             backend.shutdown()
 
 
+class TestQuantEnvPlumbing:
+    def test_quantization_spec_exports_env(self):
+        """spec.predictor.quantization -> the replica's KFX_LM_QUANT /
+        KFX_LM_KV_QUANT env (the knobs LMPredictor reads at load):
+        int8 opts in, f32 is the manifest-level escape hatch (exported
+        as the predictor's "0"), absent fields export nothing, and
+        non-predictor roles export nothing."""
+        from kubeflow_tpu.operators.serving import _Revision
+
+        rev = _Revision(name="default", model_name="m", model_dir="d",
+                        workdir="w", batcher=None,
+                        quantization={"weights": "int8", "kv": "int8"})
+        env: dict = {}
+        rev._quant_env(env)
+        assert env == {"KFX_LM_QUANT": "int8",
+                       "KFX_LM_KV_QUANT": "int8"}
+        env = {}
+        rev.quantization = {"weights": "f32"}
+        rev._quant_env(env)
+        assert env == {"KFX_LM_QUANT": "0"}
+        env = {}
+        rev.quantization = {"kv": "f32"}
+        rev._quant_env(env)
+        assert env == {"KFX_LM_KV_QUANT": "0"}
+        env = {}
+        rev.quantization = None
+        rev._quant_env(env)
+        assert env == {}
+        rev.quantization = {"weights": "int8"}
+        rev.role = "transformer"
+        env = {}
+        rev._quant_env(env)
+        assert env == {}
+
+
 @pytest.mark.slow
 class TestInferenceServiceE2E:
     def test_speculative_spec_exports_env(self):

@@ -262,3 +262,100 @@ class TestMultiFormatRemote:
         finally:
             srv.shutdown()
             srv.server_close()
+
+
+class TestQuantizedExport:
+    """ISSUE-11 export vertical: the quantize="int8" knob on both
+    export formats, the new format_version field, and the tolerant
+    loaders (a pre-versioning export has neither field and still
+    loads as v1 f32)."""
+
+    def test_classifier_int8_roundtrip_and_version(self, export_dir,
+                                                   tmp_path):
+        import json
+
+        import numpy as np
+
+        from kubeflow_tpu.data import get_dataset
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.serving.export import (
+            export_format_version, export_params, load_exported)
+        from kubeflow_tpu.training import TrainLoop
+
+        ds = get_dataset("mnist")
+        model = get_model("mlp", num_classes=ds.num_classes)
+        state = TrainLoop(model).init_state(ds.shape)
+        qdir = tmp_path / "q"
+        export_params(str(qdir), "mlp", ds.shape, ds.num_classes, state,
+                      quantize="int8")
+        cfg, payload = load_exported(str(qdir))
+        assert export_format_version(cfg) >= 2
+        assert cfg["quant"]["weights"] == "int8"
+        # Dequantized on load: same structure, f32 kernels within the
+        # per-channel quantization tolerance of the original.
+        import jax
+
+        orig = jax.device_get(state.params)
+        flat_o = jax.tree_util.tree_leaves(orig)
+        flat_q = jax.tree_util.tree_leaves(payload["params"])
+        assert len(flat_o) == len(flat_q)
+        for a, b in zip(flat_o, flat_q):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape
+            span = float(np.max(np.abs(a))) or 1.0
+            assert float(np.max(np.abs(a - b))) <= span / 127 + 1e-7
+        # The artifact really is smaller than the f32 export.
+        fdir = tmp_path / "f"
+        export_params(str(fdir), "mlp", ds.shape, ds.num_classes, state)
+        fcfg, _ = load_exported(str(fdir))
+        assert "quant" not in fcfg
+        assert (qdir / "params.msgpack").stat().st_size < \
+            0.5 * (fdir / "params.msgpack").stat().st_size
+        # v1 tolerance: strip the version field -> still loads, reads
+        # as version 1.
+        cfg_path = fdir / "config.json"
+        raw = json.loads(cfg_path.read_text())
+        raw.pop("format_version")
+        cfg_path.write_text(json.dumps(raw))
+        v1cfg, _ = load_exported(str(fdir))
+        assert export_format_version(v1cfg) == 1
+
+    def test_lm_int8_export_roundtrip(self, tmp_path):
+        import json
+
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.models.transformer import (
+            TransformerLM, params_quantized, preset_config)
+        from kubeflow_tpu.serving.lm_server import export_lm, load_lm
+
+        cfg = preset_config("tiny", max_seq_len=64)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
+        qdir = tmp_path / "lm-q"
+        export_lm(str(qdir), cfg, params, quantize="int8")
+        meta = json.loads((qdir / "lm_config.json").read_text())
+        assert meta["format_version"] >= 2
+        assert meta["quant"]["weights"] == "int8"
+        qcfg, qparams = load_lm(str(qdir))
+        # The LM export keeps int8 tensors AS int8 (the dequant-fused
+        # model path consumes them directly) and round-trips the
+        # config knob that selects that path.
+        assert qcfg.quant == "int8"
+        assert params_quantized(qparams)
+        # f32 export unchanged and auto-detected (quant defaults "").
+        fdir = tmp_path / "lm-f"
+        export_lm(str(fdir), cfg, params)
+        fcfg, fparams = load_lm(str(fdir))
+        assert fcfg.quant == "" and not params_quantized(fparams)
+        assert (qdir / "params.msgpack").stat().st_size < \
+            0.5 * (fdir / "params.msgpack").stat().st_size
+        # Quantized params serve: one greedy step through the rebuilt
+        # quant model produces finite logits of the right shape.
+        logits = TransformerLM(qcfg).apply(
+            {"params": qparams},
+            jax.numpy.asarray([[1, 2, 3]], jax.numpy.int32))
+        assert logits.shape == (1, 3, cfg.vocab_size)
+        assert bool(np.isfinite(np.asarray(logits)).all())
