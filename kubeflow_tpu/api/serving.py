@@ -175,6 +175,19 @@ class InferenceService(Resource):
                 if not ok:
                     raise ValidationError(f"spec.{rev}.{field}",
                                           f"must be a number > {lo:g}")
+            # Drain-before-kill window: >= 0 (0 = kill immediately, the
+            # explicit escape hatch), bool-as-number rejected like the
+            # autoscaling knobs above.
+            dw = rspec.get("drainWindowSeconds")
+            if dw is not None:
+                try:
+                    ok = float(dw) >= 0.0 and not isinstance(dw, bool)
+                except (TypeError, ValueError):
+                    ok = False
+                if not ok:
+                    raise ValidationError(
+                        f"spec.{rev}.drainWindowSeconds",
+                        "must be a number >= 0")
         sp = self.spec.get("schedulingPriority")
         if sp is not None and (isinstance(sp, bool)
                                or not isinstance(sp, int)):

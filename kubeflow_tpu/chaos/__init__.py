@@ -25,6 +25,10 @@ tested property: sites across the stack declare *fault points* —
                         scale decision cycle
     serving.cold_start  scale-from-zero spawn is    (operators/serving.py)
                         delayed
+    engine.wedge        decode loop stalls with     (serving/engine.py)
+                        slots active (liveness)
+    replica.kill        SIGKILL a serving replica   (operators/serving.py)
+                        mid-request
 
 — and a *plan* decides, deterministically, which evaluations inject.
 
@@ -89,6 +93,7 @@ KNOWN_POINTS = frozenset({
     "checkpoint.save", "checkpoint.restore",
     "serving.request", "serving.predict", "engine.admit",
     "engine.kv_alloc", "engine.spec_verify", "engine.kv_quant",
+    "engine.wedge", "replica.kill",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
 })

@@ -461,7 +461,9 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
     engine's KV-page pool utilization, speculative-decode accept rate
     and quantization mode (Q column: "w8"/"kv8"/"w8+kv8"/"d8"/"f32";
     paged LM revisions — "-" for classifiers and engines with the
-    signal absent), plus the canary traffic split."""
+    signal absent), cumulative replica restarts (crashes + liveness
+    wedge-kills, the kfx_replica_restarts_total number), plus the
+    canary traffic split."""
     rows = []
     for isvc in isvcs:
         repl = isvc.status.get("replicas") or {}
@@ -484,6 +486,8 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
                 f"{kv * 100:.0f}%" if kv is not None else "-",
                 f"{acc * 100:.0f}%" if acc is not None else "-",
                 str(a.get("quant") or "-"),
+                str(a["restarts"]) if a.get("restarts") is not None
+                else "-",
                 f"{pct}%" if rev == "canary" else "-"])
     return rows
 
@@ -494,7 +498,7 @@ def _print_serving_top(rows: List[List[str]]) -> None:
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
                         "DESIRED", "TARGET", "KV%", "ACC%", "Q",
-                        "CANARY%"])
+                        "RESTARTS", "CANARY%"])
 
 
 def _print_rollouts(isvcs) -> int:

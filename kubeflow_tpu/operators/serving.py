@@ -13,7 +13,15 @@ splitting default/canary traffic, KPA scaling on concurrency. Here:
   * readiness = the server's /v1/models/{name} probe; status conditions
     PredictorReady/Ready and status.url follow it;
   * minReplicas=0 scale-to-zero: the router's cold-request hook re-spawns
-    a replica on demand (Knative activator-lite).
+    a replica on demand (Knative activator-lite);
+  * self-healing: a LIVENESS probe distinct from readiness (/healthz
+    reporting a wedged decode loop -> SIGKILL + respawn, counted as
+    kfx_replica_restarts_total{reason="wedged"}), crash-loop backoff on
+    replica exits (reason="crashed"), and drain-before-kill on every
+    PLANNED kill — scale-in and revision respawn POST /drain and wait a
+    bounded window (spec drainWindowSeconds) so in-flight requests
+    finish or re-dispatch instead of dying with the process
+    (serving.drain span + kfx_serving_drain_seconds).
 """
 
 from __future__ import annotations
@@ -64,6 +72,10 @@ class _Replica:
     proc: subprocess.Popen
     port: int
     ready: bool = False
+    # Consecutive liveness-probe failures (/healthz answering
+    # "wedged"): distinct from readiness — a wedged decode loop keeps
+    # answering readiness probes forever.
+    live_fails: int = 0
 
 
 class _Revision:
@@ -103,6 +115,13 @@ class _Revision:
         self.replicas: List[_Replica] = []
         self.restarts = 0
         self.spawn_error = ""  # last custom-container launch failure
+        # Crash-loop backoff: each reap that finds dead replicas doubles
+        # the respawn delay (0.5s .. 30s); a replica reaching readiness
+        # resets it. last_crashes is the per-reap dead count the
+        # controller reads to attribute kfx_replica_restarts_total.
+        self.backoff_s = 0.0
+        self.backoff_until = 0.0
+        self.last_crashes = 0
         # Decode-engine queue sampling state (autoscaler load signal),
         # plus the paged-KV pool totals for `kfx top`'s KV% column and
         # the speculative accept rate for its ACC% column.
@@ -236,19 +255,33 @@ class _Revision:
         env["KFX_COMPONENT"] = f"{self.name}-{len(self.replicas)}"
 
     def reap_and_respawn(self, want: int) -> None:
-        """Keep `want` replicas alive; dead ones are replaced individually."""
+        """Keep `want` replicas alive; dead ones are replaced
+        individually, behind a crash-loop backoff: every reap that
+        finds corpses doubles the respawn delay (0.5s up to 30s, reset
+        when a replica next reaches readiness), so a replica dying at
+        startup burns a bounded spawn rate instead of fork-bombing the
+        host. The controller reads ``last_crashes`` to count
+        kfx_replica_restarts_total{reason="crashed"}."""
         alive = []
+        crashed = 0
         for r in self.replicas:
             if r.proc.poll() is None:
                 alive.append(r)
             else:
+                crashed += 1
                 self.restarts += 1
         self.replicas = alive
-        while len(self.replicas) < want:
-            before = len(self.replicas)
-            self.spawn()
-            if len(self.replicas) == before:
-                break  # launch failed (spawn_error set); retry next pass
+        self.last_crashes = crashed
+        now = time.monotonic()
+        if crashed:
+            self.backoff_s = min(max(self.backoff_s * 2, 0.5), 30.0)
+            self.backoff_until = now + self.backoff_s
+        if now >= self.backoff_until:
+            while len(self.replicas) < want:
+                before = len(self.replicas)
+                self.spawn()
+                if len(self.replicas) == before:
+                    break  # launch failed (spawn_error set); retry later
         while len(self.replicas) > want:
             r = self.replicas.pop()
             r.proc.terminate()
@@ -332,6 +365,14 @@ class InferenceServiceController(Controller):
     # How often (at most) a revision's replicas are polled for decode-
     # engine queue depth — the LM load signal beyond router concurrency.
     ENGINE_SAMPLE_PERIOD_S = 1.0
+    # Liveness (distinct from readiness): consecutive wedged /healthz
+    # verdicts before a replica is killed for restart. Two probes one
+    # reconcile apart filter a single slow-dispatch blip without
+    # stretching the restart window.
+    LIVENESS_FAILS = 2
+    # Bounded drain-before-kill window when the spec carries no
+    # drainWindowSeconds.
+    DEFAULT_DRAIN_WINDOW_S = 10.0
 
     def __init__(self, store: ResourceStore, home: str):
         super().__init__(store)
@@ -467,7 +508,15 @@ class InferenceServiceController(Controller):
                     or rev.speculative != speculative \
                     or rev.quantization != quantization:
                 if rev is not None:
+                    # Revision respawn (model/device/batcher/spec-env
+                    # change): drop the doomed replicas from the router
+                    # FIRST, then drain them within the bounded window
+                    # before the kill — in-flight requests finish or
+                    # re-dispatch; none die with the old revision.
+                    getattr(rt.router, rev_name).set_endpoints([])
+                    self._drain_revision(isvc, rev_name, rev, spec, reg)
                     rev.teardown()
+                prior_restarts = rev.restarts if rev is not None else 0
                 rev = _Revision(
                     name=rev_name,
                     model_name=isvc.name,
@@ -480,10 +529,20 @@ class InferenceServiceController(Controller):
                     speculative=speculative,
                     quantization=quantization,
                 )
+                # The restart tally is cumulative per revision NAME
+                # (matching kfx_replica_restarts_total's label): a
+                # planned spec change must not erase the history the
+                # `kfx top` RESTARTS column shows.
+                rev.restarts = prior_restarts
                 rt.revisions[rev_name] = rev
                 self.record_event(isvc, "Normal", "RevisionCreated",
                                   f"{rev_name} -> "
                                   f"{model_dir or 'custom container'}")
+                # Seed the restart family (both reasons, zero samples)
+                # so `scrape_metrics --require` holds before the first
+                # failure.
+                for reason in ("crashed", "wedged"):
+                    self._count_restarts(isvc, rev_name, 0, reason, reg)
             want = int(spec.get("minReplicas", 1))
             if want == 0 and rt.cold_hit.get(rev_name):
                 # Activator: scale from zero on traffic — and back to zero
@@ -559,11 +618,36 @@ class InferenceServiceController(Controller):
             if want < len(rev.replicas):
                 # Scale-down ordering (same rule as scale-to-zero above):
                 # drop the doomed replicas from the router BEFORE killing
-                # them, or a racing request 502s against a dead port.
+                # them, or a racing request 502s against a dead port —
+                # then DRAIN them within the bounded window so requests
+                # already inside finish (or re-dispatch retriably)
+                # instead of dying with the process.
                 backend_set.set_endpoints(
                     [f"127.0.0.1:{r.port}"
                      for r in rev.replicas[:want] if r.ready])
+                doomed = rev.replicas[want:]
+                self._drain_replicas(
+                    isvc, rev_name, doomed,
+                    self._drain_window_s(isvc.revision_spec(rev_name)),
+                    reg)
+                # Terminate the DRAINED replicas explicitly, not by
+                # count: reap's pop-while-over-want could otherwise
+                # keep a drained (one-way, permanently 503ing) replica
+                # in the fleet if a kept replica crashed in this same
+                # pass and filled the scale-down quota with its corpse.
+                del rev.replicas[want:]
+                for r in doomed:
+                    if r.proc.poll() is None:
+                        r.proc.terminate()
+            self._maybe_kill_replica(isvc, rev_name, rev)
             rev.reap_and_respawn(want)
+            if rev.last_crashes:
+                self._count_restarts(isvc, rev_name, rev.last_crashes,
+                                     "crashed", reg)
+                self.record_event(
+                    isvc, "Warning", "ReplicaCrashed",
+                    f"{rev_name}: {rev.last_crashes} replica(s) exited; "
+                    f"respawn backoff {rev.backoff_s:.1f}s")
             reg.gauge(
                 "kfx_autoscaler_replicas",
                 "Replica processes running per revision (spawned, "
@@ -579,9 +663,19 @@ class InferenceServiceController(Controller):
                     rt.reported_spawn_error[rev_name] = rev.spawn_error
                     self.record_event(isvc, "Warning", "SpawnFailed",
                                       f"{rev_name}: {rev.spawn_error}")
+            loading = [r for r in rev.replicas if not r.ready]
             ready = rev.probe()
+            if any(r.ready for r in loading):
+                # A replica spawned since the last crash REACHED
+                # readiness: that ends the crash loop, so the next
+                # crash backs off from 0.5s again. (An already-ready
+                # sibling staying up must NOT reset it, or a
+                # crash-looping replica next to one healthy peer would
+                # respawn at the floor rate forever.)
+                rev.backoff_s = 0.0
             if ready > 0 and rev_name in rt.cold_started:
                 self._finish_cold_start(isvc, rt, rev_name, reg)
+            self._probe_liveness(isvc, rev_name, rev, reg)
             # Readiness is judged against the spec's guarantee (base
             # replicas), not the autoscaler's transient target — a burst
             # must not flip a healthy, serving ISVC to NotReady while
@@ -689,7 +783,8 @@ class InferenceServiceController(Controller):
             asc.reset()
             rt.autoscaling_status[rev_name] = {
                 "desired": 0, "target": cfg.target_concurrency,
-                "panic": False, "reason": "scale-to-zero"}
+                "panic": False, "reason": "scale-to-zero",
+                "restarts": rev.restarts}
             reg.gauge(
                 "kfx_autoscaler_desired_replicas",
                 "Autoscaler target replicas per revision.",
@@ -735,6 +830,10 @@ class InferenceServiceController(Controller):
             "target": cfg.target_concurrency,
             "panic": decision.panic,
             "reason": decision.reason,
+            # Cumulative replica restarts (crashes + wedge kills) —
+            # `kfx top`'s RESTARTS column, same number the
+            # kfx_replica_restarts_total family counts.
+            "restarts": rev.restarts,
         }
         kv_util = rev.engine_kv_util
         if kv_util is not None:
@@ -753,6 +852,158 @@ class InferenceServiceController(Controller):
             status["quant"] = rev.engine_quant
         rt.autoscaling_status[rev_name] = status
         return decision.desired
+
+    # -- self-healing --------------------------------------------------------
+    def _count_restarts(self, isvc: InferenceService, rev_name: str,
+                        n: int, reason: str, reg) -> None:
+        reg.counter(
+            "kfx_replica_restarts_total",
+            "Serving replica restarts by revision and reason "
+            "(crashed = process exited, wedged = liveness kill).",
+        ).inc(n, namespace=isvc.namespace, isvc=isvc.name,
+              revision=rev_name, reason=reason)
+
+    def _probe_liveness(self, isvc: InferenceService, rev_name: str,
+                        rev: _Revision, reg) -> None:
+        """Liveness, distinct from readiness: /healthz aggregates the
+        decode-loop heartbeat, so a replica whose loop is wedged (stale
+        progress with slots active) answers 503 "wedged" while its
+        readiness route still says fine. After LIVENESS_FAILS
+        consecutive verdicts the replica is SIGKILLed — a wedged loop
+        cannot drain, so there is nothing to save — and the normal reap
+        path respawns it next reconcile (no crash backoff: a wedge kill
+        is the operator's own doing, not a crash loop)."""
+        for r in list(rev.replicas):
+            if not r.ready:
+                continue  # still loading: not probed for liveness yet
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{r.port}/healthz",
+                        timeout=1.0) as resp:
+                    body = json.load(resp)
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.load(e)
+                except ValueError:
+                    body = {}
+            except (OSError, ValueError):
+                # Connection-level failure = the process is dying or
+                # dead — the crash path's business, not a wedge.
+                continue
+            if body.get("status") != "wedged":
+                r.live_fails = 0
+                continue
+            r.live_fails += 1
+            if r.live_fails < self.LIVENESS_FAILS:
+                continue
+            rev.replicas.remove(r)
+            if r.proc.poll() is None:
+                r.proc.kill()
+            rev.restarts += 1
+            self._count_restarts(isvc, rev_name, 1, "wedged", reg)
+            self.record_event(
+                isvc, "Warning", "ReplicaWedged",
+                f"{rev_name} replica :{r.port} decode loop stalled "
+                f"({json.dumps(body.get('models') or {})}); killed for "
+                "restart")
+            self.queue.add(isvc.key)
+
+    def _maybe_kill_replica(self, isvc: InferenceService, rev_name: str,
+                            rev: _Revision) -> None:
+        """Chaos point ``replica.kill``: SIGKILL a serving replica
+        mid-request (docs/chaos.md) — the deterministic probe for the
+        whole recovery story: the router re-dispatches the replica's
+        in-flight generates to a healthy peer, the reap path counts a
+        crashed restart and respawns."""
+        for r in list(rev.replicas):
+            inj = chaos.draw(
+                "replica.kill",
+                target=f"{isvc.namespace}/{isvc.name}/{rev_name}/"
+                       f"{r.port}")
+            if inj is None:
+                continue
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode == "delay":
+                continue
+            if r.proc.poll() is None:
+                r.proc.kill()
+
+    def _drain_window_s(self, spec: Optional[dict]) -> float:
+        try:
+            return float((spec or {}).get("drainWindowSeconds",
+                                          self.DEFAULT_DRAIN_WINDOW_S))
+        except (TypeError, ValueError):
+            return self.DEFAULT_DRAIN_WINDOW_S
+
+    def _drain_replica(self, isvc: InferenceService, rev_name: str,
+                       r: _Replica, window_s: float, reg) -> None:
+        """Drain-before-kill: ask the replica to stop admitting and
+        finish in-flight work within the bounded window, so a PLANNED
+        kill (scale-in, revision respawn) never takes a request down
+        with it. The replica sheds its queue with a retriable 503 (the
+        router re-dispatches those to surviving replicas) and finishes
+        the slots already decoding. The interval lands on the trace
+        waterfall as a ``serving.drain`` span and in the
+        kfx_serving_drain_seconds histogram."""
+        t0 = time.time()
+        drained = False
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{r.port}/drain?wait_s={window_s:g}",
+                data=b"", method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=window_s + 2.0) as resp:
+                drained = bool(json.load(resp).get("drained", False))
+        except (OSError, ValueError):
+            pass  # dead or unresponsive: nothing left to drain
+        duration = max(time.time() - t0, 0.0)
+        obs_trace.record_span(
+            "serving.drain", ts=t0, duration=duration,
+            trace_id=obs_trace.trace_of(isvc),
+            parent_id=obs_trace.span_of(isvc),
+            namespace=isvc.namespace, isvc=isvc.name, revision=rev_name,
+            port=str(r.port), drained="1" if drained else "0")
+        reg.histogram(
+            "kfx_serving_drain_seconds",
+            "Drain-before-kill duration: drain request to empty engine "
+            "or window expiry.").observe(
+                duration, namespace=isvc.namespace, isvc=isvc.name,
+                revision=rev_name)
+        self.record_event(
+            isvc, "Normal", "ReplicaDrained",
+            f"{rev_name} replica :{r.port} drained in {duration:.2f}s"
+            + ("" if drained else " (window expired with work left)"))
+
+    def _drain_replicas(self, isvc: InferenceService, rev_name: str,
+                        replicas: List[_Replica], window_s: float,
+                        reg) -> None:
+        """Drain several doomed replicas CONCURRENTLY: the drains share
+        one window instead of stacking N of them, so a multi-replica
+        scale-in stalls this controller's reconcile loop for at most
+        ~window_s, not N x window_s."""
+        ready = [r for r in replicas if r.ready]
+        if not ready:
+            return
+        if len(ready) == 1:
+            self._drain_replica(isvc, rev_name, ready[0], window_s, reg)
+            return
+        threads = [threading.Thread(
+            target=self._drain_replica,
+            args=(isvc, rev_name, r, window_s, reg)) for r in ready]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(window_s + 5.0)
+
+    def _drain_revision(self, isvc: InferenceService, rev_name: str,
+                        rev: _Revision, spec: Optional[dict],
+                        reg) -> None:
+        """Drain every ready replica of a revision about to be torn
+        down (the respawn-on-spec-change path — quant/spec env changes
+        and storage/device/batcher edits all land here)."""
+        self._drain_replicas(isvc, rev_name, rev.replicas,
+                             self._drain_window_s(spec), reg)
 
     def _engine_queue_depth(self, rev: _Revision) -> float:
         """Best-effort decode-engine queue depth across the revision's

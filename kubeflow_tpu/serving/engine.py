@@ -93,13 +93,26 @@ bounded-drift, not byte-exact — the f32 engine remains the parity
 oracle, and ``kfx_lm_kv_bytes_per_token`` / ``kfx_lm_quant_mode``
 gauges make the mode scrape-visible.
 
+Self-healing (serving-fleet robustness): the loop keeps a progress
+**heartbeat** (monotonic iteration counter + last-completed-iteration
+timestamp, ``heartbeat()``) so the model server's /healthz is a real
+liveness probe — stale progress while slots are active means the loop
+is wedged, and the operator restarts the replica; and a one-way
+**drain mode** (``drain()``) that stops admitting (EngineDraining ->
+503 + Retry-After), resolves queued requests with that same retriable
+error (the router re-dispatches them to a healthy replica) and lets
+in-flight slots finish — the operator drains before every deliberate
+kill (scale-in, revision respawn) so planned churn never loses a
+request.
+
 Chaos points ``engine.admit``, ``engine.kv_alloc``,
 ``engine.spec_verify`` (a full-rejection wave: every proposal treated
 as rejected for that iteration — throughput falls to the
-non-speculative floor, correctness untouched) and ``engine.kv_quant``
+non-speculative floor, correctness untouched), ``engine.kv_quant``
 (int8 KV only: crushes the cached scale planes to the worst case —
-quality/accept-rate degrade observably, never a crash or page leak;
-docs/chaos.md).
+quality/accept-rate degrade observably, never a crash or page leak)
+and ``engine.wedge`` (stalls the decode loop with slots active — the
+deterministic liveness-failure probe; docs/chaos.md).
 
 jax is imported lazily (inside methods): server.py imports this module
 for ``EngineOverloaded`` on its own import path.
@@ -149,6 +162,16 @@ class EngineOverloaded(RuntimeError):
     """Admission queue full — the bounded-queueing replacement for the
     old hard ``max_batch_size`` rejection. The server maps this to
     503 + Retry-After (shed load, don't 400 a well-formed request)."""
+
+
+class EngineDraining(EngineOverloaded):
+    """The engine is in drain mode (operator-initiated shutdown
+    preamble): it stops admitting, finishes the slots already decoding,
+    and resolves queued requests with THIS error. Subclasses
+    EngineOverloaded so the server's shed-load contract applies —
+    503 + Retry-After is exactly right: the request is well-formed and
+    another replica (or this one's successor) can serve it, which is
+    what the router's re-dispatch does."""
 
 
 class PageAllocError(EngineOverloaded):
@@ -425,7 +448,8 @@ class DecodeEngine:
                  propose_tokens: int = 4,
                  draft_kv_pages: Optional[int] = None,
                  kv_quant: str = "",
-                 draft_quant: str = ""):
+                 draft_quant: str = "",
+                 stall_threshold_s: float = 10.0):
         import jax
 
         from ..models.generate import decode_config
@@ -600,8 +624,31 @@ class DecodeEngine:
         self._quant_chaos_exec: Any = None
         self._draft_quant_chaos_exec: Any = None
 
+        # -- decode-loop progress heartbeat + drain mode. The heartbeat
+        # is what turns /healthz into a real liveness probe: a wedged
+        # loop (stuck dispatch, deadlock) leaves ``_last_progress``
+        # stale while slots are active, which readiness alone can never
+        # see — the HTTP server keeps answering fine.
+        self.stall_threshold_s = float(stall_threshold_s)
+        self._iterations = 0
+        self._last_progress = time.monotonic()
+        self._draining = False
+        # AOT builds in progress (any thread). A cold prompt bucket
+        # compiling INLINE on the loop thread stalls iterations for
+        # longer than the threshold on big models, but it is slow, not
+        # stuck — and a wedge-kill would just repeat the same compile
+        # after respawn. The heartbeat suppresses the wedged verdict
+        # while a build runs (a warm-thread build overlapping a real
+        # wedge masks detection only until that build finishes).
+        self._building = 0
+
         self._cond = threading.Condition()
         self._queue: "deque[Request]" = deque()
+        # The request currently inside _admit (popped from the queue,
+        # not yet in a slot): without tracking it, drain()/heartbeat()
+        # would read an admitting engine as empty and the operator
+        # could kill the replica mid-prefill.
+        self._admitting: Optional[Request] = None
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"kfx-engine-{name}")
@@ -760,6 +807,83 @@ class DecodeEngine:
         with self._cond:
             return len(self._queue)
 
+    # -- liveness / drain ----------------------------------------------------
+    def heartbeat(self) -> Dict[str, Any]:
+        """Decode-loop progress snapshot (server /healthz liveness
+        input): monotonic iteration counter, seconds since the loop
+        last completed an iteration, whether there is work the loop
+        SHOULD be advancing (active slots or queued requests), and the
+        derived ``wedged`` verdict — stale progress while busy. An idle
+        engine is never wedged: the loop parks on its condition
+        variable, and ``_enqueue`` re-stamps progress at wake so the
+        parked interval can't read as a stall."""
+        now = time.monotonic()
+        with self._cond:
+            busy = (self._active_count() > 0 or len(self._queue) > 0
+                    or self._admitting is not None)
+        stalled_s = now - self._last_progress
+        compiling = self._building > 0
+        return {
+            "iterations": self._iterations,
+            "stalled_s": round(stalled_s, 3),
+            "busy": busy,
+            "compiling": compiling,
+            "draining": self._draining,
+            "wedged": (busy and not compiling
+                       and stalled_s > self.stall_threshold_s),
+        }
+
+    def drain(self, wait_s: float = 0.0) -> bool:
+        """Enter drain mode: stop admitting (submit/generate raise
+        EngineDraining -> 503 + Retry-After), resolve every QUEUED
+        request with the same retriable error (the router re-dispatches
+        them to a healthy replica), and let the slots already decoding
+        run to completion. Blocks up to ``wait_s`` for in-flight work
+        to finish; returns True when the engine is empty. One-way: the
+        operator calls this right before killing the replica."""
+        with self._cond:
+            self._draining = True
+            queued = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        err = EngineDraining(
+            f"engine {self.name} is draining; retry another replica")
+        for req in queued:
+            req._finish(err)
+        self._touch_gauges()
+        deadline = time.monotonic() + max(wait_s, 0.0)
+        while True:
+            with self._cond:
+                # A preemption-by-recompute mid-drain re-queues its
+                # request, and a request mid-admission is in a slot in
+                # all but timing; both are in-flight work, not new
+                # admissions, so drain waits for them too.
+                empty = (self._active_count() == 0 and not self._queue
+                         and self._admitting is None)
+            if empty or time.monotonic() >= deadline:
+                return empty
+            time.sleep(0.02)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _maybe_wedge(self) -> None:
+        """Chaos point ``engine.wedge``: stall the decode loop with
+        slots active (drawn only when there is work, so the budget is
+        spent on a stall liveness can actually see). The stall holds
+        ``rule.delay`` seconds (default 30) without touching the
+        heartbeat — exactly what a stuck device dispatch looks like to
+        the rest of the process. ``close()`` still wins: the stall
+        polls ``_stopped``."""
+        inj = chaos.draw("engine.wedge", target=self.name)
+        if inj is None:
+            return
+        stall = inj.delay if inj.delay > 0 else 30.0
+        deadline = time.monotonic() + stall
+        while time.monotonic() < deadline and not self._stopped:
+            time.sleep(0.05)
+
     # -- cache / compiled functions ------------------------------------------
     def _init_cache(self, draft: bool = False):
         """Zeros of the paged cache pytree (positions -1 = every page
@@ -804,6 +928,15 @@ class DecodeEngine:
         return jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), cache)
 
+    def _build(self, build_fn, *args):
+        """Run one AOT build under the ``_building`` marker so the
+        liveness heartbeat can tell "slow: compiling" from "stuck"."""
+        self._building += 1
+        try:
+            return build_fn(*args)
+        finally:
+            self._building -= 1
+
     def _prefill_for(self, P: int):
         """The AOT-compiled prefill executable for prompt-tail bucket P
         (compile-on-demand; the warm thread populates the same table)."""
@@ -811,7 +944,7 @@ class DecodeEngine:
             fn = self._prefill_exec.get(P)
         if fn is not None:
             return fn
-        fn = self._build_prefill(P)
+        fn = self._build(self._build_prefill, P)
         with self._exec_lock:
             return self._prefill_exec.setdefault(P, fn)
 
@@ -864,7 +997,7 @@ class DecodeEngine:
             fn = self._decode_exec
         if fn is not None:
             return fn
-        fn = self._build_decode()
+        fn = self._build(self._build_decode)
         with self._exec_lock:
             if self._decode_exec is None:
                 self._decode_exec = fn
@@ -974,7 +1107,8 @@ class DecodeEngine:
         donate = (0,) if self._donate else ()
         specs = (self._cache_specs(draft),
                  jax.ShapeDtypeStruct((n,), np.bool_))
-        fn = jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+        fn = self._build(
+            jax.jit(run, donate_argnums=donate).lower(*specs).compile)
         with self._exec_lock:
             if getattr(self, attr) is None:
                 setattr(self, attr, fn)
@@ -1012,8 +1146,8 @@ class DecodeEngine:
             return jax.tree_util.tree_unflatten(treedef, leaves)
 
         donate = (0,) if self._donate else ()
-        fn = jax.jit(run, donate_argnums=donate).lower(
-            self._cache_specs(draft)).compile()
+        fn = self._build(jax.jit(run, donate_argnums=donate).lower(
+            self._cache_specs(draft)).compile)
         with self._exec_lock:
             if getattr(self, attr) is None:
                 setattr(self, attr, fn)
@@ -1077,7 +1211,8 @@ class DecodeEngine:
         sds = jax.ShapeDtypeStruct
         specs = (self._cache_specs(), sds((), np.int32),
                  sds((), np.int32), sds((), np.int32))
-        fn = jax.jit(run, donate_argnums=donate).lower(*specs).compile()
+        fn = self._build(
+            jax.jit(run, donate_argnums=donate).lower(*specs).compile)
         with self._exec_lock:
             if self._copy_exec is None:
                 self._copy_exec = fn
@@ -1092,7 +1227,7 @@ class DecodeEngine:
             fn = self._draft_prefill_exec.get(P)
         if fn is not None:
             return fn
-        fn = self._build_draft_prefill(P)
+        fn = self._build(self._build_draft_prefill, P)
         with self._exec_lock:
             return self._draft_prefill_exec.setdefault(P, fn)
 
@@ -1132,7 +1267,7 @@ class DecodeEngine:
             fn = self._spec_exec
         if fn is not None:
             return fn
-        fn = self._build_spec_step()
+        fn = self._build(self._build_spec_step)
         with self._exec_lock:
             if self._spec_exec is None:
                 self._spec_exec = fn
@@ -1442,10 +1577,23 @@ class DecodeEngine:
         with self._cond:
             if self._stopped:
                 raise RuntimeError("engine is closed")
+            if self._draining:
+                raise EngineDraining(
+                    f"engine {self.name} is draining; retry another "
+                    "replica")
             if len(self._queue) + len(reqs) > self.max_queue:
                 raise EngineOverloaded(
                     f"admission queue full ({len(self._queue)} waiting, "
                     f"{len(reqs)} arriving, cap {self.max_queue})")
+            if self._active_count() == 0 and not self._queue \
+                    and self._admitting is None:
+                # Waking an idle loop: the parked interval is not a
+                # stall — re-stamp progress so the liveness clock
+                # starts at this admission, not at the last request.
+                # (_admitting checked too: an arrival while a request
+                # is stuck mid-admission must not reset the stall
+                # clock of a genuinely wedged loop.)
+                self._last_progress = time.monotonic()
             self._queue.extend(reqs)
             depth = len(self._queue)
             self._cond.notify()
@@ -1552,10 +1700,19 @@ class DecodeEngine:
             try:
                 self._admit_ready()
                 if self._active_count():
+                    self._maybe_wedge()
                     self._decode_once()
-            except BaseException as e:  # a broken dispatch fails the
-                self._fail_inflight(e)  # requests, never the engine
-                time.sleep(0.01)
+                # The progress heartbeat: one completed iteration. A
+                # loop stuck inside a dispatch (or the wedge stall
+                # above) never reaches this line, so /healthz sees the
+                # timestamp go stale while slots are active.
+                self._iterations += 1
+                self._last_progress = time.monotonic()
+            except Exception as e:     # a broken dispatch fails the
+                self._fail_inflight(e)  # requests, never the engine;
+                time.sleep(0.01)        # KeyboardInterrupt/SystemExit
+                #                         propagate (they are shutdown,
+                #                         not request failures)
 
     def _admit_ready(self) -> None:
         """Admit queued requests into free slots (runs between chunks —
@@ -1571,6 +1728,11 @@ class DecodeEngine:
                 if not free or not self._queue:
                     break
                 req = self._queue.popleft()
+                # Same locked step as the pop: drain()/heartbeat()
+                # must never observe the gap where the request has
+                # left the queue but is not yet tracked as admitting.
+                self._admitting = req
+            requeued = False
             try:
                 self._admit(req, free[0])
             except PageAllocError as e:
@@ -1579,14 +1741,19 @@ class DecodeEngine:
                 else:
                     with self._cond:
                         self._queue.appendleft(req)
-                    break
-            except BaseException as e:
+                    requeued = True
+            except Exception as e:
                 # A failed prefill (compile/OOM) fails THIS request —
                 # the req is not in a slot yet, so the loop-level
                 # failure net would never resolve its future. (_admit
                 # itself handles the donated-carry rebuild when the
-                # failure was mid-dispatch.)
+                # failure was mid-dispatch.) One poisoned request fails
+                # alone; the loop keeps serving everyone else.
                 req._finish(e)
+            finally:
+                self._admitting = None
+            if requeued:
+                break
         self._touch_gauges()
 
     def _admit(self, req: Request, slot: int) -> None:
@@ -1677,7 +1844,7 @@ class DecodeEngine:
                     self.params, self._cache, self._logbuf, tokens,
                     row[None, :], np.int32(slot), np.int32(len(tail)),
                     np.int32(matched))
-            except BaseException as e:
+            except Exception as e:
                 if self._donate:
                     # A failed DISPATCH may have died after the
                     # donation, deleting the carried buffers — and with
@@ -1769,7 +1936,7 @@ class DecodeEngine:
         try:
             self._draft_cache = fn(self.draft_params, self._draft_cache,
                                    tokens, row[None, :], np.int32(n))
-        except BaseException:
+        except Exception:
             if self._donate:
                 # The donated draft cache may be dead — every slot's
                 # draft KV with it. Rebuild and degrade them all; the

@@ -195,6 +195,12 @@ class LMPredictor(Predictor):
         self.quant = os.environ.get("KFX_LM_QUANT", "")
         self.kv_quant = os.environ.get("KFX_LM_KV_QUANT", "")
         self.draft_quant = os.environ.get("KFX_LM_QUANT_DRAFT", "")
+        # Liveness: seconds of decode-loop stall (while busy) before
+        # the engine's heartbeat reads wedged and /healthz fails the
+        # probe. Size it well above one worst-case dispatch (a chunk on
+        # a big model is seconds); tests shrink it via the env knob.
+        self.stall_threshold_s = float(
+            os.environ.get("KFX_LM_STALL_S", "10.0"))
         self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
@@ -246,7 +252,8 @@ class LMPredictor(Predictor):
                 propose_tokens=max(1, self.spec_tokens),
                 draft_kv_pages=self.spec_pages or None,
                 kv_quant="int8" if self.kv_quant == "int8" else "",
-                draft_quant="int8" if self.draft_quant == "int8" else "")
+                draft_quant="int8" if self.draft_quant == "int8" else "",
+                stall_threshold_s=self.stall_threshold_s)
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".
@@ -304,6 +311,23 @@ class LMPredictor(Predictor):
                 continue  # a failed warm costs the first request, only
             done += 1
             self._set_warm(done)
+
+    def engine_heartbeat(self) -> Optional[Dict[str, Any]]:
+        """Decode-loop liveness snapshot (None on the one-shot oracle
+        path, which has no persistent loop to wedge) — what turns the
+        hosting server's /healthz into a real liveness probe."""
+        if self._engine is None:
+            return None
+        return self._engine.heartbeat()
+
+    def drain(self, wait_s: float = 0.0) -> bool:
+        """Stop admitting and wait up to ``wait_s`` for in-flight
+        generations to finish (serving/engine.py drain contract).
+        Returns True when nothing is left in flight; trivially drained
+        on the engineless oracle path (its calls are synchronous)."""
+        if self._engine is None:
+            return True
+        return self._engine.drain(wait_s)
 
     def close(self) -> None:
         if self._engine is not None:

@@ -4,7 +4,13 @@ The reference splits default/canary traffic in the Istio VirtualService
 the KFServing controller writes (SURVEY.md §3 CS3). Here the router is a
 small HTTP proxy owned by the operator: deterministic hash-free
 percentage split between default and canary backends, round-robin across
-replicas, 503 with Retry-After while a backend scales from zero.
+replicas, 503 with Retry-After while a backend scales from zero,
+passive-health ejection with half-open readmission (counted as
+kfx_router_ejections_total), and cross-replica in-flight recovery — a
+backend that dies mid-``:generate`` gets the buffered request (prompt +
+sampling knobs + RNG seed) re-dispatched once to a healthy replica, so
+the client sees a latency blip instead of a lost request
+(kfx_router_recoveries_total).
 """
 
 from __future__ import annotations
@@ -62,6 +68,12 @@ class BackendSet:
         # requests and the peak since the operator last sampled.
         self._in_flight = 0
         self._peak_in_flight = 0
+        # Wired by the owning Router: fn(endpoint, event) called on
+        # every passive-health transition ("eject" — incl. a failed
+        # half-open probe re-ejecting — and "readmit"), feeding
+        # kfx_router_ejections_total. Called under self._lock; the
+        # registry has its own lock and never calls back here.
+        self.on_health_event: Optional[Callable[[str, str], None]] = None
 
     def enter(self) -> None:
         with self._lock:
@@ -123,7 +135,9 @@ class BackendSet:
     def report_success(self, endpoint: str) -> None:
         with self._lock:
             self._fails.pop(endpoint, None)
-            self._ejected.pop(endpoint, None)
+            was_ejected = self._ejected.pop(endpoint, None) is not None
+            if was_ejected and self.on_health_event is not None:
+                self.on_health_event(endpoint, "readmit")
 
     def report_failure(self, endpoint: str) -> None:
         with self._lock:
@@ -135,6 +149,8 @@ class BackendSet:
                 # A failed half-open probe re-ejects immediately; a
                 # fresh endpoint needs EJECT_AFTER consecutive misses.
                 self._ejected[endpoint] = time.monotonic()
+                if self.on_health_event is not None:
+                    self.on_health_event(endpoint, "eject")
 
     def ejected_endpoints(self) -> List[str]:
         with self._lock:
@@ -178,6 +194,24 @@ class Router:
         self.explainer = BackendSet(revision="explainer")
         self.transformer_configured = False
         self.explainer_configured = False
+        if metrics is not None:
+            for bs in (self.default, self.canary, self.transformer,
+                       self.explainer):
+                bs.on_health_event = self._record_health_event(bs)
+            # Seed the self-healing families (one zero sample each) so
+            # a pre-traffic `scrape_metrics --require` already sees
+            # them — ejection/recovery are exactly the events a fresh
+            # fleet hasn't had yet.
+            metrics.counter(
+                "kfx_router_ejections_total",
+                "Passive-health ejections/readmissions by endpoint.",
+            ).inc(0, namespace=namespace, isvc=name, revision="default",
+                  endpoint="", event="eject")
+            metrics.counter(
+                "kfx_router_recoveries_total",
+                "In-flight generate requests re-dispatched to a healthy "
+                "replica after their backend died mid-request.",
+            ).inc(0, namespace=namespace, isvc=name, revision="default")
         self._rng = rng or random.Random(0xC0FFEE)
         # Called when a request arrives and no replica is live
         # (scale-from-zero activator hook).
@@ -256,6 +290,29 @@ class Router:
             chosen.exit()
             self._set_inflight(chosen)
 
+    def _record_health_event(self, bs: BackendSet):
+        def record(endpoint: str, event: str) -> None:
+            self.metrics.counter(
+                "kfx_router_ejections_total",
+                "Passive-health ejections/readmissions by endpoint.",
+            ).inc(1, namespace=self.namespace, isvc=self.name,
+                  revision=bs.revision, endpoint=endpoint, event=event)
+        return record
+
+    def _record_recovery(self, chosen: BackendSet) -> None:
+        """One in-flight request survived its backend's death by
+        re-dispatch — the cross-replica recovery the self-healing
+        tentpole promises (bounded to one per request by the retry
+        loop)."""
+        if self.metrics is None:
+            return
+        self.metrics.counter(
+            "kfx_router_recoveries_total",
+            "In-flight generate requests re-dispatched to a healthy "
+            "replica after their backend died mid-request.",
+        ).inc(1, namespace=self.namespace, isvc=self.name,
+              revision=chosen.revision)
+
     def _set_inflight(self, chosen: BackendSet) -> None:
         if self.metrics is not None:
             self.metrics.gauge(
@@ -287,7 +344,14 @@ class Router:
         a connection failure or 5xx retries EXACTLY ONCE on a different
         backend of the same set (predict traffic is idempotent — the
         retry turns one sick replica into a latency blip, not an error
-        the client must handle). The whole relay runs under a
+        the client must handle). For ``:generate`` the same bounded
+        retry IS cross-replica in-flight recovery: the buffered request
+        body carries prompt + sampling knobs + RNG seed, so a backend
+        that dies mid-generation (SIGKILL, crash) gets its request
+        re-dispatched whole to a healthy replica and the deterministic
+        decode reproduces the completion — greedy output byte-identical
+        to an uninterrupted run (counted as
+        kfx_router_recoveries_total). The whole relay runs under a
         router.dispatch span adopting the caller's trace/span headers;
         its ID is forwarded as X-Kfx-Span-Id so the model server's
         serving.predict span parents to this hop."""
@@ -302,6 +366,7 @@ class Router:
         sp = obs_trace.start_span(
             "router.dispatch", trace_id=h.headers.get(TRACE_HEADER, ""),
             parent_id=h.headers.get(SPAN_HEADER, ""), backend=backend)
+        recovering = False
         try:
             for attempt in range(2):
                 try:
@@ -312,11 +377,22 @@ class Router:
                     last, last_err = None, e
                 if last is not None and last[0] < 500:
                     chosen.report_success(attempt_backend)
+                    if recovering:
+                        # Connection-level death mid-generate followed
+                        # by a SUCCESSFUL re-dispatch: that — and only
+                        # that — is an in-flight recovery (bounded to
+                        # one per request by this loop). A retry that
+                        # also fails is a lost request and must not
+                        # inflate the self-healing metric.
+                        self._record_recovery(chosen)
+                        sp.attrs["recovered"] = "1"
                     break
                 chosen.report_failure(attempt_backend)
                 if attempt == 0:
                     alt = chosen.pick(exclude=(attempt_backend,))
                     if alt is not None and alt != attempt_backend:
+                        recovering = last_err is not None and \
+                            h.path.partition("?")[0].endswith(":generate")
                         attempt_backend = alt
                         sp.attrs["retried_on"] = alt
                         continue

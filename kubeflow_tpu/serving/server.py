@@ -5,6 +5,16 @@ V1 protocol parity (reference kfserving python server, SURVEY.md §3 CS3):
     GET  /v1/models/{m}                 -> {"name": m, "ready": true}
     POST /v1/models/{m}:predict         -> {"predictions": [...]}
     GET  /healthz | /metrics
+    POST /drain[?wait_s=S]              -> {"draining": true, "drained": b}
+
+/healthz is a real liveness probe, not a does-the-socket-answer ping:
+it aggregates the LM decode engines' progress heartbeats and returns
+503 {"status": "wedged"} when a loop has stalled with work in flight
+(the operator's liveness probe restarts the replica). /drain is the
+operator's pre-kill hook: readiness flips false, new requests shed
+with 503 + Retry-After (the router re-dispatches them), and in-flight
+work finishes within the bounded wait — planned replica churn
+(scale-in, revision respawn) never loses a request.
 
 TPU-first serving mechanics (vs the reference's per-request python
 predict):
@@ -396,6 +406,10 @@ class ModelServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self.predictors: Dict[str, Predictor] = {}
         self.batchers: Dict[str, MicroBatcher] = {}
+        # Drain mode (operator shutdown preamble): readiness goes
+        # false, new predict/generate requests shed with 503 +
+        # Retry-After, in-flight work finishes. One-way.
+        self.draining = False
         # Server-reported latency distribution (so serving_p50_ms is a
         # /metrics fact, not only a bench observation) + request/error
         # counters, all rendered by the registry on /metrics.
@@ -579,10 +593,45 @@ class ModelServer:
                 workers=int(batcher.get("workers", 1)))
 
     # -- request handling ---------------------------------------------------
+    def _liveness(self) -> Dict[str, Any]:
+        """Aggregate decode-loop heartbeats across predictors: the
+        /healthz verdict. ``wedged`` when any engine reports stale
+        progress while busy — the server keeps answering HTTP just
+        fine with a stuck loop, which is exactly why readiness alone
+        cannot catch it."""
+        wedged: Dict[str, Any] = {}
+        for name, p in self.predictors.items():
+            hb_fn = getattr(p, "engine_heartbeat", None)
+            hb = hb_fn() if hb_fn is not None else None
+            if hb and hb.get("wedged"):
+                wedged[name] = {"iterations": hb["iterations"],
+                                "stalled_s": hb["stalled_s"]}
+        if wedged:
+            return {"status": "wedged", "models": wedged}
+        return {"status": "draining" if self.draining else "alive"}
+
+    def drain(self, wait_s: float = 0.0) -> Dict[str, Any]:
+        """Enter drain mode and wait up to ``wait_s`` for in-flight
+        work to finish: flips readiness false and sheds new requests
+        (503 + Retry-After), then drains every predictor that holds
+        in-flight state (the LM decode engine fails its queue with a
+        retriable error and finishes its slots). Returns the verdict
+        the /drain endpoint reports."""
+        self.draining = True
+        deadline = time.monotonic() + max(float(wait_s), 0.0)
+        drained = True
+        for p in self.predictors.values():
+            fn = getattr(p, "drain", None)
+            if fn is None:
+                continue  # no in-flight state beyond the HTTP handler
+            drained = fn(max(deadline - time.monotonic(), 0.0)) and drained
+        return {"draining": True, "drained": drained}
+
     def _handle_get(self, h) -> None:
         path = h.path
         if path == "/healthz" or path == "/":
-            h._send(200, {"status": "alive"})
+            live = self._liveness()
+            h._send(503 if live["status"] == "wedged" else 200, live)
         elif path == "/metrics" or path.startswith("/metrics?"):
             # Prometheus exposition by default (the reference model
             # servers are Prometheus-scrapable); JSON via ?format=json.
@@ -607,7 +656,11 @@ class ModelServer:
             if p is None:
                 h._send(404, {"error": f"model {name!r} not found"})
             else:
-                h._send(200, {"name": name, "ready": p.ready})
+                # A draining server is deliberately not ready: the
+                # operator's readiness probe (and the router behind it)
+                # must route around a replica that is about to die.
+                h._send(200, {"name": name,
+                              "ready": p.ready and not self.draining})
         else:
             h._send(404, {"error": f"no route {path}"})
 
@@ -618,6 +671,21 @@ class ModelServer:
         # keep-alive connection, and a stale 200 from the previous
         # request must not mark an aborted one as served.
         h._last_code = 0
+        if path == "/drain" or path.startswith("/drain?"):
+            # Operator drain-before-kill hook: ?wait_s bounds how long
+            # the call blocks for in-flight work (the operator's drain
+            # window). Draining twice is harmless — the second call
+            # just re-reports the (possibly now empty) state.
+            from urllib.parse import parse_qs, urlsplit
+
+            q = parse_qs(urlsplit(path).query)
+            try:
+                wait_s = float((q.get("wait_s") or ["0"])[0])
+            except ValueError:
+                h._send(400, {"error": "wait_s must be a number"})
+                return
+            h._send(200, self.drain(wait_s))
+            return
         if path.startswith("/v1/models/") and path.endswith(":generate"):
             name = path[len("/v1/models/"):-len(":generate")]
             sp = self._request_span(h, "serving.generate", name)
@@ -660,8 +728,11 @@ class ModelServer:
         if p is None:
             h._send(404, {"error": f"model {name!r} not found"})
             return
-        if not p.ready:
-            h._send(503, {"error": f"model {name!r} not ready"})
+        if not p.ready or self.draining:
+            h._send(503, {"error": f"model {name!r} not ready"
+                          if not p.ready else "server draining"},
+                    extra_headers={"Retry-After": "1"}
+                    if self.draining else None)
             return
         # Fault point: in-server predict failure/latency — the flapping
         # backend a router's passive health must eject around.
@@ -700,8 +771,14 @@ class ModelServer:
             h._send(400, {"error": f"model {name!r} does not support "
                                    f":generate"})
             return
-        if not p.ready:
-            h._send(503, {"error": f"model {name!r} not ready"})
+        if not p.ready or self.draining:
+            # Draining sheds like overload: retriable, another replica
+            # serves it (the engine's own EngineDraining covers the
+            # queue; this covers requests that raced the drain flip).
+            h._send(503, {"error": f"model {name!r} not ready"
+                          if not p.ready else "server draining"},
+                    extra_headers={"Retry-After": "1"}
+                    if self.draining else None)
             return
         try:
             length = int(h.headers.get("Content-Length", 0))

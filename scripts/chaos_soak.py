@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Seeded end-to-end chaos soak: prove the recovery machinery recovers.
 
-Two legs, both deterministic under --seed:
+Three legs, all deterministic under --seed:
 
   training  a gang-supervised JAXJob runs to its target step through
             injected worker crashes AND a corrupted latest checkpoint —
@@ -11,16 +11,24 @@ Two legs, both deterministic under --seed:
             request success while one backend fails every request
             (passive health ejects it; each failed try retries once on
             the healthy backend), then readmits the backend after the
-            half-open probe window once the fault lifts.
+            half-open probe window once the fault lifts;
+  fleet     (--mode fleet) a 2-replica LM InferenceService under
+            continuous generate traffic survives a kill / wedge /
+            drain loop — replica.kill SIGKILLs a replica mid-request
+            (router re-dispatches, operator respawns), engine.wedge
+            stalls a decode loop (liveness kills + restarts it,
+            reason=wedged), and a minReplicas scale-in drains before
+            killing — with ZERO lost requests: every client call
+            returns 200 with the greedy reference completion.
 
-Exit 0 iff both legs hold. Run from the repo root:
+Exit 0 iff the selected legs hold. Run from the repo root:
 
-    python scripts/chaos_soak.py            # full soak
+    python scripts/chaos_soak.py            # training + serving
+    python scripts/chaos_soak.py --mode fleet   # the serving-fleet loop
     python scripts/chaos_soak.py --steps 40 --requests 120   # quicker
 
 Injections are visible as kfx_chaos_injected_total{point} on the
-control plane's /metrics and as kind=Chaos events (docs/chaos.md).
-"""
+control plane's /metrics and as kind=Chaos events (docs/chaos.md)."""
 
 from __future__ import annotations
 
@@ -165,6 +173,201 @@ def run_serving_leg(requests: int, seed: int) -> dict:
     }
 
 
+def run_fleet_leg(seed: int, home: str) -> dict:
+    """Serving-fleet self-healing loop: a 2-replica LM isvc under
+    continuous generate traffic through replica.kill (SIGKILL
+    mid-request -> router re-dispatch + respawn), engine.wedge (stalled
+    decode loop -> liveness kill, reason=wedged) and a minReplicas
+    scale-in (drain-before-kill). One disruption at a time — the fleet
+    guarantee is "a replica event never loses a request", not "any
+    number of simultaneous failures" — and zero lost requests while
+    traffic flows: every client call must return 200 with the greedy
+    reference completion. Traffic pauses only across the phase-2
+    revision swap (replacing a whole revision has an availability gap
+    by design; scale-in does not)."""
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu import chaos
+    from kubeflow_tpu.api.base import from_manifest
+    from kubeflow_tpu.controlplane import ControlPlane
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from kubeflow_tpu.serving.lm_server import export_lm
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            head_dim=16, n_layers=2, d_ff=64,
+                            max_seq_len=64, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    export_dir = export_lm(os.path.join(home, "fleet-lm"), cfg, params)
+
+    saved = {k: os.environ.get(k) for k in ("KFX_CHAOS", "KFX_LM_STALL_S")}
+    os.environ.pop("KFX_CHAOS", None)
+
+    def isvc_manifest(min_replicas: int, propose: int = 0) -> dict:
+        spec = {"enabled": False}
+        if propose:
+            # A speculative-spec tweak (numerics-neutral: speculation
+            # stays off) — the env-change path that respawns the
+            # revision, picking up the operator's CURRENT environment.
+            spec["proposeTokens"] = propose
+        return {
+            "apiVersion": "serving.kubeflow.org/v1beta1",
+            "kind": "InferenceService",
+            "metadata": {"name": "fleet", "namespace": "default"},
+            "spec": {"predictor": {
+                "minReplicas": min_replicas,
+                "maxReplicas": min_replicas,
+                "drainWindowSeconds": 5,
+                "speculative": spec,
+                "jax": {"storageUri": f"file://{export_dir}"},
+            }},
+        }
+
+    prompt = [5, 9, 11, 3, 7]
+    payload = json.dumps({"prompt_tokens": [prompt],
+                          "max_new_tokens": 12, "seed": 0}).encode()
+    failures: list = []
+
+    def restart_totals(cp) -> dict:
+        out = {"crashed": 0, "wedged": 0}
+        for labels, v in cp.metrics.counter(
+                "kfx_replica_restarts_total").samples():
+            if labels.get("reason") in out:
+                out[labels.get("reason")] += int(v)
+        return out
+
+    def post(url, timeout=45.0):
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())["generated_tokens"][0]
+
+    hammer_stop = threading.Event()
+    hammer_threads: list = []
+
+    try:
+        with ControlPlane(home=home) as cp:
+            cp.apply([from_manifest(isvc_manifest(2))])
+            cp.wait_for_condition("InferenceService", "fleet", "Ready",
+                                  timeout=180)
+            url = cp.store.get("InferenceService", "fleet").status["url"]
+            gen = f"{url}/v1/models/fleet:generate"
+            reference = post(gen)
+
+            def hammer():
+                while not hammer_stop.is_set():
+                    try:
+                        out = post(gen)
+                        if out != reference:
+                            failures.append(f"mismatch: {out}")
+                    except Exception as e:
+                        failures.append(f"{type(e).__name__}: {e}")
+                    time.sleep(0.1)
+
+            def start_hammer():
+                nonlocal hammer_stop, hammer_threads
+                hammer_stop = threading.Event()
+                hammer_threads = [threading.Thread(target=hammer)
+                                  for _ in range(2)]
+                for t in hammer_threads:
+                    t.start()
+
+            def stop_hammer():
+                hammer_stop.set()
+                for t in hammer_threads:
+                    t.join()
+
+            def wait_for(pred, timeout, what):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if pred():
+                        return True
+                    time.sleep(0.25)
+                failures.append(f"timeout waiting for {what}")
+                return False
+
+            def ready_replicas():
+                st = cp.store.get("InferenceService", "fleet").status
+                return int((st.get("readyReplicas") or {})
+                           .get("default") or 0)
+
+            # Phase 1 — kill: SIGKILL one replica mid-traffic (the
+            # operator-side chaos point), wait for the respawn.
+            start_hammer()
+            chaos.install(chaos.parse_spec(
+                f"seed={seed};replica.kill:count=1"))
+            wait_for(lambda: restart_totals(cp)["crashed"] >= 1, 60,
+                     "crashed-replica restart")
+            chaos.install(None)
+            wait_for(lambda: ready_replicas() >= 2, 90,
+                     "respawn after kill")
+            stop_hammer()
+
+            # Phase 2 — wedge: a spec tweak respawns the revision with
+            # a one-stall engine.wedge budget + a fast liveness clock
+            # in the replica env; traffic then stalls one loop and the
+            # operator must kill + respawn it, reason=wedged.
+            state = os.path.join(home, "fleet-wedge.json")
+            os.environ["KFX_LM_STALL_S"] = "1"
+            os.environ["KFX_CHAOS"] = (
+                f"seed={seed};state={state};"
+                "engine.wedge:count=1,delay=8")
+
+            def revisions_created():
+                return sum(1 for e in cp.store.events_for(
+                    "InferenceService", "default/fleet")
+                    if e.reason == "RevisionCreated")
+
+            n_created = revisions_created()
+            cp.apply([from_manifest(isvc_manifest(2, propose=2))])
+            # The ready count is STALE until the operator has processed
+            # the spec change (it still describes the old revision) —
+            # wait for the swap itself first, then for readiness.
+            wait_for(lambda: revisions_created() > n_created, 60,
+                     "revision swap to be observed")
+            wait_for(lambda: ready_replicas() >= 2, 180,
+                     "revision respawn with the wedge budget")
+            start_hammer()
+            wait_for(lambda: restart_totals(cp)["wedged"] >= 1, 120,
+                     "wedged-replica restart")
+            wait_for(lambda: ready_replicas() >= 2, 90,
+                     "respawn after wedge")
+
+            # Phase 3 — drain: scale-in 2 -> 1 under load (drain-
+            # before-kill), then back out to 2.
+            cp.apply([from_manifest(isvc_manifest(1, propose=2))])
+            wait_for(lambda: ready_replicas() == 1, 60, "scale-in to 1")
+            cp.apply([from_manifest(isvc_manifest(2, propose=2))])
+            wait_for(lambda: ready_replicas() >= 2, 90, "scale-out to 2")
+            time.sleep(1.0)  # stragglers resolve before the verdict
+            stop_hammer()
+
+            totals = restart_totals(cp)
+            drained = any(e.reason == "ReplicaDrained"
+                          for e in cp.store.events_for(
+                              "InferenceService", "default/fleet"))
+    finally:
+        hammer_stop.set()
+        chaos.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "ok": (not failures and totals["crashed"] >= 1
+               and totals["wedged"] >= 1 and drained),
+        "lost_or_wrong_requests": failures[:10],
+        "restarts": totals,
+        "drained_before_scale_in": drained,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="kfx chaos soak")
     p.add_argument("--steps", type=int, default=60,
@@ -174,12 +377,24 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--home", default="",
                    help="control-plane home (default: fresh temp dir)")
+    p.add_argument("--mode", default="default",
+                   choices=["default", "training", "serving", "fleet",
+                            "all"],
+                   help="which legs to run (default: training+serving; "
+                        "fleet = the 2-replica isvc kill/wedge/drain "
+                        "loop)")
     args = p.parse_args(argv)
 
     home = args.home or tempfile.mkdtemp(prefix="kfx-chaos-soak-")
-    results = {"training": run_training_leg(args.steps, args.seed, home),
-               "serving": run_serving_leg(args.requests, args.seed)}
-    results["ok"] = all(r["ok"] for r in results.values())
+    results = {}
+    if args.mode in ("default", "all", "training"):
+        results["training"] = run_training_leg(args.steps, args.seed, home)
+    if args.mode in ("default", "all", "serving"):
+        results["serving"] = run_serving_leg(args.requests, args.seed)
+    if args.mode in ("all", "fleet"):
+        results["fleet"] = run_fleet_leg(
+            args.seed, os.path.join(home, "fleet"))
+    results["ok"] = all(r["ok"] for k, r in results.items() if k != "ok")
     print(json.dumps(results, indent=1))
     return 0 if results["ok"] else 1
 

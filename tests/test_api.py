@@ -427,6 +427,26 @@ spec:
                            match=r"spec\.canary\.quantization"):
             load_manifests(bad)
 
+    def test_drain_window_field_path(self):
+        """spec.predictor.drainWindowSeconds bounds drain-before-kill:
+        any number >= 0 passes (0 = kill immediately, the escape
+        hatch); bools and non-numbers are 400s at apply."""
+        ok = self.ISVC_YAML.replace(
+            "predictor:\n", "predictor:\n    drainWindowSeconds: 2.5\n",
+            1)
+        (isvc,) = load_manifests(ok)
+        assert isvc.predictor()["drainWindowSeconds"] == 2.5
+        zero = self.ISVC_YAML.replace(
+            "predictor:\n", "predictor:\n    drainWindowSeconds: 0\n", 1)
+        load_manifests(zero)
+        for bad_val in ("true", "-1", "soon"):
+            bad = self.ISVC_YAML.replace(
+                "predictor:\n",
+                f"predictor:\n    drainWindowSeconds: {bad_val}\n", 1)
+            with pytest.raises(ValidationError,
+                               match=r"drainWindowSeconds"):
+                load_manifests(bad)
+
     def test_custom_predictor_requires_command(self):
         """A command-less custom container would crash the operator's
         spawn loop; it must be a 400 at apply time."""

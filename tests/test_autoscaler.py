@@ -327,6 +327,47 @@ class TestRouterScaleInHygiene:
         bs.set_endpoints([self.E1, self.E2])
         assert bs.ejected_endpoints() == []
 
+    def test_half_open_probe_race_elects_exactly_one(self):
+        """Many threads racing a DUE half-open probe: exactly one pick
+        may elect the ejected endpoint (the probe re-arms it under the
+        lock before release), the rest keep rotating the healthy one —
+        and no pick READMITS it (readmission needs report_success).
+        Pins the scale-in-hygiene promise that concurrent picks can
+        neither double-probe a sick backend nor pre-eject/pre-readmit
+        its state."""
+        bs = BackendSet([self.E1, self.E2])
+        bs.PROBE_AFTER_S = 0.2
+        for _ in range(3):
+            bs.report_failure(self.E2)
+        assert bs.ejected_endpoints() == [self.E2]
+        time.sleep(0.25)  # the probe is now due
+        n_threads = 16
+        barrier = threading.Barrier(n_threads)
+        picks = []
+
+        def racer():
+            barrier.wait()
+            picks.append(bs.pick())
+
+        threads = [threading.Thread(target=racer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(picks) == n_threads
+        # One probe, no stampede on the sick backend.
+        assert picks.count(self.E2) == 1
+        assert picks.count(self.E1) == n_threads - 1
+        # The race must not have readmitted it: still ejected until a
+        # report_success, and a failed probe re-ejects for a full
+        # window.
+        assert bs.ejected_endpoints() == [self.E2]
+        bs.report_failure(self.E2)
+        assert bs.pick() == self.E1  # freshly re-armed: not due again
+        bs.report_success(self.E2)
+        assert bs.ejected_endpoints() == []
+
 
 # -- chaos points -------------------------------------------------------------
 

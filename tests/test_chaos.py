@@ -503,3 +503,18 @@ class TestChaosSoak:
         rc = chaos_soak.main(["--steps", "60", "--requests", "300",
                               "--home", str(tmp_path / "soak")])
         assert rc == 0
+
+    def test_fleet_soak(self, tmp_path):
+        """Serving-fleet self-healing soak (--mode fleet): a 2-replica
+        LM isvc under continuous generate traffic survives
+        replica.kill, engine.wedge and a scale-in drain with zero lost
+        requests — every client call returns the greedy reference
+        completion."""
+        sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+        try:
+            import chaos_soak
+        finally:
+            sys.path.pop(0)
+        rc = chaos_soak.main(["--mode", "fleet",
+                              "--home", str(tmp_path / "fleet-soak")])
+        assert rc == 0

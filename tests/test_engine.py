@@ -160,6 +160,60 @@ class TestEngineDecode:
         finally:
             eng.close()
 
+    def test_poisoned_request_fails_alone(self, engine, monkeypatch):
+        """One request whose admission blows up (a forced prefill
+        failure here) fails with that error ALONE — the loop's
+        Exception net keeps serving everyone else, and the engine
+        thread survives."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        real = DecodeEngine._prefill_for
+        calls = {"n": 0}
+
+        def poisoned(self_, P):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("poisoned prefill")
+            return real(self_, P)
+
+        monkeypatch.setattr(DecodeEngine, "_prefill_for", poisoned)
+        bad = engine.submit([1, 2, 3], max_new_tokens=4)
+        with pytest.raises(ValueError, match="poisoned"):
+            bad.result(30)
+        # The loop is intact and the next request serves normally.
+        assert engine._thread.is_alive()
+        assert len(engine.generate([[5, 9, 11]],
+                                   max_new_tokens=4)[0]) == 4
+
+    def test_loop_propagates_shutdown_exceptions(self, tiny_lm,
+                                                 monkeypatch):
+        """KeyboardInterrupt/SystemExit are shutdown, not request
+        failures: the loop must not swallow them into request errors
+        (the old BaseException net did) — the thread exits instead,
+        and close() resolves what was left queued."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        eng = DecodeEngine(cfg, params, n_slots=1, chunk_tokens=2,
+                           name="lm-exit")
+        # The propagating SystemExit reaches threading's excepthook by
+        # design; keep it out of pytest's unhandled-thread warnings.
+        monkeypatch.setattr(threading, "excepthook", lambda args: None)
+        try:
+            def boom():
+                raise SystemExit(1)
+
+            monkeypatch.setattr(eng, "_admit_ready", boom)
+            req = eng.submit([1], max_new_tokens=2)
+            eng._thread.join(10)
+            assert not eng._thread.is_alive()
+            # Not converted into a request failure.
+            assert not req.done()
+        finally:
+            eng.close()
+        with pytest.raises(RuntimeError, match="engine closed"):
+            req.result(1)
+
     def test_chaos_engine_admit(self, engine):
         chaos.install(chaos.parse_spec("engine.admit:count=1"))
         try:

@@ -1,0 +1,721 @@
+"""Serving-fleet self-healing (serving/engine.py heartbeat+drain,
+server /healthz liveness + /drain, router cross-replica recovery +
+ejection counting, operator wedge-restart / crash backoff /
+drain-before-kill): unit legs for each layer plus the tier-1 chaos e2e
+— replica.kill mid-request on a 2-replica isvc recovers byte-identical
+on the survivor, a scale-in under load drains with zero failed
+requests, and engine.wedge gets the replica liveness-killed and
+restarted with reason=wedged."""
+
+import glob
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu import chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            head_dim=16, n_layers=2, d_ff=64,
+                            max_seq_len=64, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def lm_export(tiny_lm, tmp_path_factory):
+    from kubeflow_tpu.serving.lm_server import export_lm
+
+    cfg, params = tiny_lm
+    return export_lm(str(tmp_path_factory.mktemp("fleet-lm")), cfg,
+                     params)
+
+
+def _post_json(url, payload, timeout=45.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.load(r)
+
+
+# -- engine: heartbeat + drain + wedge ----------------------------------------
+
+
+class TestEngineSelfHealing:
+    @pytest.fixture(scope="class")
+    def engine(self, tiny_lm):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        eng = DecodeEngine(cfg, params, n_slots=1, chunk_tokens=4,
+                           name="lm-heal", kv_page_size=16,
+                           stall_threshold_s=0.5)
+        eng.warm([8])
+        yield eng
+        eng.close()
+
+    def test_heartbeat_advances_and_idle_is_never_wedged(self, engine):
+        """The iteration counter advances with served work; an IDLE
+        engine is never wedged no matter how stale the timestamp (the
+        loop is parked, not stuck), and a fresh admission re-stamps
+        progress so the parked interval can't read as a stall."""
+        before = engine.heartbeat()
+        assert not before["wedged"] and not before["busy"]
+        engine.generate([[5, 9, 11]], max_new_tokens=8)
+        after = engine.heartbeat()
+        assert after["iterations"] > before["iterations"]
+        time.sleep(0.7)  # > stall_threshold_s while idle
+        hb = engine.heartbeat()
+        assert hb["stalled_s"] > 0.5 and not hb["wedged"]
+        # Work admitted after the idle stretch serves normally (the
+        # enqueue re-stamped the clock: no false-wedge on wake).
+        assert len(engine.generate([[1, 2]], max_new_tokens=4)[0]) == 4
+        assert not engine.heartbeat()["wedged"]
+
+    def test_wedge_chaos_stalls_loop_and_flags_heartbeat(self, engine):
+        """engine.wedge stalls the loop with a slot active: the
+        heartbeat reads wedged while the stall lasts (the liveness
+        signal), then the request completes untouched — the stall
+        costs latency, never correctness."""
+        chaos.install(chaos.parse_spec("engine.wedge:count=1,delay=1.2"))
+        try:
+            req = engine.submit([5, 9, 11], max_new_tokens=6)
+            saw_wedged = False
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not req.done():
+                if engine.heartbeat()["wedged"]:
+                    saw_wedged = True
+                time.sleep(0.02)
+            assert saw_wedged, "heartbeat never read wedged mid-stall"
+            assert len(req.result(30)) == 6
+            assert chaos.injected_counts().get("engine.wedge") == 1
+        finally:
+            chaos.reset()
+
+    def test_drain_finishes_slots_fails_queue_blocks_admission(
+            self, engine):
+        """drain(): the active slot runs to completion, the QUEUED
+        request resolves with the retriable EngineDraining (what the
+        router re-dispatches), and new submissions are refused with
+        the same error. Runs last in the class: drain is one-way."""
+        from kubeflow_tpu.serving.engine import EngineDraining
+
+        active = engine.submit([4, 5], max_new_tokens=24)
+        deadline = time.monotonic() + 30
+        while engine.queue_depth and time.monotonic() < deadline:
+            time.sleep(0.005)  # wait until it owns the only slot
+        queued = engine.submit([6, 7], max_new_tokens=24)
+        assert engine.drain(wait_s=30) is True
+        assert len(active.result(1)) == 24
+        with pytest.raises(EngineDraining):
+            queued.result(1)
+        with pytest.raises(EngineDraining):
+            engine.submit([1], max_new_tokens=2)
+        hb = engine.heartbeat()
+        assert hb["draining"] and not hb["busy"]
+
+
+# -- model server: /healthz liveness + /drain ---------------------------------
+
+
+class TestServerSelfHealing:
+    @pytest.fixture(scope="class")
+    def lm_server(self, lm_export):
+        from kubeflow_tpu.serving.lm_server import LMPredictor
+        from kubeflow_tpu.serving.server import ModelServer
+
+        saved = {k: os.environ.get(k)
+                 for k in ("KFX_LM_ENGINE", "KFX_LM_SPEC",
+                           "KFX_LM_STALL_S")}
+        os.environ["KFX_LM_ENGINE"] = "1"
+        os.environ["KFX_LM_SPEC"] = "0"
+        os.environ["KFX_LM_STALL_S"] = "0.5"
+        p = LMPredictor(lm_export, name="lm", warm_buckets=[8])
+        p.load()
+        srv = ModelServer(port=0)
+        srv.register(p)
+        srv.start()
+        yield srv, p
+        srv.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _healthz(self, port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    def test_healthz_is_a_liveness_probe(self, lm_server):
+        """200 alive normally; 503 {"status": "wedged"} while the
+        decode loop is stalled with work in flight — the signal the
+        operator's wedge-restart keys on (readiness keeps answering
+        200 the whole time, which is exactly why it can't catch
+        this)."""
+        srv, p = lm_server
+        assert self._healthz(srv.port) == (200, {"status": "alive"})
+        chaos.install(chaos.parse_spec("engine.wedge:count=1,delay=2"))
+        try:
+            done = {}
+
+            def client():
+                done["body"] = _post_json(
+                    f"http://127.0.0.1:{srv.port}/v1/models/lm:generate",
+                    {"prompt_tokens": [[5, 9, 11]],
+                     "max_new_tokens": 8})[1]
+
+            t = threading.Thread(target=client)
+            t.start()
+            saw = None
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                code, body = self._healthz(srv.port)
+                if code == 503 and body.get("status") == "wedged":
+                    saw = body
+                    break
+                time.sleep(0.05)
+            t.join(30)
+            assert saw is not None, "/healthz never failed mid-wedge"
+            assert "lm" in saw["models"]
+            # Readiness stayed true throughout — liveness is the only
+            # probe that can see a wedge.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/v1/models/lm",
+                    timeout=5) as r:
+                assert json.load(r)["ready"] is True
+            # The stall ended: the request completed, liveness healed.
+            assert len(done["body"]["generated_tokens"][0]) == 8
+            assert self._healthz(srv.port)[0] == 200
+        finally:
+            chaos.reset()
+
+    def test_drain_endpoint_sheds_and_finishes(self, lm_server):
+        """POST /drain: in-flight generations finish (the slot-active
+        one 200s), queued ones shed retriably, readiness flips false,
+        and new requests get 503 + Retry-After. Runs last: draining is
+        one-way."""
+        srv, p = lm_server
+        url = f"http://127.0.0.1:{srv.port}/v1/models/lm:generate"
+        # Hold the first admission 1s so work is provably in flight
+        # when the drain lands.
+        chaos.install(chaos.parse_spec(
+            "engine.admit:mode=delay,delay=1.0,count=1"))
+        results, errors = [], []
+
+        def client():
+            try:
+                results.append(_post_json(
+                    url, {"prompt_tokens": [[5, 9, 11]],
+                          "max_new_tokens": 16}))
+            except urllib.error.HTTPError as e:
+                errors.append((e.code, e.headers.get("Retry-After")))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # first admission is mid-stall now
+        try:
+            code, verdict = _post_json(
+                f"http://127.0.0.1:{srv.port}/drain?wait_s=20", {})
+            assert code == 200 and verdict["drained"] is True
+            for t in threads:
+                t.join(30)
+            # The in-flight request finished; the queued ones shed
+            # with the retriable contract (503 + Retry-After), never a
+            # hang or a hard failure.
+            assert len(results) >= 1
+            for status, body in results:
+                assert status == 200
+                assert len(body["generated_tokens"][0]) == 16
+            for code_, retry in errors:
+                assert code_ == 503 and retry is not None
+            # Readiness follows the drain; new traffic sheds.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/v1/models/lm",
+                    timeout=5) as r:
+                assert json.load(r)["ready"] is False
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_json(url, {"prompt_tokens": [[1]],
+                                 "max_new_tokens": 2})
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+            assert self._healthz(srv.port) == (
+                200, {"status": "draining"})
+        finally:
+            chaos.reset()
+
+
+# -- router: cross-replica recovery + ejection counting -----------------------
+
+
+class _DeadOnRequest(threading.Thread):
+    """Accepts a connection, reads the request, then slams the socket
+    shut — what a SIGKILL'd replica looks like to the router
+    mid-request."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self.hits = 0
+        self._stopped = False
+        self.start()
+
+    def run(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(5)
+                conn.recv(65536)
+                self.hits += 1
+            except OSError:
+                pass
+            conn.close()
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class _StubLM(threading.Thread):
+    """Healthy scripted backend: answers :generate with fixed tokens
+    and :predict with fixed predictions."""
+
+    def __init__(self, tokens):
+        super().__init__(daemon=True)
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                if self.path.endswith(":generate"):
+                    out = {"generated_tokens": [list(tokens)]}
+                else:
+                    out = {"predictions": [1]}
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        self.start()
+
+    def run(self):
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestRouterRecovery:
+    def _router(self):
+        from kubeflow_tpu.obs.metrics import MetricsRegistry
+        from kubeflow_tpu.serving.router import Router
+
+        reg = MetricsRegistry()
+        router = Router(metrics=reg, name="svc",
+                        namespace="ns").start()
+        return router, reg
+
+    def test_generate_recovers_on_backend_death_and_counts(self):
+        """A backend dying mid-:generate: the router re-dispatches the
+        buffered request to the healthy replica (client sees 200, not
+        502) and counts exactly one recovery."""
+        dead, stub = _DeadOnRequest(), _StubLM([7, 8, 9])
+        router, reg = self._router()
+        try:
+            # Round-robin starts at index 0: the dying backend takes
+            # the first dispatch deterministically.
+            router.default.set_endpoints(
+                [f"127.0.0.1:{dead.port}", f"127.0.0.1:{stub.port}"])
+            status, body = _post_json(
+                f"http://127.0.0.1:{router.port}/v1/models/m:generate",
+                {"prompt_tokens": [[1, 2]], "max_new_tokens": 3})
+            assert status == 200
+            assert body["generated_tokens"] == [[7, 8, 9]]
+            assert dead.hits == 1  # it really held the request first
+            assert reg.counter("kfx_router_recoveries_total").value(
+                namespace="ns", isvc="svc", revision="default") == 1
+        finally:
+            router.stop()
+            dead.stop()
+            stub.stop()
+
+    def test_predict_retry_is_not_counted_as_recovery(self):
+        """:predict keeps the bounded retry (idempotent traffic) but
+        recovery accounting is the :generate story only — the family
+        stays at its seeded zero."""
+        dead, stub = _DeadOnRequest(), _StubLM([1])
+        router, reg = self._router()
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{dead.port}", f"127.0.0.1:{stub.port}"])
+            status, body = _post_json(
+                f"http://127.0.0.1:{router.port}/v1/models/m:predict",
+                {"instances": [[0.0]]})
+            assert status == 200 and body["predictions"] == [1]
+            samples = dict(
+                (tuple(sorted(lab.items())), v) for lab, v in
+                reg.counter("kfx_router_recoveries_total").samples())
+            assert all(v == 0 for v in samples.values())
+        finally:
+            router.stop()
+            dead.stop()
+            stub.stop()
+
+    def test_ejection_counter_seeded_and_counts_both_events(self):
+        """kfx_router_ejections_total: seeded (zero sample) at router
+        construction so --require holds pre-traffic; ejection and
+        readmission each count with their endpoint label."""
+        router, reg = self._router()
+        e1, e2 = "127.0.0.1:7001", "127.0.0.1:7002"
+        try:
+            c = reg.counter("kfx_router_ejections_total")
+            assert c.value(namespace="ns", isvc="svc",
+                           revision="default", endpoint="",
+                           event="eject") == 0  # the seed
+            router.default.set_endpoints([e1, e2])
+            for _ in range(3):
+                router.default.report_failure(e1)
+            assert c.value(namespace="ns", isvc="svc",
+                           revision="default", endpoint=e1,
+                           event="eject") == 1
+            router.default.report_success(e1)
+            assert c.value(namespace="ns", isvc="svc",
+                           revision="default", endpoint=e1,
+                           event="readmit") == 1
+            # Plain success on a healthy endpoint is not a readmit.
+            router.default.report_success(e2)
+            assert c.value(namespace="ns", isvc="svc",
+                           revision="default", endpoint=e2,
+                           event="readmit") == 0
+        finally:
+            router.stop()
+
+
+# -- operator: crash-loop backoff (host-side unit) ----------------------------
+
+
+class _FakeProc:
+    def __init__(self):
+        self.dead = False
+
+    def poll(self):
+        return 1 if self.dead else None
+
+    def terminate(self):
+        self.dead = True
+
+    def kill(self):
+        self.dead = True
+
+
+class TestCrashLoopBackoff:
+    def _rev(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.operators.serving import _Replica, _Revision
+
+        rev = _Revision(name="default", model_name="m", model_dir="",
+                        workdir=str(tmp_path), batcher=None)
+
+        def fake_spawn():
+            rev.replicas.append(
+                _Replica(proc=_FakeProc(),
+                         port=9000 + len(rev.replicas)))
+
+        monkeypatch.setattr(rev, "spawn", fake_spawn)
+        return rev
+
+    def test_backoff_doubles_gates_respawn_and_resets(self, tmp_path,
+                                                      monkeypatch):
+        rev = self._rev(tmp_path, monkeypatch)
+        rev.reap_and_respawn(1)
+        assert len(rev.replicas) == 1 and rev.last_crashes == 0
+        rev.replicas[0].proc.dead = True
+        rev.reap_and_respawn(1)
+        # Crash counted, respawn gated by the fresh backoff window.
+        assert rev.last_crashes == 1 and rev.restarts == 1
+        assert rev.backoff_s == 0.5
+        assert len(rev.replicas) == 0
+        rev.backoff_until = 0.0  # window elapsed
+        rev.reap_and_respawn(1)
+        assert len(rev.replicas) == 1
+        rev.replicas[0].proc.dead = True
+        rev.reap_and_respawn(1)
+        assert rev.backoff_s == 1.0  # doubled
+        # What the controller does when a replica reaches readiness:
+        # the next crash backs off from 0.5s again.
+        rev.backoff_s = 0.0
+        rev.backoff_until = 0.0
+        rev.reap_and_respawn(1)
+        rev.replicas[0].proc.dead = True
+        rev.reap_and_respawn(1)
+        assert rev.backoff_s == 0.5
+
+
+# -- the chaos e2e: kill / drain / wedge on a 2-replica isvc ------------------
+
+
+MANIFEST = """
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: fleet
+spec:
+  predictor:
+    minReplicas: {n}
+    maxReplicas: {n}
+    drainWindowSeconds: 6
+    speculative: {{enabled: false}}
+    {quant}jax:
+      storageUri: file://{export}
+"""
+
+
+def _replica_ports(home):
+    ports = []
+    for path in glob.glob(os.path.join(home, "serving", "*",
+                                       "default-*.log")):
+        with open(path) as f:
+            ports += [int(m) for m in
+                      re.findall(r"server_ready .*?port=(\d+)",
+                                 f.read())]
+    return sorted(set(ports))
+
+
+class TestFleetSelfHealingE2E:
+    def test_kill_drain_wedge(self, lm_export, tmp_path, monkeypatch):
+        """The acceptance e2e, three legs on one 2-replica LM isvc:
+
+        1. replica.kill SIGKILLs the replica holding an in-flight
+           generate (held mid-admission by a deterministic chaos
+           delay) -> the router re-dispatches and the completion is
+           byte-identical to the uninterrupted reference; the operator
+           counts a crashed restart and respawns.
+        2. scale-in (minReplicas 2 -> 1) under continuous load drains
+           the doomed replica before the kill: zero failed client
+           requests, ReplicaDrained event + serving.drain span.
+        3. a quantization spec change respawns the revision (drain on
+           the respawn path too); the new replicas carry an
+           engine.wedge budget — the first busy loop stalls, liveness
+           fails, the operator kills it with reason=wedged and the
+           in-flight request recovers on the peer."""
+        from kubeflow_tpu.apiserver import ApiServer
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+        import scrape_metrics
+
+        home = str(tmp_path / "kfx")
+        state1 = str(tmp_path / "chaos-admit.json")
+        # Replica-inherited plan: exactly ONE admission — the second
+        # ever, i.e. the kill-leg request (after=1 skips the
+        # reference) — stalls 8s, so the SIGKILL lands mid-request
+        # deterministically.
+        monkeypatch.setenv(
+            "KFX_CHAOS",
+            f"state={state1};engine.admit:mode=delay,delay=8,"
+            "after=1,count=1")
+
+        def manifest(n, quant=False):
+            q = "quantization: {kv: int8}\n    " if quant else ""
+            return MANIFEST.format(n=n, quant=q, export=lm_export)
+
+        with ControlPlane(home=home) as cp:
+            cp.apply_text(manifest(2))
+            cp.wait_for_condition("InferenceService", "fleet", "Ready",
+                                  timeout=240)
+            url = cp.store.get("InferenceService", "fleet").status["url"]
+            gen = f"{url}/v1/models/fleet:generate"
+            body = {"prompt_tokens": [[5, 9, 11, 3, 7]],
+                    "max_new_tokens": 12, "seed": 0}
+
+            def post(timeout=60.0):
+                return _post_json(gen, body, timeout=timeout)[1][
+                    "generated_tokens"][0]
+
+            def ready_replicas():
+                st = cp.store.get("InferenceService", "fleet").status
+                return int((st.get("readyReplicas") or {})
+                           .get("default") or 0)
+
+            def restarts(reason):
+                return sum(
+                    int(v) for labels, v in cp.metrics.counter(
+                        "kfx_replica_restarts_total").samples()
+                    if labels.get("reason") == reason)
+
+            def wait_for(pred, timeout, what):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if pred():
+                        return
+                    time.sleep(0.2)
+                raise AssertionError(f"timed out waiting for {what}")
+
+            reference = post()  # admission draw 0: undelayed
+            assert len(reference) == 12
+
+            # ---- leg 1: replica.kill mid-request -> recovery --------
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(tokens=post()))
+            t.start()
+            ports = _replica_ports(home)
+            assert len(ports) >= 2
+            busy = None
+            deadline = time.monotonic() + 30
+            while busy is None and time.monotonic() < deadline:
+                for p in ports:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{p}/metrics"
+                                "?format=json", timeout=2) as r:
+                            eng = json.load(r).get("engine") or {}
+                    except (OSError, ValueError):
+                        continue
+                    if any(row.get("queue_depth", 0) > 0
+                           or row.get("slot_occupancy", 0) > 0
+                           for row in eng.values()):
+                        busy = p
+                        break
+                time.sleep(0.1)
+            assert busy is not None, \
+                "never saw the in-flight request on a replica"
+            # SIGKILL exactly the replica holding the request.
+            chaos.install(chaos.parse_spec(
+                f"replica.kill:count=1,match=/{busy}"))
+            try:
+                t.join(90)
+            finally:
+                chaos.install(None)
+            assert not t.is_alive(), "recovered generate never returned"
+            # Byte-identical greedy completion on the survivor.
+            assert result["tokens"] == reference
+            assert sum(
+                int(v) for _, v in cp.metrics.counter(
+                    "kfx_router_recoveries_total").samples()) >= 1
+            wait_for(lambda: restarts("crashed") >= 1, 30,
+                     "crashed-restart counter")
+            wait_for(lambda: ready_replicas() >= 2, 90,
+                     "respawn after kill")
+
+            # ---- leg 2: scale-in under load drains ------------------
+            failures = []
+            stop = threading.Event()
+            short = {"prompt_tokens": [[5, 9, 11, 3, 7]],
+                     "max_new_tokens": 4, "seed": 0}
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        _post_json(gen, short, timeout=30)
+                    except Exception as e:
+                        failures.append(repr(e))
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(3)]
+            for th in threads:
+                th.start()
+            time.sleep(1.0)
+            cp.apply_text(manifest(1))
+            try:
+                wait_for(lambda: ready_replicas() == 1, 60,
+                         "scale-in to 1 replica")
+                time.sleep(1.0)  # stragglers resolve
+            finally:
+                stop.set()
+                for th in threads:
+                    th.join()
+            assert not failures, (
+                f"in-flight requests failed during drained scale-in: "
+                f"{failures[:5]}")
+            reasons = [e.reason for e in cp.store.events_for(
+                "InferenceService", "default/fleet")]
+            assert "ReplicaDrained" in reasons
+
+            # ---- leg 3: wedge after the quant-respawn path ----------
+            state2 = str(tmp_path / "chaos-wedge.json")
+            monkeypatch.setenv("KFX_LM_STALL_S", "1")
+            monkeypatch.setenv(
+                "KFX_CHAOS",
+                f"state={state2};engine.wedge:count=1,delay=25")
+
+            def revisions_created():
+                return sum(1 for e in cp.store.events_for(
+                    "InferenceService", "default/fleet")
+                    if e.reason == "RevisionCreated")
+
+            n_created = revisions_created()
+            cp.apply_text(manifest(2, quant=True))
+            # The ready count is stale until the operator processes
+            # the spec change: wait for the swap itself (a second
+            # RevisionCreated event) before trusting readiness.
+            wait_for(lambda: revisions_created() > n_created, 60,
+                     "revision swap to be observed")
+            wait_for(lambda: ready_replicas() >= 2, 180,
+                     "revision respawn with the wedge budget")
+            out = post(timeout=90.0)  # wedges one replica; peer serves
+            assert len(out) == 12
+            wait_for(lambda: restarts("wedged") >= 1, 30,
+                     "wedged-restart counter")
+            reasons = [e.reason for e in cp.store.events_for(
+                "InferenceService", "default/fleet")]
+            assert "ReplicaWedged" in reasons
+
+            # ---- observability: span + scrape -----------------------
+            span_names = set()
+            for path in glob.glob(os.path.join(home, "spans",
+                                               "*.jsonl")):
+                with open(path) as f:
+                    span_names |= {json.loads(line).get("name")
+                                   for line in f if line.strip()}
+            assert "serving.drain" in span_names
+            with ApiServer(cp, port=0) as srv:
+                assert scrape_metrics.main(
+                    [f"{srv.url}/metrics",
+                     "--require", "kfx_replica_restarts_total",
+                     "--require", "kfx_router_ejections_total",
+                     "--require", "kfx_router_recoveries_total",
+                     "--require", "kfx_serving_drain_seconds"]) == 0

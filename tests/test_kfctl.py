@@ -149,7 +149,7 @@ class TestKfxVerbs:
             "autoscaling": {"default": {
                 "desired": 2, "target": 8,
                 "kvUtil": 0.42, "specAcceptRate": 0.87,
-                "quant": "w8+kv8"}},
+                "quant": "w8+kv8", "restarts": 3}},
         }
         clf = InferenceService.from_dict({
             "metadata": {"name": "clf", "namespace": "default"},
@@ -163,8 +163,12 @@ class TestKfxVerbs:
         # Q column: the engine's quantization mode; "-" when the
         # operator never sampled one (classifier revisions).
         assert rows[0][8] == "w8+kv8"
+        # RESTARTS column, fed from the operator's restart accounting
+        # (same number kfx_replica_restarts_total counts).
+        assert rows[0][9] == "3"
         assert rows[1][6] == "-" and rows[1][7] == "-"
         assert rows[1][8] == "-"
+        assert rows[1][9] == "-"  # operator never reported restarts
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main
