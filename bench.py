@@ -55,6 +55,9 @@ CONTRACT_KEYS = (
     "lm_quant_w8kv8_ppl_delta", "lm_quant_weight_bytes_ratio",
     "lm_quant_draft8_tokens_per_s", "lm_quant_draft8_accept_rate",
     "lm_quant_draft8_speedup",
+    "lm_mixed_itl_p99_off_ms", "lm_mixed_itl_p99_on_ms",
+    "lm_mixed_itl_improvement", "lm_mixed_prefill_skipped_frac",
+    "lm_mixed_prefill_skipped_frac_blind", "lm_mixed_affinity_hits",
     "serving_scale_p50_ms", "serving_scale_p99_ms",
     "serving_scale_success_rate", "serving_scale_max_replicas",
     "serving_scale_cold_start_ms", "serving_scale_rolled_back",
@@ -484,6 +487,16 @@ def main() -> int:
         # thing a wrong draft can cost).
         guard.section("lm_quant")
         lm.update(_bench_lm_quant())
+    if have_time(300, "lm_mixed_trace"):
+        # Chunked prefill + prefix-affinity routing (serving/engine.py
+        # + serving/router.py): inter-token p99 of short-chat clients
+        # while long prompts admit, chunking on vs off (the
+        # head-of-line-blocking kill), and the FLEET-level
+        # prefill-skipped fraction of a shared-system-prompt mix
+        # routed across 2 replicas with affinity vs blind round-robin
+        # (the per-replica prefix cache becoming a fleet cache).
+        guard.section("lm_mixed_trace")
+        lm.update(_bench_lm_mixed_trace())
     lm.update(guard.finish())
     if skipped:
         # A missing metric key must read as "budget cut this section",
@@ -834,6 +847,225 @@ def _bench_lm_engine(preset: str = "small", clients: int = 8,
     finally:
         if eng is not None:
             eng.close()
+
+
+def _bench_lm_mixed_trace(prefix: str = "lm_mixed_") -> dict:
+    """Mixed long-prompt/short-chat trace, two legs.
+
+    Inter-token leg (one engine, the lm_spec weight-bound d=512/L4
+    config): two short-chat clients decode continuously while two
+    320-token prompts admit mid-stream; inter-token arrival gaps of
+    the short clients are sampled host-side and the p99 compared with
+    chunked prefill OFF (monolithic: each long admission stalls decode
+    for its whole prefill) vs ON (32-token chunks: the stall is
+    bounded per iteration) — the head-of-line-blocking story in one
+    number.
+
+    Fleet leg (2 in-process LM servers behind the Router): 16 requests
+    over 4 distinct system prompts (48 shared + 16 unique tokens) in
+    shuffled order, with client-computed X-Kfx-Prefix headers; the
+    FLEET prefill-skipped fraction = sum(reused)/sum(admitted) across
+    both replicas' engines, measured with prefix affinity vs blind
+    round-robin (affinity_capacity=0) — affinity routes every repeat
+    to the replica already holding the pages, so the per-replica
+    cache composes into a fleet-level one."""
+    try:
+        out = {}
+        out.update(_mixed_itl_leg(prefix))
+        out.update(_mixed_fleet_leg(prefix))
+        return out
+    except Exception as e:  # secondary metric must not sink the bench
+        return {prefix + "error": str(e)[:200]}
+
+
+def _mixed_itl_leg(prefix: str, short_new: int = 96,
+                   long_len: int = 320, chunk: int = 32) -> dict:
+    import threading
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.generate import pow2_bucket
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg = TransformerConfig(vocab_size=512, d_model=512, n_heads=4,
+                            head_dim=128, n_layers=4, d_ff=2048,
+                            max_seq_len=512, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(7)
+    shorts = [list(rng.integers(0, cfg.vocab_size, 16))
+              for _ in range(2)]
+    longs = [list(rng.integers(0, cfg.vocab_size, long_len))
+             for _ in range(2)]
+
+    def run_leg(chunk_tokens: int) -> float:
+        # chunk_tokens=1 (one decode dispatch per token): the sampled
+        # gaps ARE inter-token latencies, not K-token-batch arrivals.
+        eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=1,
+                           name="mix", kv_page_size=16,
+                           request_timeout_s=600.0,
+                           prefill_chunk_tokens=chunk_tokens)
+        try:
+            eng.warm([pow2_bucket(16, 512),
+                      pow2_bucket(long_len, 512)])
+            eng.generate([shorts[0]], max_new_tokens=4)  # warm path
+            reqs = [eng.submit(p, max_new_tokens=short_new)
+                    for p in shorts]
+
+            def feed_longs():
+                for p in longs:
+                    time.sleep(0.4)
+                    eng.submit(p, max_new_tokens=8)
+
+            feeder = threading.Thread(target=feed_longs, daemon=True)
+            feeder.start()
+            gaps = []
+            last_len = [0] * len(reqs)
+            last_t = [None] * len(reqs)
+            deadline = time.perf_counter() + 300
+            while (not all(r.done() for r in reqs)
+                   and time.perf_counter() < deadline):
+                now = time.perf_counter()
+                for i, r in enumerate(reqs):
+                    n = len(r.tokens)
+                    if n > last_len[i]:
+                        if last_t[i] is not None:
+                            gaps.append(now - last_t[i])
+                        last_t[i] = now
+                        last_len[i] = n
+                time.sleep(0.0005)
+            feeder.join(30)
+            for r in reqs:
+                r.result(60)
+            return float(np.percentile(gaps, 99)) if gaps else 0.0
+        finally:
+            eng.close()
+
+    p99_off = run_leg(0)
+    p99_on = run_leg(chunk)
+    return {
+        prefix + "short_clients": 2,
+        prefix + "long_prompt_tokens": long_len,
+        prefix + "chunk_tokens": chunk,
+        prefix + "itl_p99_off_ms": round(p99_off * 1000, 1),
+        prefix + "itl_p99_on_ms": round(p99_on * 1000, 1),
+        prefix + "itl_improvement":
+            round(p99_off / p99_on, 2) if p99_on > 0 else 0.0,
+    }
+
+
+def _mixed_fleet_leg(prefix: str, n_prompts: int = 4,
+                     repeats: int = 4) -> dict:
+    import json as _json
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from kubeflow_tpu.obs.metrics import MetricsRegistry
+    from kubeflow_tpu.serving.lm_server import LMPredictor, export_lm
+    from kubeflow_tpu.serving.prefix import PREFIX_HEADER, affinity_key
+    from kubeflow_tpu.serving.router import Router
+
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=2,
+                            head_dim=32, n_layers=2, d_ff=128,
+                            max_seq_len=128, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    tmp = tempfile.mkdtemp(prefix="kfx-bench-mix-")
+    export_lm(tmp, cfg, params)
+    rng = np.random.default_rng(11)
+    systems = [[int(t) for t in rng.integers(0, cfg.vocab_size, 48)]
+               for _ in range(n_prompts)]
+    order = [(s, r) for r in range(repeats)
+             for s in range(n_prompts)]
+    rng.shuffle(order)
+    saved = {k: os.environ.get(k)
+             for k in ("KFX_LM_ENGINE", "KFX_LM_SPEC",
+                       "KFX_LM_KV_PAGE_SIZE", "KFX_LM_PREFILL_CHUNK")}
+    os.environ.update({"KFX_LM_ENGINE": "1", "KFX_LM_SPEC": "0",
+                       "KFX_LM_KV_PAGE_SIZE": "16",
+                       "KFX_LM_PREFILL_CHUNK": "32"})
+
+    def run_leg(affinity: bool):
+        from kubeflow_tpu.serving.server import ModelServer
+
+        servers, router = [], None
+        try:
+            for _ in range(2):
+                p = LMPredictor(tmp, name="mix", warm_buckets=[8])
+                p.load()
+                srv = ModelServer(port=0)
+                srv.register(p)
+                srv.start()
+                servers.append(srv)
+            reg = MetricsRegistry()
+            router = Router(metrics=reg, name="mix", namespace="bench",
+                            affinity_capacity=512 if affinity else 0
+                            ).start()
+            router.default.set_endpoints(
+                [f"127.0.0.1:{s.port}" for s in servers])
+            url = (f"http://127.0.0.1:{router.port}"
+                   "/v1/models/mix:generate")
+            for s_idx, r_idx in order:
+                prompt = systems[s_idx] + [
+                    int(t) for t in rng.integers(0, cfg.vocab_size, 16)]
+                hdrs = {"Content-Type": "application/json"}
+                if affinity:
+                    hdrs[PREFIX_HEADER] = affinity_key(prompt)
+                req = urllib.request.Request(
+                    url, data=_json.dumps(
+                        {"prompt_tokens": [prompt],
+                         "max_new_tokens": 4}).encode(), headers=hdrs)
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    _json.load(resp)
+            reused = admitted = 0.0
+            for srv in servers:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/metrics"
+                        "?format=json", timeout=10) as resp:
+                    row = _json.load(resp)["engine"]["mix"]
+                reused += row.get("prefix_tokens_reused", 0.0)
+                admitted += row.get("prompt_tokens_admitted", 0.0)
+            hits = reg.counter(
+                "kfx_router_prefix_affinity_hits_total").value(
+                    namespace="bench", isvc="mix")
+            return (reused / admitted if admitted else 0.0), hits
+        finally:
+            if router is not None:
+                router.stop()
+            for srv in servers:
+                srv.stop()
+
+    try:
+        frac_aff, hits = run_leg(affinity=True)
+        frac_blind, _ = run_leg(affinity=False)
+        return {
+            prefix + "fleet_replicas": 2,
+            prefix + "fleet_requests": len(order),
+            prefix + "prefill_skipped_frac": round(frac_aff, 3),
+            prefix + "prefill_skipped_frac_blind":
+                round(frac_blind, 3),
+            prefix + "affinity_hits": int(hits),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _spec_benchable_params(params, alpha: float = 0.35):

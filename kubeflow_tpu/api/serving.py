@@ -188,6 +188,17 @@ class InferenceService(Resource):
                     raise ValidationError(
                         f"spec.{rev}.drainWindowSeconds",
                         "must be a number >= 0")
+            # Chunked-prefill bound (tokens; the engine rounds up to a
+            # whole number of KV pages): integer >= 0, 0 = monolithic
+            # prefill. `prefillChunkTokens: true` must be a 400 at
+            # apply, not chunk size 1 at revision startup.
+            pc = rspec.get("prefillChunkTokens")
+            if pc is not None and (isinstance(pc, bool)
+                                   or not isinstance(pc, int)
+                                   or pc < 0):
+                raise ValidationError(
+                    f"spec.{rev}.prefillChunkTokens",
+                    "must be an integer >= 0 (0 = monolithic prefill)")
         sp = self.spec.get("schedulingPriority")
         if sp is not None and (isinstance(sp, bool)
                                or not isinstance(sp, int)):

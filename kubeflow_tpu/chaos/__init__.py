@@ -14,6 +14,9 @@ tested property: sites across the stack declare *fault points* —
     checkpoint.save     corrupt/partial write       (training/checkpoint.py)
     checkpoint.restore  restore read error          (training/checkpoint.py)
     serving.request     router->backend failure     (serving/router.py)
+    router.affinity     prefix-affinity miss +      (serving/router.py)
+                        map eviction (degrades to
+                        plain load balancing)
     serving.predict     in-server predict failure   (serving/server.py)
     engine.admit        LM decode-engine admission  (serving/engine.py)
                         failure/latency
@@ -93,7 +96,7 @@ KNOWN_POINTS = frozenset({
     "checkpoint.save", "checkpoint.restore",
     "serving.request", "serving.predict", "engine.admit",
     "engine.kv_alloc", "engine.spec_verify", "engine.kv_quant",
-    "engine.wedge", "replica.kill",
+    "engine.wedge", "replica.kill", "router.affinity",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
 })

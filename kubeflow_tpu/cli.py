@@ -458,12 +458,14 @@ def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
 def _serving_top_rows(isvcs) -> List[List[str]]:
     """Per-revision replica lines for `kfx top`: ready/spawned against
     the autoscaler's desired count and concurrency target, the decode
-    engine's KV-page pool utilization, speculative-decode accept rate
-    and quantization mode (Q column: "w8"/"kv8"/"w8+kv8"/"d8"/"f32";
-    paged LM revisions — "-" for classifiers and engines with the
-    signal absent), cumulative replica restarts (crashes + liveness
-    wedge-kills, the kfx_replica_restarts_total number), plus the
-    canary traffic split."""
+    engine's KV-page pool utilization, prefix-cache prefill-skip
+    fraction (SKIP% — the signal prefix-affinity routing moves),
+    speculative-decode accept rate and quantization mode (Q column:
+    "w8"/"kv8"/"w8+kv8"/"d8"/"f32"; paged LM revisions — "-" for
+    classifiers and engines with the signal absent), cumulative
+    replica restarts (crashes + liveness wedge-kills, the
+    kfx_replica_restarts_total number), plus the canary traffic
+    split."""
     rows = []
     for isvc in isvcs:
         repl = isvc.status.get("replicas") or {}
@@ -478,12 +480,14 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
             panic = " (panic)" if a.get("panic") else ""
             kv = a.get("kvUtil")
             acc = a.get("specAcceptRate")
+            skip = a.get("prefillSkip")
             rows.append([
                 isvc.name, isvc.namespace, rev,
                 f"{int(ready.get(rev) or 0)}/{int(repl.get(rev) or 0)}",
                 f"{a.get('desired', '-')}{panic}",
                 str(a.get("target", "-")),
                 f"{kv * 100:.0f}%" if kv is not None else "-",
+                f"{skip * 100:.0f}%" if skip is not None else "-",
                 f"{acc * 100:.0f}%" if acc is not None else "-",
                 str(a.get("quant") or "-"),
                 str(a["restarts"]) if a.get("restarts") is not None
@@ -497,8 +501,8 @@ def _print_serving_top(rows: List[List[str]]) -> None:
         return
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
-                        "DESIRED", "TARGET", "KV%", "ACC%", "Q",
-                        "RESTARTS", "CANARY%"])
+                        "DESIRED", "TARGET", "KV%", "SKIP%", "ACC%",
+                        "Q", "RESTARTS", "CANARY%"])
 
 
 def _print_rollouts(isvcs) -> int:

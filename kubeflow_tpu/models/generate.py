@@ -34,6 +34,25 @@ def pow2_bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def prefill_chunks(tail_len: int, chunk: int, cap: int) -> list:
+    """The chunked-prefill schedule for a ``tail_len``-token prompt
+    tail: [(offset, length, bucket)] with every chunk ``chunk`` tokens
+    except the remainder, each bucketed by ``pow2_bucket`` — all full
+    chunks share ONE prefill compile and the tail chunk reuses the
+    small-prompt buckets the engine already warms. This is the
+    bucket-policy contract above extended to chunked admission: the
+    DecodeEngine's prefill cursor walks exactly this schedule (same
+    min/pow2_bucket math), and tests/bench derive expected dispatch
+    counts and compile buckets from it."""
+    out = []
+    off = 0
+    while off < tail_len:
+        length = min(chunk, tail_len - off)
+        out.append((off, length, pow2_bucket(length, cap)))
+        off += length
+    return out
+
+
 def decode_config(cfg: TransformerConfig,
                   max_len: Optional[int] = None) -> TransformerConfig:
     """The serving-time decode variant of a train config: KV caches on,

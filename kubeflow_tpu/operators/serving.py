@@ -89,7 +89,8 @@ class _Revision:
                  graph: Optional[dict] = None,
                  container: Optional[dict] = None,
                  speculative: Optional[dict] = None,
-                 quantization: Optional[dict] = None):
+                 quantization: Optional[dict] = None,
+                 prefill_chunk: Optional[int] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -107,6 +108,9 @@ class _Revision:
         # as the KFX_LM_QUANT / KFX_LM_KV_QUANT knobs the LMPredictor
         # reads at load; classifier frameworks ignore them.
         self.quantization = quantization
+        # spec.<rev>.prefillChunkTokens (api/serving.py) — exported as
+        # KFX_LM_PREFILL_CHUNK; None leaves the predictor's default.
+        self.prefill_chunk = prefill_chunk
         # KFServing custom-predictor parity: a user-provided container
         # command serves the port instead of a framework server. The
         # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
@@ -130,6 +134,12 @@ class _Revision:
         self.engine_kv_free = 0.0
         self.engine_spec_rate: Optional[float] = None
         self.engine_quant: Optional[str] = None
+        # Prefix-reuse token totals summed across replicas — the
+        # revision-level prefill-skipped fraction for `kfx top`'s
+        # SKIP% column (the per-replica caches compose into a fleet
+        # cache under the router's prefix-affinity map).
+        self.engine_prefix_reused = 0.0
+        self.engine_prompt_tokens = 0.0
         self.engine_sampled = float("-inf")
         self.engine_absent = False
 
@@ -140,6 +150,15 @@ class _Revision:
         if self.engine_kv_pages <= 0:
             return None
         return 1.0 - self.engine_kv_free / self.engine_kv_pages
+
+    @property
+    def engine_prefill_skip(self):
+        """Fraction of admitted prompt tokens served from cached
+        prefix pages across this revision's replicas (None before any
+        prompt traffic or on classifier revisions)."""
+        if self.engine_prompt_tokens <= 0:
+            return None
+        return self.engine_prefix_reused / self.engine_prompt_tokens
 
     def spawn(self) -> None:
         port = free_port()
@@ -203,6 +222,7 @@ class _Revision:
         self._span_env(env)
         self._spec_env(env)
         self._quant_env(env)
+        self._prefill_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
@@ -224,6 +244,15 @@ class _Revision:
             env["KFX_LM_SPEC_LAYERS"] = str(int(sp["draftLayers"]))
         if sp.get("proposeTokens") is not None:
             env["KFX_LM_SPEC_TOKENS"] = str(int(sp["proposeTokens"]))
+
+    def _prefill_env(self, env: dict) -> None:
+        """spec.<rev>.prefillChunkTokens -> KFX_LM_PREFILL_CHUNK (the
+        chunked-prefill decode-stall bound, docs/serving.md). Only an
+        explicit field is exported — the predictor owns the default;
+        0 is the manifest-level monolithic-prefill escape hatch."""
+        if self.prefill_chunk is None or self.role != "predictor":
+            return
+        env["KFX_LM_PREFILL_CHUNK"] = str(int(self.prefill_chunk))
 
     def _quant_env(self, env: dict) -> None:
         """spec.<rev>.quantization -> the LMPredictor's quantization
@@ -502,11 +531,13 @@ class InferenceServiceController(Controller):
             device = str(spec.get("device", "auto"))
             speculative = spec.get("speculative")
             quantization = spec.get("quantization")
+            prefill_chunk = spec.get("prefillChunkTokens")
             if rev is None or rev.model_dir != model_dir \
                     or rev.device != device or rev.batcher != batcher \
                     or rev.container != container \
                     or rev.speculative != speculative \
-                    or rev.quantization != quantization:
+                    or rev.quantization != quantization \
+                    or rev.prefill_chunk != prefill_chunk:
                 if rev is not None:
                     # Revision respawn (model/device/batcher/spec-env
                     # change): drop the doomed replicas from the router
@@ -528,6 +559,7 @@ class InferenceServiceController(Controller):
                     container=container,
                     speculative=speculative,
                     quantization=quantization,
+                    prefill_chunk=prefill_chunk,
                 )
                 # The restart tally is cumulative per revision NAME
                 # (matching kfx_replica_restarts_total's label): a
@@ -841,6 +873,13 @@ class InferenceServiceController(Controller):
             # occupancy signal the dense slot count used to hide):
             # surfaced in `kfx top`'s per-isvc table.
             status["kvUtil"] = round(kv_util, 3)
+        skip = rev.engine_prefill_skip
+        if skip is not None:
+            # Fraction of prompt tokens the revision served from
+            # cached prefix pages — `kfx top`'s SKIP% column, the
+            # revision-level view of the fleet number prefix-affinity
+            # routing moves (docs/serving.md).
+            status["prefillSkip"] = round(skip, 3)
         if rev.engine_spec_rate is not None:
             # Trailing-window draft acceptance (replica mean) —
             # `kfx top`'s ACC% column: the live signal for whether
@@ -1019,6 +1058,7 @@ class InferenceServiceController(Controller):
         rev.engine_sampled = now
         total, answered, saw_engine = 0.0, False, False
         kv_pages, kv_free = 0.0, 0.0
+        reused, admitted = 0.0, 0.0
         spec_rates: List[float] = []
         quants: List[str] = []
         for r in rev.replicas:
@@ -1037,6 +1077,9 @@ class InferenceServiceController(Controller):
                 total += float(row.get("queue_depth", 0.0))
                 kv_pages += float(row.get("kv_pages", 0.0))
                 kv_free += float(row.get("kv_pages_free", 0.0))
+                reused += float(row.get("prefix_tokens_reused", 0.0))
+                admitted += float(row.get("prompt_tokens_admitted",
+                                          0.0))
                 if "spec_accept_rate" in row:
                     spec_rates.append(float(row["spec_accept_rate"]))
                 if row.get("quant"):
@@ -1046,6 +1089,8 @@ class InferenceServiceController(Controller):
         rev.engine_queue = total
         rev.engine_kv_pages = kv_pages
         rev.engine_kv_free = kv_free
+        rev.engine_prefix_reused = reused
+        rev.engine_prompt_tokens = admitted
         rev.engine_spec_rate = (sum(spec_rates) / len(spec_rates)
                                 if spec_rates else None)
         rev.engine_quant = quants[0] if quants else None

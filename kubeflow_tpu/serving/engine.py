@@ -30,12 +30,51 @@ pages:
     shared via device copy-on-write); cache pages are reclaimed LRU
     when the pool needs them back.
 
-Exactly two hot compiled functions remain: ``prefill`` (one compile per
-power-of-two prompt-TAIL bucket; writes the unmatched prompt tokens
-through the slot's block table straight into the pool — no row copy —
-plus the last real token's logits) and ``decode_chunk`` (ONE compile;
-chunked ``lax.scan`` advancing every active slot). Two cold helpers
-(page-invalidate, page-copy for COW) compile once each.
+The hot compiled inventory (one AOT table, populated by ``warm()`` —
+"exactly two hot functions" stopped being true at PR 10):
+
+  * ``prefill`` — one compile per power-of-two prompt-TAIL bucket;
+    writes the unmatched prompt tokens through the slot's block table
+    straight into the pool (no row copy) plus the last real token's
+    logits. Chunked admission (below) dispatches these SAME
+    executables at chunk-size buckets, so chunking adds at most one
+    new compile (the chunk bucket itself).
+  * ``decode_chunk`` — ONE compile; chunked ``lax.scan`` advancing
+    every active slot. Dispatched only by draft-less engines.
+  * the fused speculative step — ONE compile REPLACING decode_chunk
+    when a draft is configured (``draft_layers > 0``): propose +
+    multi-token verify + accept + rollback + draft catch-up in one
+    dispatch per iteration.
+  * the draft prefill — one compile per FULL-prompt bucket
+    (speculative engines only; the draft shares no prefix cache).
+
+Cold helpers (page-invalidate per pool, the COW page-copy, the
+kv-quant chaos crush) compile once each.
+
+Chunked prefill (``prefill_chunk_tokens > 0``): a long prompt no
+longer stalls every active decode slot for its full prefill — the
+head-of-line blocking iteration-level schedulers exist to kill.
+Admission places the request in a slot WITHOUT dispatching; the slot
+holds its pages and a **prefill cursor**, and each engine iteration
+runs at most ONE page-multiple prompt-chunk dispatch (oldest cursor
+first) before the normal decode/fused-spec step, so the per-iteration
+decode stall is bounded by ``prefill_chunk_tokens`` instead of by
+prompt length (measured by the ``kfx_lm_decode_stall_seconds``
+histogram; chunk dispatches count ``kfx_lm_prefill_chunks_total``).
+Each chunk writes the same tokens at the same dense-equivalent
+locations the monolithic prefill would (attention masks by cached
+position id, so a chunk's window attends its own tokens causally and
+everything earlier through the block table), and the final chunk
+lands the last real token's logits — greedy output stays
+byte-identical to the ``KFX_LM_ENGINE=0`` oracle. Chunked admission
+composes with prefix-cache hits (the cursor starts at the matched
+tail), preemption-by-recompute (a mid-prefill slot is a valid victim:
+pages freed, request re-queued whole), drain (a prefilling slot is
+in-flight work and finishes), and the draft pool (the draft's
+full-prompt prefill runs once at cursor completion — draft-depth
+cheap). Fully-covered prompt pages register into the prefix cache as
+each chunk completes, so same-prefix admissions later in a wave still
+share.
 
 Exactness: attention masks by cached *position id* (-1 = empty), never
 by cache location, and decode writes land at the DENSE-EQUIVALENT
@@ -121,7 +160,6 @@ for ``EngineOverloaded`` on its own import path.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import threading
 import time
 from collections import OrderedDict, deque
@@ -133,6 +171,7 @@ import numpy as np
 from .. import chaos
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry, default_registry
+from .prefix import chain_hash as _chain_hash
 
 # Admission wait buckets (seconds): a healthy engine admits within one
 # chunk (sub-ms..ms on tiny models, tens of ms on big ones); the tail
@@ -190,7 +229,7 @@ class Request:
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
                  "stop", "tokens", "rng", "error", "t_enqueue",
-                 "t_done", "trace_id", "span_id", "_event")
+                 "t_done", "counted", "trace_id", "span_id", "_event")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  top_k: int, seed: int, stop: int):
@@ -204,6 +243,12 @@ class Request:
         # RNG stream stashed at preemption ([2] uint32); None until
         # then — a fresh admission derives the stream from ``seed``.
         self.rng: Optional[np.ndarray] = None
+        # Admission stats (queue wait, prompt tokens, prefix hits)
+        # counted once, at the FIRST admission: a requeued preempt —
+        # including a mid-prefill one, whose token list is still
+        # empty — is recompute, not a new client admission, and
+        # ``tokens`` alone cannot tell those apart.
+        self.counted = False
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.monotonic()
         self.t_done = 0.0
@@ -294,12 +339,6 @@ class _PrefixEntry:
         self.tokens = tokens    # partial entries: the page's real tokens
         self.partial = partial
         self.nchildren = 0      # cached entries extending this one
-
-
-def _chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
-    h = hashlib.sha256(parent)
-    h.update(np.asarray(tokens, np.int64).tobytes())
-    return h.digest()
 
 
 class PrefixCache:
@@ -449,7 +488,8 @@ class DecodeEngine:
                  draft_kv_pages: Optional[int] = None,
                  kv_quant: str = "",
                  draft_quant: str = "",
-                 stall_threshold_s: float = 10.0):
+                 stall_threshold_s: float = 10.0,
+                 prefill_chunk_tokens: int = 0):
         import jax
 
         from ..models.generate import decode_config
@@ -503,6 +543,19 @@ class DecodeEngine:
         self.name = name
         self.n_slots = n_slots
         self.chunk_tokens = chunk_tokens
+        # Chunked prefill: admit prompt tails in page-multiple chunks,
+        # one chunk dispatch per engine iteration, bounding the decode
+        # stall a long prompt can inflict. 0 = monolithic (one prefill
+        # dispatch per admission, the pre-chunking behavior); any other
+        # value rounds UP to a whole number of pages so chunk
+        # boundaries and page boundaries coincide.
+        if prefill_chunk_tokens < 0:
+            raise ValueError("prefill_chunk_tokens must be >= 0 "
+                             "(0 = monolithic prefill)")
+        if prefill_chunk_tokens:
+            prefill_chunk_tokens = -(-int(prefill_chunk_tokens)
+                                     // ps) * ps
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         if draft_layers >= base.n_layers:
             raise ValueError(
                 f"draft_layers {draft_layers} must be < the target's "
@@ -610,6 +663,19 @@ class DecodeEngine:
         self._draft_slot_pages: List[List[int]] = [[] for _ in range(B)]
         self._spec_ok = np.zeros((B,), np.bool_)
         self._pending = np.full((B,), -1, np.int32)
+        # Chunked-prefill cursors: slot -> {"req", "full", "n",
+        # "next" (absolute index of the next chunk's first token),
+        # "key"/"reg_block" (incremental prefix-cache registration
+        # state), "bucket", "remaining"}. A slot with a cursor holds
+        # its request (``_slots[slot]`` set, so drain/occupancy/
+        # heartbeat count it as in-flight) but is NOT ``_active`` —
+        # the decode dispatch masks it until the cursor completes.
+        self._prefilling: Dict[int, Dict[str, Any]] = {}
+        # Per-iteration decode-stall accumulator: seconds of prefill
+        # dispatch (monolithic admission or one prompt chunk) active
+        # decode slots waited on this iteration — what the
+        # kfx_lm_decode_stall_seconds histogram observes.
+        self._iter_stall = 0.0
 
         # -- compiled executables (AOT, so a background warm populates
         # the same table the admission path reads — no jit-cache games)
@@ -782,6 +848,31 @@ class DecodeEngine:
         reg.counter("kfx_lm_prefix_cache_hits_total",
                     "Admissions that reused cached prefix pages.").inc(
                         0, model=self.name)
+        # Prefix-reuse token totals as gauges (engine-host truth): the
+        # server's JSON engine block exposes them per replica, and the
+        # FLEET-level prefill_skipped_frac = sum(reused)/sum(admitted)
+        # across replicas — the number prefix-affinity routing exists
+        # to move (docs/serving.md).
+        st = self.prefix_stats()
+        reg.gauge("kfx_lm_prefix_tokens_reused",
+                  "Prompt tokens served from cached prefix pages "
+                  "(cumulative).").set(
+                      st["tokens_reused"], model=self.name)
+        reg.gauge("kfx_lm_prompt_tokens_admitted",
+                  "Prompt tokens admitted (cumulative; denominator of "
+                  "the prefill-skipped fraction).").set(
+                      st["prompt_tokens"], model=self.name)
+        # Chunked-prefill families, pre-seeded (counter at 0; the
+        # histogram family registered with a zero-count observe) so a
+        # pre-traffic `scrape_metrics --require` already sees them.
+        reg.counter("kfx_lm_prefill_chunks_total",
+                    "Prompt-chunk prefill dispatches (chunked "
+                    "admission).").inc(0, model=self.name)
+        reg.histogram("kfx_lm_decode_stall_seconds",
+                      "Seconds active decode slots waited on a prefill "
+                      "dispatch, per engine iteration.",
+                      buckets=QUEUE_WAIT_BUCKETS).observe(
+                          0.0, n=0, model=self.name)
         # Speculative families are seeded iff the engine HAS a draft —
         # their absence is the signal (the server's JSON engine block
         # omits spec_accept_rate and `kfx top` renders "-", never a
@@ -930,12 +1021,19 @@ class DecodeEngine:
 
     def _build(self, build_fn, *args):
         """Run one AOT build under the ``_building`` marker so the
-        liveness heartbeat can tell "slow: compiling" from "stuck"."""
-        self._building += 1
+        liveness heartbeat can tell "slow: compiling" from "stuck".
+        The counter is lock-guarded: the background warm thread and
+        the loop's on-demand compiles run this concurrently, and an
+        unsynchronized +=/-= could lose an update — leaving the flag
+        stuck >0 (wedge detection silently disabled) or negative (a
+        legitimate inline compile killed as wedged)."""
+        with self._exec_lock:
+            self._building += 1
         try:
             return build_fn(*args)
         finally:
-            self._building -= 1
+            with self._exec_lock:
+                self._building -= 1
 
     def _prefill_for(self, P: int):
         """The AOT-compiled prefill executable for prompt-tail bucket P
@@ -1543,6 +1641,15 @@ class DecodeEngine:
         self._reset_fn()
         if self._prefix is not None:
             self._copy_fn()
+        if self.prefill_chunk_tokens:
+            # Chunked admission dispatches the chunk-size bucket for
+            # every full chunk — compile it once here, not inside the
+            # first long-prompt request.
+            from ..models.generate import pow2_bucket
+
+            self._prefill_for(
+                pow2_bucket(self.prefill_chunk_tokens,
+                            self.cfg.max_seq_len))
         for b in buckets if buckets is not None else self.prompt_buckets:
             self._prefill_for(int(b))
             if self.spec:
@@ -1698,10 +1805,27 @@ class DecodeEngine:
                 if self._stopped:
                     return
             try:
+                # Decode-stall accounting: prefill dispatch time (a
+                # monolithic admission's, or this iteration's one
+                # prompt chunk) is observed as stall only when active
+                # decode slots existed to be stalled by it.
+                self._iter_stall = 0.0
+                had_active = bool(self._active.any())
                 self._admit_ready()
                 if self._active_count():
                     self._maybe_wedge()
-                    self._decode_once()
+                    # At most ONE prompt-chunk dispatch per iteration:
+                    # the chunked-prefill head-of-line bound.
+                    self._advance_prefill()
+                    if had_active and self._iter_stall > 0:
+                        self._reg().histogram(
+                            "kfx_lm_decode_stall_seconds",
+                            "Seconds active decode slots waited on a "
+                            "prefill dispatch, per engine iteration.",
+                            buckets=QUEUE_WAIT_BUCKETS).observe(
+                                self._iter_stall, model=self.name)
+                    if bool(self._active.any()):
+                        self._decode_once()
                 # The progress heartbeat: one completed iteration. A
                 # loop stuck inside a dispatch (or the wedge stall
                 # above) never reaches this line, so /healthz sees the
@@ -1785,9 +1909,19 @@ class DecodeEngine:
         shared: List[int] = []
         cow = None
         matched = 0
+        key = b""
         if self._prefix is not None:
             shared, cow, matched, key = self._prefix.match(full, n - 1)
         tail = full[matched:]
+        if self.prefill_chunk_tokens and \
+                len(tail) > self.prefill_chunk_tokens:
+            # Chunked admission: the tail is longer than one chunk, so
+            # a monolithic prefill here would stall every active slot
+            # past the chunk bound. Place the request and leave a
+            # cursor; the loop advances it one chunk per iteration.
+            return self._admit_chunked(req, slot, full, n, remaining,
+                                       bucket, shared, cow, matched,
+                                       key)
         P = pow2_bucket(len(tail), L)
         fn = self._prefill_for(P)       # compile OUTSIDE the mutation
         cfn = self._copy_fn() if cow else None  # window: failing here
@@ -1821,15 +1955,10 @@ class DecodeEngine:
             row[j] = pg
         for b, pg in zip(want_blocks, pages):
             row[b] = pg
-        if not req.tokens:  # fresh admission, not a requeued preempt
-            wait = time.monotonic() - req.t_enqueue
-            self._reg().histogram(
-                "kfx_lm_queue_wait_seconds",
-                "Decode-engine admission wait (enqueue to slot "
-                "prefill).",
-                buckets=QUEUE_WAIT_BUCKETS).observe(wait, model=self.name)
+        self._count_admission(req, matched, n)
         tokens = np.zeros((1, P), np.int32)
         tokens[0, :len(tail)] = tail
+        t_dispatch = time.monotonic()
         with obs_trace.span("engine.admit", trace_id=req.trace_id,
                             parent_id=req.span_id, model=self.name,
                             slot=str(slot), bucket=str(bucket),
@@ -1855,6 +1984,9 @@ class DecodeEngine:
                 else:
                     self._mgr.decref(pinned + pages)
                 raise
+        # A monolithic prefill is decode stall for every active slot —
+        # the head-of-line blocking the chunked path exists to bound.
+        self._iter_stall += time.monotonic() - t_dispatch
         if cow is not None:
             # The COW source's pin was only for the copy window; the
             # slot keeps the private clone, not the source.
@@ -1865,21 +1997,10 @@ class DecodeEngine:
         # full prompt page not already cached, chained after the
         # matched prefix, plus the partially-filled boundary page.
         if self._prefix is not None:
-            # Stats count CLIENT admissions only: a preempt-requeue
-            # re-matches the pages its own first admission registered —
-            # recompute savings, not prompt reuse — and its n includes
-            # generated tokens, which are not "prompt tokens admitted".
-            if not req.tokens:
-                if matched:
-                    self._prefix.hits += 1
-                    self._prefix.tokens_reused += matched
-                    self._reg().counter(
-                        "kfx_lm_prefix_cache_hits_total",
-                        "Admissions that reused cached prefix pages."
-                        ).inc(1, model=self.name)
-                self._prompt_tokens += n
             # ``key`` covers the matched FULL pages; block len(shared)
             # (COW'd or fresh) chains from it like any other page.
+            # (Admission stats were counted by _count_admission above
+            # — once per client request, never for preempt-requeues.)
             h = key
             for b in range(len(shared), n // ps):
                 h = self._prefix.insert_full(
@@ -1955,6 +2076,328 @@ class DecodeEngine:
         self._draft_slot_pages[slot] = pages
         self._spec_ok[slot] = True
 
+    def _count_admission(self, req: Request, matched: int,
+                         n: int) -> bool:
+        """First-admission stats, counted exactly once per CLIENT
+        request (``req.counted``): the queue-wait histogram, the
+        prefix-hit counters for ``matched`` reused tokens, and the
+        admitted-prompt-token total (the prefill_skipped_frac
+        denominator). A requeued preempt — mid-decode or mid-prefill —
+        is recompute, not client traffic: it counts nothing. ONE
+        implementation for the monolithic and chunked admission paths;
+        returns whether this admission was counted (the chunked path's
+        late re-match follows the same verdict)."""
+        if req.counted:
+            return False
+        req.counted = True
+        wait = time.monotonic() - req.t_enqueue
+        self._reg().histogram(
+            "kfx_lm_queue_wait_seconds",
+            "Decode-engine admission wait (enqueue to slot prefill).",
+            buckets=QUEUE_WAIT_BUCKETS).observe(wait, model=self.name)
+        if self._prefix is not None:
+            if matched:
+                self._count_prefix_hit(matched)
+            self._prompt_tokens += n
+        return True
+
+    def _count_prefix_hit(self, matched: int) -> None:
+        self._prefix.hits += 1
+        self._prefix.tokens_reused += matched
+        self._reg().counter(
+            "kfx_lm_prefix_cache_hits_total",
+            "Admissions that reused cached prefix pages.").inc(
+                1, model=self.name)
+
+    def _clone_cow_page(self, pinned: List[int], cow) -> int:
+        """One COW boundary-page clone for the chunked paths: allocate
+        a private page, run the compiled copy of ``cow`` (source page
+        already pinned via ``pinned``), release the SOURCE's pin (the
+        slot keeps the clone). On failure every pin this call was
+        trusted with is released first: PageAllocError re-raises with
+        ``pinned`` decref'd; a failed DISPATCH re-raises after either
+        the donated-carry rebuild (_fail_inflight — the monolithic
+        path's contract) or, non-donated, decref of ``pinned`` + the
+        clone. Callers decide whether the raise dooms the admission
+        (_admit_chunked) or just the optimization
+        (_late_prefix_match)."""
+        cfn = self._copy_fn()   # compile OUTSIDE the mutation window
+        try:
+            page = self._alloc_pages(1)[0]
+        except PageAllocError:
+            self._mgr.decref(pinned)
+            raise
+        try:
+            self._cache = cfn(self._cache, np.int32(page),
+                              np.int32(cow[0]), np.int32(cow[1]))
+        except Exception as e:
+            if self._donate:
+                self._fail_inflight(e)
+            else:
+                self._mgr.decref(pinned + [page])
+            raise
+        self._mgr.decref([cow[0]])
+        return page
+
+    def _admit_chunked(self, req: Request, slot: int, full: List[int],
+                       n: int, remaining: int, bucket: int,
+                       shared: List[int], cow, matched: int,
+                       key: bytes) -> None:
+        """Chunked admission: place the request in the slot WITHOUT a
+        prompt prefill dispatch — pin the matched prefix pages (and
+        clone the COW boundary page, a one-page compiled copy), record
+        the queue wait and prefix stats exactly as the monolithic path
+        does, and leave a prefill cursor for the loop to advance one
+        page-multiple chunk per iteration. The slot is NOT active
+        until the cursor completes, so the decode dispatch masks it;
+        it IS in ``_slots``, so drain/heartbeat/occupancy count it as
+        in-flight work."""
+        first_own = len(shared)
+        # Matched pages (and the COW source) pinned BEFORE any
+        # allocation, same eviction hazard as the monolithic path.
+        pinned = shared + ([cow[0]] if cow is not None else [])
+        for pg in pinned:
+            self._mgr.incref(pg)
+        # Chunked admission stamps the SAME engine.admit span the
+        # monolithic path does (the documented per-admission trace
+        # node); the prefill dispatches follow as engine.prefill_chunk
+        # children of the request's trace.
+        with obs_trace.span("engine.admit", trace_id=req.trace_id,
+                            parent_id=req.span_id, model=self.name,
+                            slot=str(slot), bucket=str(bucket),
+                            prefix_tokens=str(matched), chunked="1"):
+            cow_page = None
+            if cow is not None:
+                cow_page = self._clone_cow_page(pinned, cow)
+        row = np.full((self.n_blocks,), -1, np.int32)
+        for j, pg in enumerate(shared):
+            row[j] = pg
+        own: List[int] = []
+        if cow_page is not None:
+            row[first_own] = cow_page
+            own.append(cow_page)
+        fresh = self._count_admission(req, matched, n)
+        self._tables[slot] = row
+        self._slot_pages[slot] = shared + own
+        self._active[slot] = False
+        self._pending[slot] = -1
+        self._slots[slot] = req
+        self._prefilling[slot] = {
+            "req": req, "full": full, "n": n, "next": matched,
+            "key": key, "reg_block": len(shared),
+            "bucket": bucket, "remaining": remaining,
+            # Whether THIS admission was counted as a client
+            # admission — the late re-match's hit accounting must
+            # follow the same verdict (a requeued preempt re-matching
+            # its own registered pages is recompute, not reuse).
+            "fresh": fresh}
+
+    def _advance_prefill(self) -> None:
+        """Advance chunked prefill by at most ONE chunk dispatch per
+        engine iteration (oldest cursor first — FIFO service, so a
+        long prompt behind a longer one still makes progress). Pages
+        allocate at the chunk boundary; pool exhaustion preempts the
+        youngest in-flight slot, which may be this cursor itself (its
+        request re-queues whole as a recompute continuation)."""
+        if not self._prefilling:
+            return
+        from ..models.generate import pow2_bucket
+
+        slot = min(self._prefilling,
+                   key=lambda s: self._prefilling[s]["req"].t_enqueue)
+        cur = self._prefilling[slot]
+        req = cur["req"]
+        if self._prefix is not None and cur["next"] == 0 \
+                and not self._slot_pages[slot]:
+            # Late prefix match, once per cursor before its first
+            # chunk: admission matched nothing (the page owner may
+            # have been mid-prefill in the SAME wave), but by now the
+            # owner's completed chunks have registered — re-match so
+            # same-wave identical prompts still share (the PR-7
+            # one-wave sharing contract, preserved under chunking).
+            if not self._late_prefix_match(slot, cur):
+                return  # donated COW death: engine state was rebuilt
+        L, ps = self.cfg.max_seq_len, self.page_size
+        start, n = cur["next"], cur["n"]
+        length = min(self.prefill_chunk_tokens, n - start)
+        last = start + length >= n
+        P = pow2_bucket(length, L)
+        try:
+            fn = self._prefill_for(P)
+        except Exception as e:
+            # A compile failure poisons THIS request only.
+            self._abort_prefill(slot, e)
+            return
+        # Page budget: this chunk's blocks, plus (on the final chunk)
+        # the first decode block when the pad gap puts it past the
+        # prompt blocks — the monolithic path's ping-pong guard.
+        blocks = list(range(start // ps, (start + length - 1) // ps + 1))
+        if last and cur["bucket"] // ps > (n - 1) // ps:
+            blocks.append(cur["bucket"] // ps)
+        while True:
+            try:
+                for b in blocks:
+                    if self._tables[slot, b] < 0:
+                        pg = self._alloc_pages(1)[0]
+                        self._tables[slot, b] = pg
+                        self._slot_pages[slot].append(pg)
+                break
+            except PageAllocError as e:
+                victims = [s for s, r in enumerate(self._slots)
+                           if r is not None]
+                if len(victims) <= 1:
+                    # Nothing in flight can free pages: fail honestly
+                    # (the 503 + Retry-After shed contract).
+                    self._abort_prefill(slot, e)
+                    return
+                victim = max(victims,
+                             key=lambda s: self._slots[s].t_enqueue)
+                self._preempt(victim)
+                if victim == slot:
+                    return  # this cursor was the youngest: re-queued
+        tokens = np.zeros((1, P), np.int32)
+        tokens[0, :length] = cur["full"][start:start + length]
+        t_dispatch = time.monotonic()
+        with obs_trace.span("engine.prefill_chunk",
+                            trace_id=req.trace_id,
+                            parent_id=req.span_id, model=self.name,
+                            slot=str(slot), start=str(start),
+                            tokens=str(length)):
+            try:
+                self._cache, self._logbuf = fn(
+                    self.params, self._cache, self._logbuf, tokens,
+                    np.ascontiguousarray(
+                        self._tables[slot])[None, :],
+                    np.int32(slot), np.int32(length), np.int32(start))
+            except Exception as e:
+                if self._donate:
+                    self._fail_inflight(e)
+                else:
+                    self._abort_prefill(slot, e)
+                return
+        self._iter_stall += time.monotonic() - t_dispatch
+        self._reg().counter(
+            "kfx_lm_prefill_chunks_total",
+            "Prompt-chunk prefill dispatches (chunked admission).").inc(
+                1, model=self.name)
+        cur["next"] = start + length
+        self._register_prefix_pages(slot, cur, final=last)
+        if last:
+            self._finish_prefill(slot)
+
+    def _late_prefix_match(self, slot: int, cur: Dict[str, Any]
+                           ) -> bool:
+        """Adopt a prefix-cache match for a cursor that admitted
+        against an empty match: pin the matched full pages, clone the
+        COW boundary page, and fast-forward the cursor — exactly the
+        admission-time hit, just discovered at first-chunk time. A
+        failed COW page allocation (or a non-donated dispatch failure)
+        abandons the match and plain chunked prefill continues —
+        sharing is an optimization, never a requirement. Returns False
+        only when a DONATED COW dispatch died (the carried cache is
+        gone, every request already failed via _fail_inflight — the
+        caller must stop touching this cursor)."""
+        shared, cow, matched, key = self._prefix.match(
+            cur["full"], cur["n"] - 1)
+        if not matched:
+            return True
+        pinned = shared + ([cow[0]] if cow is not None else [])
+        for pg in pinned:
+            self._mgr.incref(pg)
+        cow_page = None
+        if cow is not None:
+            try:
+                cow_page = self._clone_cow_page(pinned, cow)
+            except PageAllocError:
+                return True   # match abandoned; plain prefill continues
+            except Exception:
+                # Donated-carry death: the helper already failed every
+                # request and rebuilt — stop touching this cursor.
+                # Non-donated: pins released, the plain chunked
+                # prefill continues unharmed.
+                return not self._donate
+        own = list(shared)
+        for j, pg in enumerate(shared):
+            self._tables[slot, j] = pg
+        if cow_page is not None:
+            self._tables[slot, len(shared)] = cow_page
+            own.append(cow_page)
+        self._slot_pages[slot] = own
+        cur["next"] = matched
+        cur["key"] = key
+        cur["reg_block"] = len(shared)
+        if cur["fresh"]:
+            self._count_prefix_hit(matched)
+        return True
+
+    def _register_prefix_pages(self, slot: int, cur: Dict[str, Any],
+                               final: bool) -> None:
+        """Incremental prefix-cache registration: every full prompt
+        page the cursor has fully covered chains after the matched
+        prefix (so same-prefix admissions later in the wave already
+        share), and the partially-filled boundary page registers once
+        at completion — the monolithic path's coverage, chunk by
+        chunk."""
+        if self._prefix is None:
+            return
+        ps = self.page_size
+        n, full = cur["n"], cur["full"]
+        h = cur["key"]
+        covered = min(cur["next"], n) // ps
+        b = cur["reg_block"]
+        while b < covered:
+            h = self._prefix.insert_full(
+                h, full[b * ps:(b + 1) * ps],
+                int(self._tables[slot, b]))
+            b += 1
+        cur["key"], cur["reg_block"] = h, b
+        if final and n % ps and self._tables[slot, n // ps] >= 0:
+            self._prefix.insert_partial(
+                h, full[(n // ps) * ps:n],
+                int(self._tables[slot, n // ps]))
+
+    def _finish_prefill(self, slot: int) -> None:
+        """Cursor complete: the slot's pages hold the whole prompt at
+        its dense-equivalent locations and ``logbuf[slot]`` the last
+        real token's logits — flip the slot active with exactly the
+        state the monolithic path would have left, then prefill the
+        draft (one full-prompt dispatch at draft depth)."""
+        import jax
+
+        cur = self._prefilling.pop(slot)
+        req = cur["req"]
+        n, bucket = cur["n"], cur["bucket"]
+        self._pos[slot] = n
+        self._loc[slot] = bucket
+        self._max_loc[slot] = bucket + cur["remaining"] - 1
+        self._active[slot] = True
+        self._produced[slot] = len(req.tokens)
+        if req.rng is not None:
+            # A preempt stash from an earlier DECODING life of this
+            # request; restoring it keeps the sampled stream exact.
+            self._rngs[slot] = req.rng
+        else:
+            self._rngs[slot] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32)
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._stop[slot] = req.stop
+        self._max_new[slot] = req.max_new
+        self._pending[slot] = -1
+        if self.spec:
+            self._admit_draft(req, slot, cur["full"], n)
+
+    def _abort_prefill(self, slot: int, error: BaseException) -> None:
+        """Tear a prefill cursor down, releasing the slot's pages
+        whole, and fail its request ALONE with ``error`` (the
+        poisoned-request contract — the loop keeps serving everyone
+        else). Pool-pressure recompute requeues go through _preempt,
+        never here."""
+        cur = self._prefilling.pop(slot)
+        self._slots[slot] = None
+        self._release_slot(slot)
+        cur["req"]._finish(error)
+
     def _ensure_chunk_pages(self) -> None:
         """Allocate, at the chunk boundary, every page the next chunk
         may write (decode locations loc..loc+k-1, capped at the slot's
@@ -1979,8 +2422,11 @@ class DecodeEngine:
                             self._slot_pages[slot].append(pg)
                 return
             except PageAllocError:
+                # Victims include mid-prefill slots: their pages are
+                # as reclaimable as a decoder's, and preempting the
+                # youngest keeps the oldest requests progressing.
                 victims = [s for s, r in enumerate(self._slots)
-                           if r is not None and self._active[s]]
+                           if r is not None]
                 if len(victims) <= 1:
                     raise
                 self._preempt(max(
@@ -1988,9 +2434,13 @@ class DecodeEngine:
 
     def _preempt(self, slot: int) -> None:
         req = self._slots[slot]
-        # Stash the live RNG stream so re-admission resumes it (greedy
-        # ignores it; sampled must not fork from the replayed run).
-        req.rng = np.array(self._rngs[slot], np.uint32)
+        if self._active[slot]:
+            # Stash the live RNG stream so re-admission resumes it
+            # (greedy ignores it; sampled must not fork from the
+            # replayed run). A mid-PREFILL victim has consumed no
+            # stream yet — any earlier stash stays authoritative.
+            req.rng = np.array(self._rngs[slot], np.uint32)
+        self._prefilling.pop(slot, None)
         self._slots[slot] = None
         self._release_slot(slot)
         self._reg().counter(
@@ -2028,7 +2478,7 @@ class DecodeEngine:
                 break
             except PageAllocError:
                 victims = [s for s, r in enumerate(self._slots)
-                           if r is not None and self._active[s]]
+                           if r is not None]
                 if len(victims) <= 1:
                     raise
                 self._preempt(max(
@@ -2107,9 +2557,11 @@ class DecodeEngine:
 
         # Fresh admissions (and requeued preempts) have no pending
         # token: sample it from the prefill logits — the same token
-        # the chunked path's first decode step would produce.
+        # the chunked path's first decode step would produce. Active
+        # only: a mid-prefill slot's logbuf row is not final yet.
         fresh = [s for s, r in enumerate(self._slots)
-                 if r is not None and self._pending[s] < 0]
+                 if r is not None and self._active[s]
+                 and self._pending[s] < 0]
         if fresh:
             logbuf = np.asarray(self._logbuf)
             emitted0 = 0
@@ -2246,7 +2698,10 @@ class DecodeEngine:
                     "Decode-chunk dispatches.").inc(1, model=self.name)
         emitted = 0
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or slot in self._prefilling:
+                # A mid-prefill slot rides the dispatch fully masked:
+                # inactive by design, not retired — finishing it here
+                # would return an empty completion.
                 continue
             hits = np.flatnonzero(emits[:, slot])
             req.tokens.extend(int(t) for t in toks[hits, slot])
@@ -2266,6 +2721,7 @@ class DecodeEngine:
             if req is not None:
                 self._slots[slot] = None
                 req._finish(e)
+        self._prefilling.clear()
         self._active[:] = False
         self._tables[:, :] = -1
         self._slot_pages = [[] for _ in range(self.n_slots)]

@@ -172,6 +172,15 @@ class LMPredictor(Predictor):
         self.kv_pages = int(os.environ.get("KFX_LM_KV_PAGES", "0"))
         self.prefix_cache = \
             os.environ.get("KFX_LM_PREFIX_CACHE", "1") != "0"
+        # Chunked prefill (docs/serving.md): prompt tails longer than
+        # this admit in page-multiple chunks, one chunk dispatch per
+        # engine iteration, bounding the decode stall a long prompt
+        # can inflict on active slots. Default 256: prompts at or
+        # below it behave exactly as before (one dispatch), longer
+        # ones stop head-of-line blocking decode. 0 disables
+        # (monolithic prefill, the escape hatch).
+        self.prefill_chunk = int(
+            os.environ.get("KFX_LM_PREFILL_CHUNK", "256"))
         # Speculative decoding (docs/serving.md): on by default — the
         # engine falls back per slot when the draft can't help, and
         # greedy output is byte-identical either way. KFX_LM_SPEC=0 is
@@ -253,7 +262,8 @@ class LMPredictor(Predictor):
                 draft_kv_pages=self.spec_pages or None,
                 kv_quant="int8" if self.kv_quant == "int8" else "",
                 draft_quant="int8" if self.draft_quant == "int8" else "",
-                stall_threshold_s=self.stall_threshold_s)
+                stall_threshold_s=self.stall_threshold_s,
+                prefill_chunk_tokens=max(0, self.prefill_chunk))
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".

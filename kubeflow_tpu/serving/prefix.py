@@ -1,0 +1,69 @@
+"""Shared prefix keying: the SHA-256 page-chain hash and the routing
+affinity key derived from it.
+
+The engine's PrefixCache (serving/engine.py) keys cached prompt pages
+by a CHAIN hash — page i's key folds page i-1's key, so a key match is
+a match of the whole prefix, not of one page in isolation. The router's
+prefix-affinity map (serving/router.py) keys on the SAME chain hash of
+the prompt's LEADING pages, so a request routed by affinity lands on
+the replica whose cache holds pages under exactly those keys. Hoisting
+the hash here is what keeps the two sides from drifting: if either
+re-derived its own keying, same-prefix requests could stop colliding
+and the fleet-level cache win would silently evaporate.
+
+Clients that already hold the token ids compute the key themselves and
+send it as the ``X-Kfx-Prefix`` header (PREFIX_HEADER) — the cheap
+path; the router falls back to computing it from the buffered
+``:generate`` body for header-less clients, so affinity never depends
+on client cooperation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+import numpy as np
+
+# Request header carrying the hex affinity key (set by clients via
+# affinity_key(); the router computes the same value from the body when
+# the header is absent).
+PREFIX_HEADER = "X-Kfx-Prefix"
+
+# Defaults for the ROUTING key only (the engine's cache chains at its
+# own kv_page_size): 16-token pages over at most 2 leading pages (32
+# tokens). The key must collide for requests sharing a system prompt
+# and diverge once prompts differ; system prompts are long while
+# unique user tails arrive late, so a SHORT leading window groups
+# correctly — widening it would hash the per-user divergence into the
+# key and break exactly the grouping affinity exists for (a prompt
+# whose divergence falls inside 32 tokens had at most 2 shareable
+# pages anyway). Collisions past the window only co-locate prompts
+# that already share those pages: affinity is a hint, never a
+# correctness surface.
+AFFINITY_PAGE_TOKENS = 16
+AFFINITY_MAX_PAGES = 2
+
+
+def chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
+    """One page link of the chain: SHA-256 over the parent key + this
+    page's token ids (int64 bytes, the PrefixCache convention)."""
+    h = hashlib.sha256(parent)
+    h.update(np.asarray(list(tokens), np.int64).tobytes())
+    return h.digest()
+
+
+def affinity_key(tokens: Sequence[int],
+                 page_tokens: int = AFFINITY_PAGE_TOKENS,
+                 max_pages: int = AFFINITY_MAX_PAGES) -> str:
+    """Routing affinity key for a prompt: the hex chain hash of its
+    leading full ``page_tokens``-sized pages, capped at ``max_pages``.
+    Empty string when the prompt has no full page (nothing worth
+    pinning — a sub-page prompt re-prefills in one dispatch anyway)."""
+    toks = list(tokens)
+    key = b""
+    n = 0
+    while n + page_tokens <= len(toks) and n // page_tokens < max_pages:
+        key = chain_hash(key, toks[n:n + page_tokens])
+        n += page_tokens
+    return key.hex() if n else ""

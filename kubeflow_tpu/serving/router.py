@@ -11,6 +11,25 @@ backend that dies mid-``:generate`` gets the buffered request (prompt +
 sampling knobs + RNG seed) re-dispatched once to a healthy replica, so
 the client sees a latency blip instead of a lost request
 (kfx_router_recoveries_total).
+
+Prefix-affinity routing (docs/serving.md): ``:generate`` requests carry
+a prefix key — the ``X-Kfx-Prefix`` header clients compute with
+``serving.prefix.affinity_key`` (the SAME SHA-256 page-chain hash the
+engine's PrefixCache keys cached pages by — serving/prefix.py is the
+one implementation, so router and engine cannot drift), or the router
+derives it from the buffered body for header-less clients. A bounded
+LRU map (prefix key -> endpoint) routes same-prefix requests to the
+replica whose prefix cache already holds those pages, turning the
+per-replica prefix cache into a FLEET-level one (the 0.5-0.75 prefill
+skip stops depending on round-robin luck). The fallback ladder when
+the affinity target can't take the request — removed from rotation,
+ejected by passive health (a draining replica's 503s land here), or
+overloaded relative to its least-loaded healthy peer — is a
+least-loaded pick among the healthy endpoints, and the map re-learns
+whichever endpoint actually served, so affinity loss degrades to plain
+load balancing with zero failed requests (the ``router.affinity``
+chaos point forces exactly that, docs/chaos.md). Hits count
+``kfx_router_prefix_affinity_hits_total``.
 """
 
 from __future__ import annotations
@@ -22,12 +41,14 @@ import random
 import socket
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import chaos
 from ..obs import trace as obs_trace
 from ..obs.trace import SPAN_HEADER, TRACE_HEADER
+from .prefix import PREFIX_HEADER, affinity_key
 
 # RFC 7230 §6.1: connection-scoped headers a proxy must not forward.
 _HOP_BY_HOP = frozenset({
@@ -47,11 +68,20 @@ class BackendSet:
 
     EJECT_AFTER = 3
     PROBE_AFTER_S = 2.0
+    # Affinity overload guard: an affinity target this many in-flight
+    # requests past its least-loaded healthy peer is "overloaded" and
+    # the request falls back to the least-loaded pick — cache locality
+    # must never pile a hot prefix onto one replica while its peers
+    # idle.
+    AFFINITY_OVERLOAD_LEAD = 4
 
     def __init__(self, endpoints: Optional[List[str]] = None,
                  revision: str = ""):
         self._lock = threading.Lock()
         self._endpoints = list(endpoints or [])
+        # Per-endpoint in-flight counts (the least-loaded fallback's
+        # signal; the set-wide _in_flight below stays the KPA signal).
+        self._ep_inflight: Dict[str, int] = {}
         # Label for this set's per-revision metrics ("default"/"canary"/
         # "transformer"/"explainer"), stamped by the owning Router.
         self.revision = revision
@@ -108,6 +138,43 @@ class BackendSet:
                            if e in self._endpoints and e in previous}
             self._ejected = {e: t for e, t in self._ejected.items()
                              if e in self._endpoints and e in previous}
+            self._ep_inflight = {e: n for e, n in
+                                 self._ep_inflight.items()
+                                 if e in self._endpoints
+                                 and e in previous}
+
+    def _probe_or_healthy(self, exclude: Tuple[str, ...]
+                          ) -> Tuple[Optional[str], List[str]]:
+        """Shared pick prologue (caller holds ``self._lock``): elect a
+        due half-open probe — re-armed BEFORE release, so concurrent
+        picks cannot all elect the same sick backend — or return the
+        healthy candidate list, degrading to the full set under total
+        ejection. ONE implementation: round-robin and least-loaded
+        picks must never drift on probe/ejection semantics."""
+        now = time.monotonic()
+        candidates = [e for e in self._endpoints if e not in exclude]
+        if not candidates:
+            return None, []
+        for e in candidates:
+            ejected_at = self._ejected.get(e)
+            if ejected_at is not None and \
+                    now - ejected_at >= self.PROBE_AFTER_S:
+                self._ejected[e] = now
+                return e, []
+        healthy = [e for e in candidates if e not in self._ejected]
+        # Total ejection: degrade to rotation, don't die.
+        return None, (healthy or candidates)
+
+    def due_probe(self) -> Optional[str]:
+        """A due half-open probe, re-armed, or None. The affinity path
+        checks this BEFORE honoring a map hit: with every request
+        riding the affinity map (hits never reach pick()), an ejected
+        endpoint whose prefixes migrated away would otherwise never be
+        probed and a recovered replica would stay stranded out of
+        rotation."""
+        with self._lock:
+            probe, _ = self._probe_or_healthy(())
+            return probe
 
     def pick(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
         """Next endpoint, skipping ``exclude`` (the retry path's
@@ -115,22 +182,58 @@ class BackendSet:
         half-open probe, which takes priority (one request buys the
         readmission signal)."""
         with self._lock:
-            now = time.monotonic()
-            candidates = [e for e in self._endpoints if e not in exclude]
-            if not candidates:
-                return None
-            for e in candidates:
-                ejected_at = self._ejected.get(e)
-                if ejected_at is not None and \
-                        now - ejected_at >= self.PROBE_AFTER_S:
-                    # Re-arm before releasing the probe: concurrent
-                    # picks must not all elect the same sick backend.
-                    self._ejected[e] = now
-                    return e
-            healthy = [e for e in candidates if e not in self._ejected]
+            probe, healthy = self._probe_or_healthy(exclude)
+            if probe is not None:
+                return probe
             if not healthy:
-                healthy = candidates  # total ejection: degrade, don't die
+                return None
             return healthy[next(self._rr) % len(healthy)]
+
+    def pick_least_loaded(self, exclude: Tuple[str, ...] = ()
+                          ) -> Optional[str]:
+        """The affinity fallback: the healthy endpoint with the fewest
+        in-flight requests (round-robin among ties), with the same
+        half-open-probe priority and total-ejection degradation as
+        ``pick``."""
+        with self._lock:
+            probe, healthy = self._probe_or_healthy(exclude)
+            if probe is not None:
+                return probe
+            if not healthy:
+                return None
+            low = min(self._ep_inflight.get(e, 0) for e in healthy)
+            ties = [e for e in healthy
+                    if self._ep_inflight.get(e, 0) == low]
+            return ties[next(self._rr) % len(ties)]
+
+    def affinity_usable(self, endpoint: str) -> bool:
+        """Whether the affinity map may route to ``endpoint`` right
+        now: still in rotation, not ejected (a draining replica's 503s
+        ejected it), and not overloaded relative to its least-loaded
+        healthy peer."""
+        with self._lock:
+            if endpoint not in self._endpoints or \
+                    endpoint in self._ejected:
+                return False
+            mine = self._ep_inflight.get(endpoint, 0)
+            peers = [self._ep_inflight.get(e, 0)
+                     for e in self._endpoints
+                     if e != endpoint and e not in self._ejected]
+            return not (peers and
+                        mine >= min(peers) + self.AFFINITY_OVERLOAD_LEAD)
+
+    def ep_enter(self, endpoint: str) -> None:
+        with self._lock:
+            self._ep_inflight[endpoint] = \
+                self._ep_inflight.get(endpoint, 0) + 1
+
+    def ep_exit(self, endpoint: str) -> None:
+        with self._lock:
+            n = self._ep_inflight.get(endpoint, 0) - 1
+            if n > 0:
+                self._ep_inflight[endpoint] = n
+            else:
+                self._ep_inflight.pop(endpoint, None)
 
     def report_success(self, endpoint: str) -> None:
         with self._lock:
@@ -166,10 +269,19 @@ class Router:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  rng: Optional[random.Random] = None,
-                 metrics=None, name: str = "", namespace: str = ""):
+                 metrics=None, name: str = "", namespace: str = "",
+                 affinity_capacity: int = 512):
         self.default = BackendSet(revision="default")
         self.canary = BackendSet(revision="canary")
         self.canary_percent = 0
+        # Prefix-affinity map: prefix chain-hash key -> the endpoint
+        # whose engine prefix cache holds those pages. Bounded LRU
+        # (``affinity_capacity`` keys; 0 disables affinity): an
+        # evicted or stale entry is only ever a lost optimization —
+        # the fallback ladder re-learns on the next request.
+        self.affinity_capacity = int(affinity_capacity)
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        self._aff_lock = threading.Lock()
         # Per-revision observability (the autoscaler/SLO-watcher input):
         # when a registry is wired (the operator passes the control
         # plane's), every forwarded request records
@@ -212,6 +324,12 @@ class Router:
                 "In-flight generate requests re-dispatched to a healthy "
                 "replica after their backend died mid-request.",
             ).inc(0, namespace=namespace, isvc=name, revision="default")
+            metrics.counter(
+                "kfx_router_prefix_affinity_hits_total",
+                "Generate requests routed to their prefix-affinity "
+                "endpoint (the replica already holding the prompt's "
+                "cached prefix pages).",
+            ).inc(0, namespace=namespace, isvc=name)
         self._rng = rng or random.Random(0xC0FFEE)
         # Called when a request arrives and no replica is live
         # (scale-from-zero activator hook).
@@ -238,22 +356,121 @@ class Router:
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
-    def _pick_backend(self) -> Tuple[Optional[str], Optional[BackendSet]]:
+    def _pick_backend(self, aff_key: str = ""
+                      ) -> Tuple[Optional[str], Optional[BackendSet]]:
         use_canary = (len(self.canary) > 0
                       and self._rng.random() * 100 < self.canary_percent)
         first = self.canary if use_canary else self.default
         other = self.default if use_canary else self.canary
-        backend = first.pick()
+        backend = self._pick_in_set(first, aff_key)
         if backend is not None:
             return backend, first
-        backend = other.pick()  # fall through to the other set
+        backend = self._pick_in_set(other, aff_key)  # fall through
         return backend, (other if backend is not None else None)
+
+    def _pick_in_set(self, bs: BackendSet, aff_key: str
+                     ) -> Optional[str]:
+        """One set's pick with the affinity ladder: the mapped
+        endpoint when it can take the request, else a least-loaded
+        healthy pick that the map re-learns; keyless traffic keeps the
+        plain round-robin."""
+        if not aff_key or self.affinity_capacity <= 0:
+            return bs.pick()
+        probe = bs.due_probe()
+        if probe is not None:
+            # The half-open probe outranks the affinity hit — one
+            # request buys the readmission signal, and the map
+            # re-learns from wherever the request actually lands.
+            return probe
+        target = self._affinity_target(aff_key, bs)
+        if target is not None:
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "kfx_router_prefix_affinity_hits_total",
+                    "Generate requests routed to their prefix-affinity "
+                    "endpoint (the replica already holding the "
+                    "prompt's cached prefix pages).",
+                ).inc(1, namespace=self.namespace, isvc=self.name)
+            return target
+        backend = bs.pick_least_loaded()
+        if backend is not None:
+            self._remember_affinity(aff_key, bs, backend)
+        return backend
+
+    def _affinity_target(self, aff_key: str, bs: BackendSet
+                         ) -> Optional[str]:
+        """The mapped endpoint for this prefix, or None (miss /
+        unusable / chaos-evicted). The ``router.affinity`` chaos point
+        forces misses — ``mode=error`` (the default) also evicts the
+        whole map, the worst case the fallback ladder must absorb with
+        zero failed requests."""
+        inj = chaos.draw("router.affinity",
+                         target=f"{self.namespace}/{self.name}")
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                with self._aff_lock:
+                    self._affinity.clear()
+                return None
+        mkey = f"{bs.revision}:{aff_key}"
+        with self._aff_lock:
+            ep = self._affinity.get(mkey)
+            if ep is not None:
+                self._affinity.move_to_end(mkey)
+        if ep is None or not bs.affinity_usable(ep):
+            return None
+        return ep
+
+    def _remember_affinity(self, aff_key: str, bs: BackendSet,
+                           endpoint: str) -> None:
+        """Map entries are scoped per backend SET (``default:<key>`` /
+        ``canary:<key>``): under a canary split the same prefix
+        legitimately pins one replica per revision, and an unscoped
+        map would churn between them on every split flip."""
+        if not aff_key or self.affinity_capacity <= 0:
+            return
+        mkey = f"{bs.revision}:{aff_key}"
+        with self._aff_lock:
+            self._affinity[mkey] = endpoint
+            self._affinity.move_to_end(mkey)
+            while len(self._affinity) > self.affinity_capacity:
+                self._affinity.popitem(last=False)
+
+    @staticmethod
+    def _affinity_from_body(data: bytes) -> str:
+        """Header-less clients: derive the prefix key from the
+        buffered ``:generate`` body (the router already buffers it for
+        cross-replica recovery). Multi-prompt bodies key on the first
+        prompt — a shared-system-prompt batch shares its leading pages
+        anyway."""
+        if not data:
+            return ""
+        try:
+            prompts = json.loads(data).get("prompt_tokens") or []
+            if prompts and isinstance(prompts[0], int):
+                prompts = [prompts]
+            if not prompts or not isinstance(prompts[0], list):
+                return ""
+            return affinity_key(prompts[0])
+        except (ValueError, TypeError, AttributeError):
+            return ""
 
     def _proxy(self, h, has_body: bool) -> None:
         self.last_request_time = time.monotonic()
         path = h.path.partition("?")[0]
+        # Buffer the body up front: recovery re-dispatch needs it, and
+        # the affinity key may be derived from it.
+        data = b""
+        if has_body:
+            length = int(h.headers.get("Content-Length", 0))
+            data = h.rfile.read(length) if length else b""
         internal = h.headers.get("X-KFX-Component", "").lower() == \
             "predictor"
+        aff_key = ""
+        if path.endswith(":generate") and self.affinity_capacity > 0:
+            aff_key = h.headers.get(PREFIX_HEADER, "") or \
+                self._affinity_from_body(data)
         if not internal and self.explainer_configured and \
                 path.endswith(":explain"):
             backend = self.explainer.pick()
@@ -265,7 +482,7 @@ class Router:
             backend = self.transformer.pick()
             chosen = self.transformer if backend is not None else None
         else:
-            backend, chosen = self._pick_backend()
+            backend, chosen = self._pick_backend(aff_key)
         if chosen is not None:
             chosen.last_request_time = self.last_request_time
         if backend is None:
@@ -285,7 +502,7 @@ class Router:
         chosen.enter()
         self._set_inflight(chosen)
         try:
-            self._forward(h, backend, chosen, has_body)
+            self._forward(h, backend, chosen, data, aff_key)
         finally:
             chosen.exit()
             self._set_inflight(chosen)
@@ -339,7 +556,7 @@ class Router:
                   revision=chosen.revision)
 
     def _forward(self, h, backend: str, chosen: BackendSet,
-                 has_body: bool) -> None:
+                 data: bytes, aff_key: str = "") -> None:
         """Relay to ``backend``, reporting passive health to ``chosen``;
         a connection failure or 5xx retries EXACTLY ONCE on a different
         backend of the same set (predict traffic is idempotent — the
@@ -351,15 +568,14 @@ class Router:
         re-dispatched whole to a healthy replica and the deterministic
         decode reproduces the completion — greedy output byte-identical
         to an uninterrupted run (counted as
-        kfx_router_recoveries_total). The whole relay runs under a
+        kfx_router_recoveries_total). A request with a prefix key
+        re-learns the affinity map from wherever it actually SUCCEEDS,
+        so a recovery re-dispatch also migrates the prefix's affinity
+        off the dead replica. The whole relay runs under a
         router.dispatch span adopting the caller's trace/span headers;
         its ID is forwarded as X-Kfx-Span-Id so the model server's
         serving.predict span parents to this hop."""
         t0 = time.perf_counter()
-        data = b""
-        if has_body:
-            length = int(h.headers.get("Content-Length", 0))
-            data = h.rfile.read(length) if length else b""
         attempt_backend = backend
         last: Optional[Tuple[int, List[Tuple[str, str]], bytes]] = None
         last_err: Optional[OSError] = None
@@ -369,14 +585,23 @@ class Router:
         recovering = False
         try:
             for attempt in range(2):
+                chosen.ep_enter(attempt_backend)
                 try:
                     last = self._attempt(h, attempt_backend, data,
                                          span_id=sp.span_id)
                     last_err = None
                 except OSError as e:
                     last, last_err = None, e
+                finally:
+                    chosen.ep_exit(attempt_backend)
                 if last is not None and last[0] < 500:
                     chosen.report_success(attempt_backend)
+                    if aff_key:
+                        # The map tracks where the prefix's pages
+                        # actually landed — including a recovery
+                        # re-dispatch migrating off a dead replica.
+                        self._remember_affinity(aff_key, chosen,
+                                                attempt_backend)
                     if recovering:
                         # Connection-level death mid-generate followed
                         # by a SUCCESSFUL re-dispatch: that — and only

@@ -532,6 +532,10 @@ class ModelServer:
                               ("kfx_lm_kv_pages_free", "kv_pages_free"),
                               ("kfx_lm_kv_bytes_per_token",
                                "kv_bytes_per_token"),
+                              ("kfx_lm_prefix_tokens_reused",
+                               "prefix_tokens_reused"),
+                              ("kfx_lm_prompt_tokens_admitted",
+                               "prompt_tokens_admitted"),
                               ("kfx_lm_spec_accept_rate",
                                "spec_accept_rate")):
             for labels, value in self.metrics.gauge(family).samples():

@@ -395,6 +395,34 @@ spec:
                            match=r"spec\.canary\.speculative"):
             load_manifests(bad)
 
+    def test_prefill_chunk_field_path(self):
+        """spec.predictor.prefillChunkTokens (the chunked-prefill
+        decode-stall bound): integer >= 0 with a field-path error;
+        `prefillChunkTokens: true` is a 400 at apply, never chunk
+        size 1 at revision startup."""
+        ok = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    prefillChunkTokens: 128\n", 1)
+        (isvc,) = load_manifests(ok)
+        assert isvc.predictor()["prefillChunkTokens"] == 128
+        zero = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    prefillChunkTokens: 0\n", 1)
+        load_manifests(zero)  # 0 = monolithic escape hatch, valid
+        for bad_val in ("true", "-1", "1.5", "'64'"):
+            bad = self.ISVC_YAML.replace(
+                "predictor:\n",
+                f"predictor:\n    prefillChunkTokens: {bad_val}\n", 1)
+            with pytest.raises(ValidationError,
+                               match=r"prefillChunkTokens"):
+                load_manifests(bad)
+        bad = self.ISVC_YAML + (
+            "  canary:\n    prefillChunkTokens: false\n"
+            "    jax: {storageUri: 'file:///tmp/models/resnet'}\n")
+        with pytest.raises(ValidationError,
+                           match=r"spec\.canary\.prefillChunkTokens"):
+            load_manifests(bad)
+
     def test_quantization_field_paths(self):
         """spec.predictor.quantization {weights, kv}: each must be the
         string 'int8' or 'f32', with field-path errors; booleans and

@@ -125,6 +125,19 @@ class TestBoxGuard:
                     "lm_quant_draft8_speedup"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_mixed_trace_keys_in_contract(self):
+        """The chunked-prefill + prefix-affinity acceptance numbers
+        (ISSUE 13: inter-token p99 >= 2x with chunking on vs off, and
+        fleet prefill_skipped_frac >= 0.5 on a shared-system-prompt
+        mix routed across 2 replicas) ride the compact BENCH_CONTRACT
+        line; pinned like the paged-KV keys."""
+        for key in ("lm_mixed_itl_p99_off_ms", "lm_mixed_itl_p99_on_ms",
+                    "lm_mixed_itl_improvement",
+                    "lm_mixed_prefill_skipped_frac",
+                    "lm_mixed_prefill_skipped_frac_blind",
+                    "lm_mixed_affinity_hits"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_lm_mfu_keys_in_contract(self):
         """The training-MFU acceptance numbers (ISSUE 8: lm_best_mfu >=
         0.60, lm_long_mfu >= 0.45, no step-time-variance regression)

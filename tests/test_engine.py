@@ -407,6 +407,183 @@ class TestPagedKV:
 
 
 @pytest.fixture(scope="module")
+def chunked_engine(tiny_lm):
+    """Module-scoped chunked-prefill engine: one-page (16-token)
+    chunks over 16-token pages, so a 40-token prompt admits in 3
+    chunk dispatches interleaved with decode."""
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg, params = tiny_lm
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=4,
+                       name="lm-ck", kv_page_size=16,
+                       prefill_chunk_tokens=16)
+    yield eng
+    eng.close()
+
+
+class TestChunkedPrefill:
+    """Chunked prompt admission: byte parity with the one-shot oracle
+    for every chunk size, composition with prefix hits / preemption /
+    drain, and the head-of-line bound's observability."""
+
+    def test_parity_page_chunks_and_dispatch_count(self, tiny_lm,
+                                                   chunked_engine):
+        """Mixed lengths through one-page chunks: byte-identical to
+        the oracle, with exactly the chunk dispatches the shared
+        schedule (models/generate.prefill_chunks) predicts for the
+        long prompts (short tails keep the monolithic single
+        dispatch)."""
+        from kubeflow_tpu.models.generate import (LMGenerator,
+                                                  prefill_chunks)
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = chunked_engine
+        long_a = [(7 * i + 3) % 60 for i in range(40)]
+        long_b = [(3 * i + 1) % 60 for i in range(33)]
+        prompts = [long_a, [2], [1, 2, 3, 4, 5], long_b]
+        before = eng._reg().counter(
+            "kfx_lm_prefill_chunks_total").value(model="lm-ck")
+        out = eng.generate(prompts, max_new_tokens=12)
+        ref = [gen.generate([p], max_new_tokens=12)[0] for p in prompts]
+        assert out == ref
+        want = sum(len(prefill_chunks(len(p), 16, cfg.max_seq_len))
+                   for p in (long_a, long_b))
+        got = eng._reg().counter(
+            "kfx_lm_prefill_chunks_total").value(model="lm-ck") - before
+        assert got == want, (got, want)
+
+    def test_parity_two_page_and_oversize_chunks(self, tiny_lm):
+        """Chunk sizes 2*page and > prompt: parity holds; an
+        oversize chunk degenerates to the monolithic path (zero chunk
+        dispatches)."""
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        long_p = [(7 * i + 3) % 60 for i in range(40)]
+        prompts = [long_p, [13, 14]]
+        ref = [gen.generate([p], max_new_tokens=10)[0] for p in prompts]
+        for chunk, want_chunks in ((32, 2), (128, 0)):
+            eng = DecodeEngine(cfg, params, n_slots=2, chunk_tokens=4,
+                               name=f"ck{chunk}", kv_page_size=16,
+                               prefill_chunk_tokens=chunk)
+            try:
+                assert eng.generate(prompts, max_new_tokens=10) == ref
+                assert eng._reg().counter(
+                    "kfx_lm_prefill_chunks_total").value(
+                        model=f"ck{chunk}") == want_chunks
+            finally:
+                eng.close()
+
+    def test_chunked_admission_with_prefix_hit_tail(self, tiny_lm,
+                                                    chunked_engine):
+        """A prefix-cache hit under chunking skips straight to the
+        unmatched tail: the cursor starts at the matched offset, the
+        reuse counters move, and output stays byte-identical."""
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = chunked_engine
+        system = [(5 * i + 7) % 60 for i in range(36)]  # 2.25 pages
+        prompts = [system + [60 + i] for i in range(3)]
+        reused0 = eng._prefix.tokens_reused
+        out = eng.generate(prompts, max_new_tokens=8)
+        assert out == [gen.generate([p], max_new_tokens=8)[0]
+                       for p in prompts]
+        # Two followers each reuse >= the 2 full system pages.
+        assert eng._prefix.tokens_reused - reused0 >= 2 * 32
+
+    def test_decode_interleaves_and_stall_is_observed(self, tiny_lm,
+                                                      chunked_engine):
+        """A short request actively decoding while a long prompt
+        chunk-admits keeps making progress (both outputs exact), and
+        the decode-stall histogram observed the prefill dispatches the
+        active slot waited on."""
+        import numpy as np
+
+        from kubeflow_tpu.models.generate import LMGenerator
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = chunked_engine
+        hist = eng._reg().histogram("kfx_lm_decode_stall_seconds")
+        before = hist.count(model="lm-ck")
+        short = eng.submit([4, 5], max_new_tokens=32)
+        deadline = time.monotonic() + 30
+        while not np.any(eng._active) and time.monotonic() < deadline:
+            time.sleep(0.001)
+        long_p = [(11 * i + 5) % 60 for i in range(40)]
+        long_req = eng.submit(long_p, max_new_tokens=8)
+        assert short.result(60) == gen.generate(
+            [[4, 5]], max_new_tokens=32)[0]
+        assert long_req.result(60) == gen.generate(
+            [long_p], max_new_tokens=8)[0]
+        assert hist.count(model="lm-ck") > before
+
+    def test_preemption_mid_prefill(self, tiny_lm):
+        """Pool exhaustion while a long prompt is mid-cursor: the
+        youngest in-flight slot (the prefilling one included) preempts
+        by recompute, everything completes byte-identical, and the
+        pool drains whole."""
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = DecodeEngine(cfg, params, n_slots=8, chunk_tokens=4,
+                           name="lm-ckpp", kv_page_size=16, kv_pages=8,
+                           prefix_cache=False, prefill_chunk_tokens=16)
+        try:
+            grow = [[i + 1, i + 2, i + 3] for i in range(3)]
+            long_p = [(5 * i + 2) % 60 for i in range(40)]
+            prompts = grow + [long_p]
+            outs = eng.generate(prompts, max_new_tokens=24)
+            assert outs == [gen.generate([p], max_new_tokens=24)[0]
+                            for p in prompts]
+            assert eng._reg().counter(
+                "kfx_lm_kv_preemptions_total").value(
+                    model="lm-ckpp") >= 1
+            assert eng._mgr.n_free == eng.n_pages
+        finally:
+            eng.close()
+
+    def test_drain_mid_prefill(self, tiny_lm):
+        """drain() while a cursor is mid-prompt: the prefilling slot
+        is in-flight work — it finishes its prefill AND its decode
+        inside the drain window, byte-identical."""
+        from kubeflow_tpu.models.generate import LMGenerator
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        cfg, params = tiny_lm
+        gen = LMGenerator(cfg, params)
+        eng = DecodeEngine(cfg, params, n_slots=2, chunk_tokens=4,
+                           name="lm-ckdr", kv_page_size=16,
+                           prefill_chunk_tokens=16)
+        try:
+            eng.warm([8, 16, 64])
+            # Deterministic mid-prefill window: the wedge stall draws
+            # AFTER admission (the cursor exists) and BEFORE the chunk
+            # dispatches, so the drain provably lands mid-cursor.
+            chaos.install(chaos.parse_spec(
+                "engine.wedge:count=1,delay=1.0"))
+            long_p = [(5 * i + 2) % 60 for i in range(40)]
+            req = eng.submit(long_p, max_new_tokens=8)
+            deadline = time.monotonic() + 30
+            while not eng._prefilling and time.monotonic() < deadline:
+                time.sleep(0.0005)
+            assert eng._prefilling, "never observed a mid-prefill slot"
+            assert eng.drain(wait_s=30) is True
+            assert req.result(1) == gen.generate(
+                [long_p], max_new_tokens=8)[0]
+        finally:
+            chaos.reset()
+            eng.close()
+
+
+@pytest.fixture(scope="module")
 def kv8_engine(tiny_lm):
     from kubeflow_tpu.serving.engine import DecodeEngine
 
@@ -1057,6 +1234,11 @@ class TestEngineServing:
                           "--require", "kfx_lm_kv_bytes_per_token",
                           "--require", "kfx_lm_quant_mode",
                           "--require", "kfx_lm_prefix_cache_hits_total",
+                          "--require", "kfx_lm_prefix_tokens_reused",
+                          "--require",
+                          "kfx_lm_prompt_tokens_admitted",
+                          "--require", "kfx_lm_prefill_chunks_total",
+                          "--require", "kfx_lm_decode_stall_seconds",
                           "--require", "kfx_lm_spec_proposed_total",
                           "--require", "kfx_lm_spec_accepted_total",
                           "--require", "kfx_lm_spec_accept_rate"])

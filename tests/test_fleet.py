@@ -434,6 +434,230 @@ class TestRouterRecovery:
             router.stop()
 
 
+# -- router: prefix-affinity routing ------------------------------------------
+
+
+class TestPrefixAffinity:
+    def _router(self, capacity=512):
+        from kubeflow_tpu.obs.metrics import MetricsRegistry
+        from kubeflow_tpu.serving.router import Router
+
+        reg = MetricsRegistry()
+        router = Router(metrics=reg, name="svc", namespace="ns",
+                        affinity_capacity=capacity).start()
+        return router, reg
+
+    def test_affinity_hit_sticks_and_counts(self):
+        """Same prefix key -> same endpoint, counted on the seeded
+        kfx_router_prefix_affinity_hits_total family; keyless traffic
+        keeps plain round-robin."""
+        router, reg = self._router()
+        e1, e2 = "127.0.0.1:7001", "127.0.0.1:7002"
+        try:
+            router.default.set_endpoints([e1, e2])
+            c = reg.counter("kfx_router_prefix_affinity_hits_total")
+            assert c.value(namespace="ns", isvc="svc") == 0  # the seed
+            first = router._pick_in_set(router.default, "k1")
+            picks = {router._pick_in_set(router.default, "k1")
+                     for _ in range(5)}
+            assert picks == {first}
+            assert c.value(namespace="ns", isvc="svc") == 5
+            # Round-robin without a key alternates endpoints.
+            assert {router._pick_in_set(router.default, "")
+                    for _ in range(4)} == {e1, e2}
+        finally:
+            router.stop()
+
+    def test_ejected_target_falls_back_least_loaded(self):
+        """An ejected affinity target degrades to a least-loaded
+        healthy pick — and the map re-learns the replacement, so the
+        prefix sticks to the survivor afterwards."""
+        router, _ = self._router()
+        e1, e2, e3 = ("127.0.0.1:7001", "127.0.0.1:7002",
+                      "127.0.0.1:7003")
+        try:
+            router.default.set_endpoints([e1, e2, e3])
+            router._remember_affinity("k", router.default, e1)
+            for _ in range(3):
+                router.default.report_failure(e1)  # eject the target
+            router.default.ep_enter(e2)  # e2 busy: e3 is least-loaded
+            got = router._pick_in_set(router.default, "k")
+            assert got == e3
+            router.default.ep_exit(e2)
+            # Re-learned, under the per-set scoped key (a canary split
+            # must not churn the default set's entries).
+            assert router._affinity["default:k"] == e3
+            assert router._pick_in_set(router.default, "k") == e3
+        finally:
+            router.stop()
+
+    def test_overloaded_target_falls_back(self):
+        """An affinity target far past its least-loaded healthy peer's
+        in-flight count is 'overloaded': cache locality must not pile
+        a hot prefix onto one replica while its peers idle."""
+        from kubeflow_tpu.serving.router import BackendSet
+
+        router, _ = self._router()
+        e1, e2 = "127.0.0.1:7001", "127.0.0.1:7002"
+        try:
+            router.default.set_endpoints([e1, e2])
+            router._remember_affinity("k", router.default, e1)
+            for _ in range(BackendSet.AFFINITY_OVERLOAD_LEAD):
+                router.default.ep_enter(e1)
+            assert router._pick_in_set(router.default, "k") == e2
+        finally:
+            router.stop()
+
+    def test_lru_bound(self):
+        """The affinity map is a bounded LRU: the oldest key evicts at
+        capacity, and a touched key survives."""
+        router, _ = self._router(capacity=2)
+        e1 = "127.0.0.1:7001"
+        try:
+            router.default.set_endpoints([e1])
+            router._remember_affinity("a", router.default, e1)
+            router._remember_affinity("b", router.default, e1)
+            router._pick_in_set(router.default, "a")  # touch "a"
+            router._remember_affinity("c", router.default, e1)
+            # "b" evicted (keys scoped per backend set).
+            assert set(router._affinity) == {"default:a", "default:c"}
+        finally:
+            router.stop()
+
+    def test_chaos_affinity_loss_is_loss_free(self):
+        """router.affinity chaos (forced misses + map eviction): every
+        request still serves — affinity loss degrades to plain load
+        balancing, never a failure."""
+        s1, s2 = _StubLM([1]), _StubLM([2])
+        router, reg = self._router()
+        from kubeflow_tpu.serving.prefix import PREFIX_HEADER, \
+            affinity_key
+
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"])
+            prompt = list(range(40))
+            hdrs = {PREFIX_HEADER: affinity_key(prompt)}
+
+            def gen():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{router.port}"
+                    "/v1/models/m:generate",
+                    data=json.dumps(
+                        {"prompt_tokens": [prompt]}).encode(),
+                    headers={"Content-Type": "application/json",
+                             **hdrs})
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    return r.status
+
+            assert gen() == 200  # learn the map
+            chaos.install(chaos.parse_spec("router.affinity:count=50"))
+            try:
+                assert all(gen() == 200 for _ in range(6))
+                assert chaos.injected_counts().get(
+                    "router.affinity", 0) >= 6
+            finally:
+                chaos.reset()
+            assert not router._affinity or gen() == 200
+        finally:
+            router.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_two_replica_e2e_same_prefix_same_replica(self, lm_export):
+        """The fleet-level prefix-cache e2e: two in-process LM servers
+        behind one Router, chunked prefill ON, three same-prefix
+        requests with the client-computed X-Kfx-Prefix header — the
+        2nd and 3rd route to the SAME replica and skip the shared
+        prefill there (that replica's engine reports reused prompt
+        tokens; the other replica never saw the prefix), with zero
+        failed requests; under router.affinity chaos requests keep
+        succeeding on plain load balancing."""
+        from kubeflow_tpu.obs.metrics import MetricsRegistry
+        from kubeflow_tpu.serving.lm_server import LMPredictor
+        from kubeflow_tpu.serving.prefix import PREFIX_HEADER, \
+            affinity_key
+        from kubeflow_tpu.serving.router import Router
+        from kubeflow_tpu.serving.server import ModelServer
+
+        saved = {k: os.environ.get(k)
+                 for k in ("KFX_LM_ENGINE", "KFX_LM_SPEC",
+                           "KFX_LM_KV_PAGE_SIZE",
+                           "KFX_LM_PREFILL_CHUNK")}
+        os.environ.update({"KFX_LM_ENGINE": "1", "KFX_LM_SPEC": "0",
+                           "KFX_LM_KV_PAGE_SIZE": "16",
+                           "KFX_LM_PREFILL_CHUNK": "16"})
+        servers = []
+        router = None
+        try:
+            for _ in range(2):
+                p = LMPredictor(lm_export, name="fleet",
+                                warm_buckets=[8])
+                p.load()
+                srv = ModelServer(port=0)
+                srv.register(p)
+                srv.start()
+                servers.append(srv)
+            reg = MetricsRegistry()
+            router = Router(metrics=reg, name="fleet",
+                            namespace="ns").start()
+            router.default.set_endpoints(
+                [f"127.0.0.1:{s.port}" for s in servers])
+            system = [(5 * i + 7) % 60 for i in range(32)]  # 2 pages
+            url = (f"http://127.0.0.1:{router.port}"
+                   "/v1/models/fleet:generate")
+
+            def gen(tail_tok):
+                prompt = system + [tail_tok]
+                req = urllib.request.Request(
+                    url, data=json.dumps(
+                        {"prompt_tokens": [prompt],
+                         "max_new_tokens": 4}).encode(),
+                    headers={"Content-Type": "application/json",
+                             PREFIX_HEADER: affinity_key(prompt)})
+                with urllib.request.urlopen(req, timeout=45) as r:
+                    return json.load(r)["generated_tokens"][0]
+
+            outs = [gen(60 + i) for i in range(3)]
+            assert all(len(o) == 4 for o in outs)
+            assert reg.counter(
+                "kfx_router_prefix_affinity_hits_total").value(
+                    namespace="ns", isvc="fleet") >= 2
+
+            def engine_stats(srv):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/metrics"
+                        "?format=json", timeout=10) as r:
+                    return json.load(r)["engine"]["fleet"]
+
+            stats = [engine_stats(s) for s in servers]
+            reused = [s.get("prefix_tokens_reused", 0) for s in stats]
+            admitted = [s.get("prompt_tokens_admitted", 0)
+                        for s in stats]
+            # One replica served all three (2 followers x 2 shared
+            # pages = 64+ reused tokens); the other never admitted a
+            # prompt at all — the per-replica cache became a fleet
+            # cache.
+            assert sorted(admitted) [0] == 0, (admitted, reused)
+            assert max(reused) >= 2 * 32, (admitted, reused)
+            # Affinity loss under chaos: plain LB, zero failures.
+            chaos.install(chaos.parse_spec("router.affinity:count=10"))
+            try:
+                assert all(len(gen(50 + i)) == 4 for i in range(3))
+            finally:
+                chaos.reset()
+        finally:
+            if router is not None:
+                router.stop()
+            for srv in servers:
+                srv.stop()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
 # -- operator: crash-loop backoff (host-side unit) ----------------------------
 
 
@@ -637,6 +861,14 @@ class TestFleetSelfHealingE2E:
                     "kfx_router_recoveries_total").samples()) >= 1
             wait_for(lambda: restarts("crashed") >= 1, 30,
                      "crashed-restart counter")
+            # The reap reconcile counts the restart BEFORE it syncs
+            # status, so readyReplicas can still read the stale
+            # pre-kill 2 in that window — wait for the RESPAWNED
+            # replica's own server_ready line (a third port in the
+            # logs) before trusting readiness, the same stale-status
+            # guard leg 3 uses for the revision swap.
+            wait_for(lambda: len(_replica_ports(home)) >= 3, 120,
+                     "respawned replica to print server_ready")
             wait_for(lambda: ready_replicas() >= 2, 90,
                      "respawn after kill")
 

@@ -148,7 +148,8 @@ class TestKfxVerbs:
             "readyReplicas": {"default": 2},
             "autoscaling": {"default": {
                 "desired": 2, "target": 8,
-                "kvUtil": 0.42, "specAcceptRate": 0.87,
+                "kvUtil": 0.42, "prefillSkip": 0.63,
+                "specAcceptRate": 0.87,
                 "quant": "w8+kv8", "restarts": 3}},
         }
         clf = InferenceService.from_dict({
@@ -159,16 +160,20 @@ class TestKfxVerbs:
                       "autoscaling": {"default": {"desired": 1,
                                                   "target": 8}}}
         rows = _serving_top_rows([lm, clf])
-        assert rows[0][6] == "42%" and rows[0][7] == "87%"
+        assert rows[0][6] == "42%"
+        # SKIP% column: prompt tokens served from cached prefix pages
+        # (the fleet prefill-skip signal prefix-affinity routing moves).
+        assert rows[0][7] == "63%"
+        assert rows[0][8] == "87%"
         # Q column: the engine's quantization mode; "-" when the
         # operator never sampled one (classifier revisions).
-        assert rows[0][8] == "w8+kv8"
+        assert rows[0][9] == "w8+kv8"
         # RESTARTS column, fed from the operator's restart accounting
         # (same number kfx_replica_restarts_total counts).
-        assert rows[0][9] == "3"
+        assert rows[0][10] == "3"
         assert rows[1][6] == "-" and rows[1][7] == "-"
-        assert rows[1][8] == "-"
-        assert rows[1][9] == "-"  # operator never reported restarts
+        assert rows[1][8] == "-" and rows[1][9] == "-"
+        assert rows[1][10] == "-"  # operator never reported restarts
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main

@@ -540,6 +540,35 @@ class TestQuantEnvPlumbing:
         assert env == {}
 
 
+class TestPrefillEnvPlumbing:
+    def test_prefill_chunk_spec_exports_env(self):
+        """spec.predictor.prefillChunkTokens -> the replica's
+        KFX_LM_PREFILL_CHUNK env (the chunked-prefill knob LMPredictor
+        reads): only an explicit field exports (the predictor owns the
+        default), 0 exports as the monolithic escape hatch, and
+        non-predictor roles export nothing."""
+        from kubeflow_tpu.operators.serving import _Revision
+
+        rev = _Revision(name="default", model_name="m", model_dir="d",
+                        workdir="w", batcher=None, prefill_chunk=128)
+        env: dict = {}
+        rev._prefill_env(env)
+        assert env == {"KFX_LM_PREFILL_CHUNK": "128"}
+        env = {}
+        rev.prefill_chunk = 0
+        rev._prefill_env(env)
+        assert env == {"KFX_LM_PREFILL_CHUNK": "0"}
+        env = {}
+        rev.prefill_chunk = None
+        rev._prefill_env(env)
+        assert env == {}
+        rev.prefill_chunk = 64
+        rev.role = "explainer"
+        env = {}
+        rev._prefill_env(env)
+        assert env == {}
+
+
 @pytest.mark.slow
 class TestInferenceServiceE2E:
     def test_speculative_spec_exports_env(self):
