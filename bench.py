@@ -62,6 +62,8 @@ CONTRACT_KEYS = (
     "serving_scale_success_rate", "serving_scale_max_replicas",
     "serving_scale_cold_start_ms", "serving_scale_rolled_back",
     "serving_scale_preempted_training",
+    "obs_scrape_ms", "obs_rule_eval_ms", "obs_tsdb_window_samples",
+    "obs_engine_tokens_per_s", "obs_engine_tokens_delta_frac",
     "cpu_count", "host_speed_score", "load_avg_max",
     "contaminated_sections", "sections_skipped_for_budget",
     "bench_wall_s")
@@ -368,6 +370,14 @@ def main() -> int:
     guard.section("serving")
     serving = _bench_serving_p50()
     lm: dict = {}
+    if have_time(150, "obs_overhead"):
+        # Telemetry plane (obs/tsdb.py + obs/rules.py): one scrape
+        # cycle's cost (render + parse + ingest) with the store at a
+        # 10k-sample window, default-rule-pack evaluation cost over
+        # that window, and the engine-throughput tax of a live scrape
+        # loop (acceptance: tokens/s delta <= 2%).
+        guard.section("obs_overhead")
+        lm.update(_bench_obs_overhead())
     if have_time(chip_est(240), "lm"):
         # save_dense selective remat: keep the fat matmul outputs,
         # recompute only elementwise + the S^2 block — measured 4.8%
@@ -746,6 +756,125 @@ def _bench_lm_decode(preset: str = "small", batch: int = 4,
         }
     except Exception as e:  # secondary metric must not sink the bench
         return {prefix + "error": str(e)[:200]}
+
+
+def _bench_obs_overhead() -> dict:
+    """Telemetry-plane overhead micro-section (ISSUE 14 acceptance):
+
+    (a) ``obs_scrape_ms`` — one full scrape cycle (render a
+        plane-shaped registry, parse its exposition text, ingest into
+        the store) with every series already holding a 10k-sample ring
+        buffer (the worst-case window the retention caps allow);
+    (b) ``obs_rule_eval_ms`` — evaluating the DEFAULT rule pack
+        against that 10k-deep store;
+    (c) ``obs_engine_tokens_delta_frac`` — the decode-engine
+        throughput tax of a live 0.25s scrape-loop (registry render +
+        parse + ingest + rule eval on a background thread, the
+        contention a real replica sees); the acceptance bar is <= 2%.
+    """
+    prefix = "obs_"
+    eng = None
+    scraper = None
+    try:
+        import numpy as np
+
+        import jax
+
+        from kubeflow_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        from kubeflow_tpu.obs.metrics import MetricsRegistry
+        from kubeflow_tpu.obs.rules import RuleEngine, default_rules
+        from kubeflow_tpu.obs.tsdb import TSDB, CentralScraper
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.utils.prom import parse_prom_text
+
+        window_samples = 10_000
+        # A plane-shaped registry: ~50 families incl. every family the
+        # default rule pack queries, labelled like the real plane's.
+        reg = MetricsRegistry()
+        for i in range(40):
+            reg.counter(f"kfx_synth_{i}_total").inc(1 + i, shard="0")
+        req = reg.counter("kfx_router_requests_total")
+        restarts = reg.counter("kfx_replica_restarts_total")
+        rec_h = reg.histogram("kfx_reconcile_duration_seconds")
+        qw_h = reg.histogram("kfx_lm_queue_wait_seconds")
+        tsdb = TSDB(retention_s=1e12, max_samples=window_samples,
+                    max_series=16384)
+        families = parse_prom_text(reg.render())
+        # Fill every ring buffer to its 10k cap with advancing
+        # timestamps (0.06s spacing: the pack's 60-300s windows then
+        # cover 1k-5k points each) — the state one long-lived plane
+        # reaches and stays at.
+        base_ts = 1_000_000.0
+        for i in range(window_samples):
+            tsdb.ingest(families, ts=base_ts + i * 0.06)
+        now = base_ts + window_samples * 0.06
+        # (a) the real cycle, registry values advancing per scrape.
+        reps = 15
+        t0 = time.perf_counter()
+        for i in range(reps):
+            req.inc(3, namespace="default", isvc="fleet",
+                    revision="default", code="2xx")
+            restarts.inc(0, namespace="default", isvc="fleet",
+                         revision="default", reason="crashed")
+            rec_h.observe(0.004, kind="InferenceService")
+            qw_h.observe(0.02, model="fleet")
+            tsdb.ingest(parse_prom_text(reg.render()),
+                        ts=now + (i + 1) * 0.06)
+        scrape_ms = (time.perf_counter() - t0) * 1000.0 / reps
+        # (b) the default pack over the 10k-deep store.
+        rules = RuleEngine(tsdb, default_rules())
+        now += (reps + 1) * 0.06
+        t0 = time.perf_counter()
+        for i in range(reps):
+            rules.evaluate(now=now + i * 0.06)
+        rule_ms = (time.perf_counter() - t0) * 1000.0 / reps
+        # (c) engine tokens/s, unscraped vs under a live scrape loop.
+        cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=2,
+                                head_dim=32, n_layers=2, d_ff=128,
+                                max_seq_len=192,
+                                dtype=jax.numpy.float32)
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
+        rng = np.random.default_rng(0)
+        clients, max_new = 4, 48
+        eng = DecodeEngine(cfg, params, n_slots=clients, chunk_tokens=8,
+                           name="obsbench", kv_page_size=16)
+        eng.warm([64])
+
+        def leg():
+            prompts = [list(rng.integers(0, cfg.vocab_size, 48))
+                       for _ in range(clients)]
+            t0 = time.perf_counter()
+            eng.generate(prompts, max_new_tokens=max_new)
+            return clients * max_new / (time.perf_counter() - t0)
+
+        leg()  # warm the full path
+        base = max(leg(), leg())
+        live_tsdb = TSDB()
+        scraper = CentralScraper(
+            live_tsdb, reg, interval_s=0.25,
+            rules=RuleEngine(live_tsdb, default_rules())).start()
+        time.sleep(0.3)  # the loop is provably running mid-leg
+        scraped = max(leg(), leg())
+        scraper.stop()
+        delta = max(0.0, (base - scraped) / base) if base > 0 else 0.0
+        return {
+            prefix + "scrape_ms": round(scrape_ms, 3),
+            prefix + "rule_eval_ms": round(rule_ms, 3),
+            prefix + "tsdb_window_samples": window_samples,
+            prefix + "engine_tokens_per_s": round(base, 1),
+            prefix + "engine_tokens_per_s_scraped": round(scraped, 1),
+            prefix + "engine_tokens_delta_frac": round(delta, 4),
+        }
+    except Exception as e:  # secondary metric must not sink the bench
+        return {prefix + "error": str(e)[:200]}
+    finally:
+        if scraper is not None:
+            scraper.stop()
+        if eng is not None:
+            eng.close()
 
 
 def _bench_lm_engine(preset: str = "small", clients: int = 8,

@@ -35,6 +35,9 @@ used by local kfx invocations on the server's own box.
 Routes:
   GET    /healthz                                 liveness
   GET    /version
+  GET    /metrics[?format=json]                   registry render/snapshot
+  GET    /query?family=&fn=&labels=&since=        telemetry window query
+  GET    /alerts                                  alert-rule states
   GET    /apis                                    registered kinds
   GET    /apis/{kind}[?namespace=ns]              list (JSON)
   GET    /apis/{kind}/{ns}/{name}                 object (JSON)
@@ -189,6 +192,22 @@ class UserTokens:
                     hmac.compare_digest(self._hash(token), ref))
 
 
+def parse_label_selector(text: str) -> dict:
+    """``k=v,k2=v2`` -> dict (the /query and `kfx query -l` label
+    selector). Empty input -> {}. A clause without '=' raises."""
+    out = {}
+    for clause in (text or "").split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        k, sep, v = clause.partition("=")
+        if not sep or not k.strip():
+            raise ValueError(f"bad label selector clause {clause!r} "
+                             f"(want key=value)")
+        out[k.strip()] = v.strip()
+    return out
+
+
 def metrics_json(snapshot: dict) -> dict:
     """Project the registry snapshot (the ONE metrics source — the
     exposition text renders the same state) into the legacy JSON shape
@@ -331,6 +350,10 @@ class _Handler(BaseHTTPRequestHandler):
                         200, metrics_json(self.cp.metrics.snapshot()))
                 return self._send(
                     200, self.cp.metrics.render().encode(), PROM_CTYPE)
+            if url.path == "/query":
+                return self._query(q)
+            if url.path == "/alerts":
+                return self._json(200, {"alerts": self.cp.alerts.states()})
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
             if parts == ["ui", "notebooks"]:
@@ -349,6 +372,27 @@ class _Handler(BaseHTTPRequestHandler):
             return self._unavailable(e)
         except Exception as e:  # never abort the connection mid-response
             return self._error(500, f"{type(e).__name__}: {e}")
+
+    def _query(self, q) -> None:
+        """GET /query?family=&fn=rate|p99|max|...&labels=k=v,k2=v2&
+        since=60 — the telemetry-store window query behind `kfx
+        query`: the aggregate value plus the point series a sparkline
+        renders (obs/tsdb.py QueryResult)."""
+        family = (q.get("family") or [""])[0]
+        if not family:
+            return self._error(400, "family is required")
+        fn = (q.get("fn") or ["latest"])[0]
+        try:
+            since = float((q.get("since") or ["60"])[0])
+        except ValueError:
+            return self._error(400, "since must be a number (seconds)")
+        try:
+            labels = parse_label_selector((q.get("labels") or [""])[0])
+            res = self.cp.telemetry.query(family, fn, labels or None,
+                                          since)
+        except ValueError as e:
+            return self._error(400, str(e))
+        return self._json(200, res.to_dict())
 
     def _get_apis(self, parts: List[str], q) -> None:
         if not parts:
@@ -1108,6 +1152,22 @@ class Client:
         """The /metrics?format=json snapshot (incl. the ``sched``
         capacity/queue block the CLI summary line renders)."""
         return self._json("/metrics?format=json")
+
+    def query(self, family: str, fn: str = "latest",
+              labels: Optional[dict] = None,
+              since_s: float = 60.0) -> dict:
+        """One telemetry-store window query (GET /query) — the remote
+        half of `kfx query` and the `kfx top --watch` rate columns."""
+        from urllib.parse import quote
+
+        sel = ",".join(f"{k}={v}" for k, v in (labels or {}).items())
+        return self._json(
+            f"/query?family={quote(family)}&fn={quote(fn)}"
+            f"&since={since_s:g}&labels={quote(sel)}")
+
+    def alerts(self) -> List[dict]:
+        """Live alert-rule states (GET /alerts)."""
+        return self._json("/alerts")["alerts"]
 
 
 SERVER_MARKER = "server.json"

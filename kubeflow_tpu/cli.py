@@ -11,8 +11,8 @@ Two modes:
   thin HTTP clients (the kubectl model). Implemented in
   kubeflow_tpu.apiserver.
 
-Verbs: apply, run, get, describe, delete, logs, events, trace,
-kill-replica, server, version.
+Verbs: apply, run, get, describe, delete, logs, events, trace, top,
+queue, rollout, query, alerts, kill-replica, server, version.
 """
 
 from __future__ import annotations
@@ -224,12 +224,17 @@ class KfxCLI:
         return 0
 
     def trace(self, kind: str, name: str, namespace: str,
-              fmt: str = "ascii", output: str = "") -> int:
+              fmt: str = "ascii", output: str = "",
+              since_s: float = 0.0, min_ms: float = 0.0) -> int:
         """Cross-process timeline reconstruction (`kfx trace <job>`):
         merge the span logs of the control plane and every gang replica
         for this job's trace ID into one tree; render an ASCII
         waterfall with the critical path, or Chrome trace JSON
-        (--format=chrome) loadable in Perfetto / chrome://tracing."""
+        (--format=chrome) loadable in Perfetto / chrome://tracing.
+        ``--since N`` keeps only spans still live in the last N
+        seconds and ``--min-ms M`` drops spans shorter than M ms —
+        the long-lived-revision filters (a serving trace accretes
+        request spans forever; the waterfall must not)."""
         from .obs import timeline
         from .obs.trace import SPANS_DIRNAME, trace_of
 
@@ -253,9 +258,14 @@ class KfxCLI:
         dirs += sorted(glob.glob(os.path.join(
             self.cp.home, "serving", "*", SPANS_DIRNAME)))
         spans = timeline.load_spans(timeline.span_files(dirs), trace_id)
+        spans = timeline.filter_spans(spans, since_s=since_s,
+                                      min_duration_s=min_ms / 1000.0)
         if not spans:
             print(f"error: no spans recorded for trace {trace_id} "
-                  f"(searched {', '.join(dirs)})", file=sys.stderr)
+                  f"(searched {', '.join(dirs)}"
+                  + (f"; --since/--min-ms filtered everything out"
+                     if since_s or min_ms else "") + ")",
+                  file=sys.stderr)
             return 1
         if fmt == "chrome":
             text = json.dumps(timeline.chrome_trace(spans), indent=1)
@@ -269,14 +279,29 @@ class KfxCLI:
             print(text)
         return 0
 
-    def top(self) -> int:
+    def top(self, watch: float = 0.0, window_s: float = 30.0) -> int:
         """Live training telemetry (the `kubectl top` analogue): latest
         step/loss/throughput per training job, parsed from each chief
         log with the same stdout-metric contract the HPO collector uses
         (SURVEY.md §5.5) — so `kfx top`, Katib observations and the
         runner all agree on one number. Headed by the gang scheduler's
         capacity/queue summary; per-InferenceService replica lines
-        (ready/spawned vs the autoscaler's target) follow the table."""
+        (ready/spawned vs the autoscaler's target) follow the table,
+        with TOK/S, RPS and SKIP% computed as TRUE WINDOW RATES from
+        the central telemetry store's history buffer (obs/tsdb.py) —
+        not gauge snapshots. ``--watch N`` refreshes every N seconds."""
+        while True:
+            rc = self._top_once(window_s)
+            if watch <= 0:
+                return rc
+            try:
+                time.sleep(watch)
+            except KeyboardInterrupt:
+                return rc
+            print(f"\n--- kfx top (refresh every {watch:g}s, "
+                  f"rates over {window_s:g}s) ---")
+
+    def _top_once(self, window_s: float) -> int:
         running, queued = _slice_state(_store_jobs(self.cp))
         serving = _serving_slice_rows(
             self.cp.store.list("InferenceService"))
@@ -297,8 +322,41 @@ class KfxCLI:
                              _job_state(job)] + _telemetry_cells(text))
         rc = _print_top(rows)
         _print_serving_top(_serving_top_rows(
-            self.cp.store.list("InferenceService")))
+            self.cp.store.list("InferenceService"),
+            rates_fn=_local_rates_fn(self.cp, window_s)))
         return rc
+
+    def query(self, family: str, fn: str, labels: str,
+              since: float) -> int:
+        """Windowed telemetry query (`kfx query FAMILY --fn rate`):
+        the central store's history for any scraped family, rendered
+        as the aggregate value plus an ASCII sparkline of the window's
+        points. Shares the /query endpoint's semantics exactly."""
+        from .apiserver import parse_label_selector
+
+        try:
+            sel = parse_label_selector(labels)
+            res = self.cp.telemetry.query(family, fn, sel or None,
+                                          since)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        return _print_query(res.to_dict())
+
+    def alerts(self) -> int:
+        """Alert-rule states (`kfx alerts`): the rule pack with each
+        rule's live state/value — transitions land as kind=Alert store
+        events (`kfx events` territory); this is the "what is firing
+        right now" view."""
+        if self.cp.alerts.last_eval == 0:
+            # A passive (read-only) plane never scrapes or evaluates:
+            # rendering every rule as "inactive" would read as a green
+            # fleet during an incident the OWNING server sees.
+            print("note: rules have never been evaluated in this "
+                  "process (passive plane) — run inside `kfx server` "
+                  "or set KFX_SERVER to query the live plane",
+                  file=sys.stderr)
+        return _print_alerts(self.cp.alerts.states())
 
     def queue(self) -> int:
         """Gang-scheduler view (`kfx queue`): slice capacity, the gangs
@@ -455,7 +513,7 @@ def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
     return rows
 
 
-def _serving_top_rows(isvcs) -> List[List[str]]:
+def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
     """Per-revision replica lines for `kfx top`: ready/spawned against
     the autoscaler's desired count and concurrency target, the decode
     engine's KV-page pool utilization, prefix-cache prefill-skip
@@ -464,8 +522,14 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
     "w8"/"kv8"/"w8+kv8"/"d8"/"f32"; paged LM revisions — "-" for
     classifiers and engines with the signal absent), cumulative
     replica restarts (crashes + liveness wedge-kills, the
-    kfx_replica_restarts_total number), plus the canary traffic
-    split."""
+    kfx_replica_restarts_total number), window-rate TOK/S + RPS
+    columns, plus the canary traffic split.
+
+    ``rates_fn(namespace, isvc, revision) -> (tok_s, rps, skip)`` taps
+    the central telemetry store's history buffer: TOK/S and RPS are
+    true window rates (None renders "-"), and a non-None window
+    ``skip`` REPLACES the status snapshot's cumulative SKIP% — the
+    live number a `--watch` loop should show."""
     rows = []
     for isvc in isvcs:
         repl = isvc.status.get("replicas") or {}
@@ -481,6 +545,12 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
             kv = a.get("kvUtil")
             acc = a.get("specAcceptRate")
             skip = a.get("prefillSkip")
+            tok_s = rps = None
+            if rates_fn is not None:
+                tok_s, rps, window_skip = rates_fn(
+                    isvc.namespace, isvc.name, rev)
+                if window_skip is not None:
+                    skip = window_skip
             rows.append([
                 isvc.name, isvc.namespace, rev,
                 f"{int(ready.get(rev) or 0)}/{int(repl.get(rev) or 0)}",
@@ -492,6 +562,8 @@ def _serving_top_rows(isvcs) -> List[List[str]]:
                 str(a.get("quant") or "-"),
                 str(a["restarts"]) if a.get("restarts") is not None
                 else "-",
+                f"{tok_s:.1f}" if tok_s is not None else "-",
+                f"{rps:.1f}" if rps is not None else "-",
                 f"{pct}%" if rev == "canary" else "-"])
     return rows
 
@@ -502,7 +574,133 @@ def _print_serving_top(rows: List[List[str]]) -> None:
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
                         "DESIRED", "TARGET", "KV%", "SKIP%", "ACC%",
-                        "Q", "RESTARTS", "CANARY%"])
+                        "Q", "RESTARTS", "TOK/S", "RPS", "CANARY%"])
+
+
+def _revision_window_rates(query, namespace: str, isvc: str,
+                           revision: str, window_s: float):
+    """(tokens/s, RPS, window prefill-skip fraction) for one revision
+    from a telemetry ``query(family, fn, labels, since)`` callable —
+    the one rate derivation local and remote `kfx top` share. Any
+    signal without history in the window is None ("-")."""
+    sel = {"namespace": namespace, "isvc": isvc, "revision": revision}
+
+    def q(family, fn):
+        try:
+            res = query(family, fn, sel, window_s)
+        except Exception:
+            return None
+        return res.get("value") if isinstance(res, dict) else res.value
+
+    tok_s = q("kfx_lm_generated_tokens_total", "rate")
+    rps = q("kfx_router_requests_total", "rate")
+    reused = q("kfx_lm_prefix_tokens_reused", "delta")
+    admitted = q("kfx_lm_prompt_tokens_admitted", "delta")
+    skip = (reused / admitted) if reused is not None \
+        and admitted else None
+    return tok_s, rps, skip
+
+
+def _local_rates_fn(cp, window_s: float):
+    telemetry = getattr(cp, "telemetry", None)
+    if telemetry is None:
+        return None
+
+    def rates(namespace, isvc, revision):
+        return _revision_window_rates(telemetry.query, namespace, isvc,
+                                      revision, window_s)
+    return rates
+
+
+def _selector_dict(text: str) -> dict:
+    from .apiserver import parse_label_selector
+
+    return parse_label_selector(text)
+
+
+def _remote_rates_fn(client, window_s: float):
+    def rates(namespace, isvc, revision):
+        return _revision_window_rates(
+            lambda fam, fn, sel, since: client.query(fam, fn, sel, since),
+            namespace, isvc, revision, window_s)
+    return rates
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 60) -> str:
+    """Downsampled unicode sparkline of a value series."""
+    if not values:
+        return ""
+    if len(values) > width:
+        # Bucket-mean downsample so a long window still fits one line.
+        step = len(values) / width
+        buckets = []
+        for i in range(width):
+            chunk = values[int(i * step):int((i + 1) * step)] or \
+                [values[min(int(i * step), len(values) - 1)]]
+            buckets.append(sum(chunk) / len(chunk))
+        values = buckets
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    out = []
+    for v in values:
+        idx = int((v - lo) / span * (len(_SPARK_BLOCKS) - 1)) if span \
+            else 0
+        out.append(_SPARK_BLOCKS[idx])
+    return "".join(out)
+
+
+def _fmt_value(v, fn: str) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.4g}/s" if fn == "rate" else f"{v:.4g}"
+
+
+def _print_query(res: dict) -> int:
+    """Render one /query result (shared by local and remote `kfx
+    query`). rc 1 when the window holds no samples at all — the
+    scriptable 'is there history' signal."""
+    pts = res.get("points") or []
+    value = res.get("value")
+    fn = res.get("fn", "latest")
+    print(f"{res.get('family')} {fn}[{res.get('since'):g}s] = "
+          f"{_fmt_value(value, fn)}  "
+          f"({res.get('seriesMatched', 0)} series, {len(pts)} points)")
+    if pts:
+        values = [v for _, v in pts]
+        span = pts[-1][0] - pts[0][0]
+        print(f"  {_sparkline(values)}")
+        print(f"  min {min(values):.4g}  max {max(values):.4g}  "
+              f"span {span:.0f}s")
+    if value is None and not pts:
+        print("  no samples in the window (is the scraper running? "
+              "`kfx query` needs a live `kfx server` or embedded plane)")
+        return 1
+    return 0
+
+
+def _print_alerts(states: List[dict]) -> int:
+    """Render the rule states (shared by local and remote `kfx
+    alerts`). rc 1 while anything is firing — scriptable like a
+    health check."""
+    rows = []
+    firing = 0
+    for st in states:
+        if st.get("state") == "firing":
+            firing += 1
+        val = st.get("value")
+        rows.append([st.get("name", ""), st.get("severity", ""),
+                     str(st.get("state", "")),
+                     f"{val:.4g}" if isinstance(val, (int, float))
+                     else "-",
+                     st.get("expr", "")])
+    if not rows:
+        print("no alert rules loaded")
+        return 0
+    _print_table(rows, ["RULE", "SEVERITY", "STATE", "VALUE", "EXPR"])
+    return 1 if firing else 0
 
 
 def _print_rollouts(isvcs) -> int:
@@ -661,9 +859,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="chrome = Perfetto-loadable trace-event JSON")
     sp.add_argument("-o", "--output", default="",
                     help="write to a file instead of stdout")
+    sp.add_argument("--since", type=float, default=0.0,
+                    help="only spans still live in the last N seconds "
+                         "(0 = no time filter)")
+    sp.add_argument("--min-ms", type=float, default=0.0,
+                    help="drop spans shorter than this many ms")
 
-    sub.add_parser("top", help="live training telemetry (latest step/"
-                               "loss/throughput per job)")
+    sp = sub.add_parser("top", help="live training telemetry (latest "
+                                    "step/loss/throughput per job)")
+    sp.add_argument("--watch", type=float, default=0.0, metavar="N",
+                    help="refresh every N seconds (rate columns are "
+                         "true window rates from the telemetry store)")
+    sp.add_argument("--window", type=float, default=30.0,
+                    help="rate-column window in seconds (default 30)")
+
+    sp = sub.add_parser(
+        "query", help="windowed telemetry query against the central "
+                      "scrape store (rate/delta/pNN/max over history)")
+    sp.add_argument("family", help="metric family, e.g. "
+                                   "kfx_router_requests_total")
+    sp.add_argument("--fn", default="latest",
+                    choices=["latest", "rate", "delta", "max", "min",
+                             "avg", "p50", "p90", "p99"])
+    sp.add_argument("-l", "--labels", default="",
+                    help="label selector, e.g. isvc=fleet,code=5xx")
+    sp.add_argument("--since", type=float, default=60.0,
+                    help="window in seconds (default 60)")
+
+    sub.add_parser("alerts", help="alert-rule states (pending/firing/"
+                                  "resolved ride kind=Alert events)")
 
     sub.add_parser("queue", help="gang-scheduler state: slice capacity, "
                                  "running gangs (incl. serving "
@@ -753,7 +977,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
             print(p)
         return 0
     _REMOTE_VERBS = ("apply", "run", "get", "describe", "delete", "logs",
-                     "events", "top", "queue", "rollout")
+                     "events", "top", "queue", "rollout", "query",
+                     "alerts")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
     if os.environ.get("KFX_SERVER") and args.cmd == "trace":
@@ -805,7 +1030,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
                            "delete", "kill-replica", "top", "trace",
-                           "queue", "rollout")
+                           "queue", "rollout", "query", "alerts")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -861,9 +1086,15 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return cli.events(args.kind, args.name, args.namespace)
         if args.cmd == "trace":
             return cli.trace(args.kind, args.name, args.namespace,
-                             args.format, args.output)
+                             args.format, args.output,
+                             since_s=args.since, min_ms=args.min_ms)
         if args.cmd == "top":
-            return cli.top()
+            return cli.top(watch=args.watch, window_s=args.window)
+        if args.cmd == "query":
+            return cli.query(args.family, args.fn, args.labels,
+                             args.since)
+        if args.cmd == "alerts":
+            return cli.alerts()
         if args.cmd == "queue":
             return cli.queue()
         if args.cmd == "rollout":
@@ -1078,22 +1309,47 @@ def _remote_dispatch(client, args) -> int:
     if args.cmd == "top":
         from .apiserver import ApiError
 
-        print(_remote_capacity_summary(client))
-        rows = []
-        for kind in _training_kinds():
-            for o in client.list(kind):
-                ns = o["metadata"].get("namespace", "default")
-                name = o["metadata"]["name"]
-                try:
-                    # Tail: don't download whole logs for a few lines.
-                    text = client.logs_tail(kind, ns, name)
-                except ApiError:
-                    text = ""
-                rows.append([name, kind, ns, _dict_state(o)]
-                            + _telemetry_cells(text))
-        rc = _print_top(rows)
-        _print_serving_top(_serving_top_rows(_remote_isvcs(client)))
-        return rc
+        watch = getattr(args, "watch", 0.0)
+        window = getattr(args, "window", 30.0)
+        while True:
+            print(_remote_capacity_summary(client))
+            rows = []
+            for kind in _training_kinds():
+                for o in client.list(kind):
+                    ns = o["metadata"].get("namespace", "default")
+                    name = o["metadata"]["name"]
+                    try:
+                        # Tail: don't download whole logs for a few
+                        # lines.
+                        text = client.logs_tail(kind, ns, name)
+                    except ApiError:
+                        text = ""
+                    rows.append([name, kind, ns, _dict_state(o)]
+                                + _telemetry_cells(text))
+            rc = _print_top(rows)
+            _print_serving_top(_serving_top_rows(
+                _remote_isvcs(client),
+                rates_fn=_remote_rates_fn(client, window)))
+            if watch <= 0:
+                return rc
+            try:
+                time.sleep(watch)
+            except KeyboardInterrupt:
+                return rc
+            print(f"\n--- kfx top (refresh every {watch:g}s, rates "
+                  f"over {window:g}s) ---")
+    if args.cmd == "query":
+        from .apiserver import ApiError
+
+        try:
+            return _print_query(client.query(
+                args.family, args.fn,
+                _selector_dict(args.labels), args.since))
+        except (ApiError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    if args.cmd == "alerts":
+        return _print_alerts(client.alerts())
     if args.cmd == "queue":
         print(_remote_capacity_summary(client))
         running, queued = _slice_state(_remote_jobs(client))

@@ -120,6 +120,24 @@ class ControlPlane:
                                capacity=self.gangs.slice_capacity(),
                                metrics=self.metrics)
         self.metrics.add_collector(self.sched.collect)
+        # Telemetry plane (obs/tsdb.py + obs/rules.py): the bounded
+        # time-series store every history consumer reads (autoscaler
+        # SLO windows, operator status sampling, `kfx top --watch`,
+        # /query, the alert rules), fed by ONE central scraper that
+        # polls this registry plus every live serving replica's
+        # /metrics on KFX_OBS_INTERVAL seconds. Alert transitions land
+        # as kind=Alert store events.
+        from .obs.rules import RuleEngine, load_rules
+        from .obs.tsdb import TSDB, CentralScraper
+
+        self.telemetry = TSDB()
+        self.alerts = RuleEngine(self.telemetry, load_rules(),
+                                 metrics=self.metrics,
+                                 on_transition=self._record_alert_event)
+        self.scraper = CentralScraper(
+            self.telemetry, self.metrics,
+            interval_s=float(os.environ.get("KFX_OBS_INTERVAL", "1.0")),
+            targets=self._scrape_targets, rules=self.alerts)
         self._register_controllers(worker_platform)
         for ctrl in self.manager.controllers.values():
             ctrl.metrics = self.metrics
@@ -176,11 +194,22 @@ class ControlPlane:
             if hasattr(ctrl, "scheduler"):
                 ctrl.scheduler = self.sched
                 self.sched.register_waker(ctrl.KIND, ctrl.queue.add)
+        # Controllers that consume metric HISTORY (the serving
+        # operator's status sampling + rollout SLO windows) read the
+        # central telemetry store — no controller polls /metrics
+        # endpoints itself anymore.
+        for ctrl in self.manager.controllers.values():
+            if hasattr(ctrl, "telemetry"):
+                ctrl.telemetry = self.telemetry
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ControlPlane":
         if not self.passive:
             self.manager.start()
+            # The scraper only runs where the reconcile loops do: a
+            # passive (read-only) plane must not duplicate the owner's
+            # scrape traffic or evaluate alerts twice.
+            self.scraper.start()
             self._started = True
         return self
 
@@ -189,6 +218,7 @@ class ControlPlane:
 
         chaos.remove_listener(self._chaos_listener)
         if self._started:
+            self.scraper.stop()
             self.manager.stop()
             self._started = False
         for ctrl in self.manager.controllers.values():
@@ -211,6 +241,29 @@ class ControlPlane:
         self.stop()
 
     # -- observability -------------------------------------------------------
+    def _scrape_targets(self):
+        """Replica /metrics endpoints for the central scraper,
+        discovered from the serving operator's live revision state
+        (the same source the router's endpoint sets come from)."""
+        out = []
+        for ctrl in self.manager.controllers.values():
+            fn = getattr(ctrl, "scrape_targets", None)
+            if fn is not None:
+                try:
+                    out.extend(fn())
+                except Exception:
+                    pass  # discovery racing a teardown is fine
+        return out
+
+    def _record_alert_event(self, rule, reason: str, value, message: str
+                            ) -> None:
+        """Alert-transition listener: every pending/firing/resolved
+        transition becomes a kind=Alert store event (key=<rule name>),
+        so alert history reads like any other platform history."""
+        etype = "Normal" if reason == "AlertResolved" else "Warning"
+        self.store.record_raw_event("Alert", rule.name, etype, reason,
+                                    message)
+
     def _record_chaos_event(self, point: str, rule, trace_id: str,
                             span_id: str = "") -> None:
         """Chaos-injection listener: every injection in this process

@@ -1,14 +1,28 @@
-"""kfx observability: metrics registry + distributed span tracing.
+"""kfx observability: metrics registry, span tracing, telemetry plane.
 
 ``obs.metrics`` is the process-wide instrument registry every /metrics
 endpoint renders; ``obs.trace`` carries one correlation ID — and a
 Dapper-style span tree — from apiserver admission through reconciles,
 gang environments, runner step windows and serving requests, appending
 finished spans to per-process JSONL logs; ``obs.timeline`` merges those
-logs back into one trace tree for `kfx trace`. See
-docs/observability.md.
+logs back into one trace tree for `kfx trace`; ``obs.tsdb`` is the
+bounded ring-buffer time-series store the central scraper feeds
+(metric HISTORY: window rates, percentile-over-window, `kfx query`);
+``obs.rules`` evaluates the alert rule pack over it (`kfx alerts`,
+kind=Alert store events). See docs/observability.md.
 """
 
+from .rules import (  # noqa: F401
+    Rule,
+    RuleEngine,
+    default_rules,
+    load_rules,
+)
+from .tsdb import (  # noqa: F401
+    TSDB,
+    CentralScraper,
+    QueryResult,
+)
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
     Counter,

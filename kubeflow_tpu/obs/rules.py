@@ -1,0 +1,283 @@
+"""Recording/alert rules over the telemetry store (obs.tsdb).
+
+A rule is one windowed query (family + fn + label filter, the same
+query surface `kfx query` exposes) compared against a threshold, with a
+Prometheus-style ``for:`` duration gating the transition to firing:
+
+    inactive --cond--> pending --held for_s--> firing
+    pending/firing --!cond--> resolved (back to inactive)
+
+Every transition is observable three ways, deterministically on the
+scrape cycle that caused it: a ``kind=Alert`` store event (wired by the
+control plane, so `kfx events`-style tooling reads alerts like any
+other platform history), the ``kfx_alerts_firing{rule=...}`` gauge, and
+the ``kfx_alert_transitions_total{rule,to}`` counter. Evaluation is
+pure against (tsdb, now) — no clocks of its own — so the chaos e2e can
+drive pending → firing → resolved exactly.
+
+Rule syntax (docs/observability.md): a JSON object per rule —
+
+    {"name": "router-5xx-rate", "family": "kfx_router_requests_total",
+     "fn": "rate", "labels": {"code": "5xx"}, "op": ">",
+     "threshold": 0.2, "window_s": 60, "for_s": 10,
+     "severity": "warning"}
+
+``KFX_ALERT_RULES`` (a JSON list) overrides/extends the default pack
+by rule name — how a deployment tightens a window without forking the
+pack, and how the chaos e2e makes the restart-rate alert resolve
+inside a test budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .tsdb import QUERY_FNS, TSDB
+
+INACTIVE = "inactive"
+PENDING = "pending"
+FIRING = "firing"
+
+# Transition reasons as they land on kind=Alert store events.
+REASON_PENDING = "AlertPending"
+REASON_FIRING = "AlertFiring"
+REASON_RESOLVED = "AlertResolved"
+
+RULES_ENV = "KFX_ALERT_RULES"
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda v, t: v > t,
+    "<": lambda v, t: v < t,
+    ">=": lambda v, t: v >= t,
+    "<=": lambda v, t: v <= t,
+}
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    family: str
+    fn: str = "latest"
+    op: str = ">"
+    threshold: float = 0.0
+    window_s: float = 60.0
+    for_s: float = 0.0
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    severity: str = "warning"
+    summary: str = ""
+
+    def __post_init__(self):
+        if self.fn not in QUERY_FNS:
+            raise ValueError(f"rule {self.name!r}: unknown fn {self.fn!r}")
+        if self.op not in _OPS:
+            raise ValueError(f"rule {self.name!r}: unknown op {self.op!r}")
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Rule":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"rule {d.get('name', '?')!r}: unknown field(s) "
+                f"{sorted(unknown)}")
+        if not d.get("name") or not d.get("family"):
+            raise ValueError("a rule needs both 'name' and 'family'")
+        return cls(**{k: d[k] for k in d})
+
+    def expr(self) -> str:
+        """Human rendering of the condition (kfx alerts / events)."""
+        sel = ""
+        if self.labels:
+            inner = ",".join(f"{k}={v}"
+                             for k, v in sorted(self.labels.items()))
+            sel = "{" + inner + "}"
+        return (f"{self.fn}({self.family}{sel}[{self.window_s:g}s]) "
+                f"{self.op} {self.threshold:g} for {self.for_s:g}s")
+
+
+class AlertState:
+    """One rule's live state (the engine's unit of bookkeeping)."""
+
+    __slots__ = ("rule", "state", "since", "value", "transitions")
+
+    def __init__(self, rule: Rule):
+        self.rule = rule
+        self.state = INACTIVE
+        self.since = 0.0        # when the current state was entered
+        self.value: Optional[float] = None
+        self.transitions = 0
+
+    def to_dict(self) -> Dict:
+        return {"name": self.rule.name, "state": self.state,
+                "since": self.since, "value": self.value,
+                "threshold": self.rule.threshold,
+                "severity": self.rule.severity,
+                "expr": self.rule.expr(),
+                "summary": self.rule.summary}
+
+
+# fn(rule, transition_reason, value, message) — the control plane wires
+# this to a kind=Alert store event.
+TransitionHook = Callable[[Rule, str, Optional[float], str], None]
+
+
+class RuleEngine:
+    """Evaluates a rule pack against the TSDB; pure in (tsdb, now)."""
+
+    def __init__(self, tsdb: TSDB, rules: List[Rule],
+                 metrics=None, on_transition: Optional[TransitionHook] = None):
+        self.tsdb = tsdb
+        self.metrics = metrics
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._states: Dict[str, AlertState] = {
+            r.name: AlertState(r) for r in rules}
+        # Wall clock of the last evaluate() — 0.0 means the pack has
+        # never been judged (a passive plane's `kfx alerts` must say
+        # so rather than render an authoritative-looking "inactive").
+        self.last_eval = 0.0
+        if metrics is not None:
+            # Seed per-rule gauges at 0 so a pre-incident scrape (and
+            # `scrape_metrics --require kfx_alerts_firing`) already
+            # sees the pack.
+            g = metrics.gauge(
+                "kfx_alerts_firing",
+                "1 while the named alert rule is firing (kind=Alert "
+                "store events carry the transitions).")
+            c = metrics.counter(
+                "kfx_alert_transitions_total",
+                "Alert state transitions by rule and target state.")
+            for name in self._states:
+                g.set(0, rule=name)
+                c.inc(0, rule=name, to=FIRING)
+
+    def rules(self) -> List[Rule]:
+        with self._lock:
+            return [st.rule for st in self._states.values()]
+
+    def states(self) -> List[Dict]:
+        with self._lock:
+            return [st.to_dict() for st in self._states.values()]
+
+    def firing(self) -> List[str]:
+        with self._lock:
+            return sorted(name for name, st in self._states.items()
+                          if st.state == FIRING)
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> List[Dict]:
+        """One evaluation pass; returns the transitions it caused as
+        [{rule, from, to, value}] (the chaos e2e's assertion surface)."""
+        import time as _time
+
+        now = _time.time() if now is None else float(now)
+        self.last_eval = now
+        out: List[Dict] = []
+        with self._lock:
+            states = list(self._states.values())
+        for st in states:
+            r = st.rule
+            res = self.tsdb.query(r.family, r.fn, r.labels or None,
+                                  r.window_s, now=now)
+            value = res.value
+            st.value = value
+            cond = value is not None and _OPS[r.op](value, r.threshold)
+            before = st.state
+            if cond and st.state == INACTIVE:
+                self._transition(st, PENDING, now, out)
+            if cond and st.state == PENDING and \
+                    now - st.since >= r.for_s:
+                self._transition(st, FIRING, now, out)
+            elif not cond and st.state in (PENDING, FIRING):
+                self._transition(st, INACTIVE, now, out, resolved=True)
+            if before != st.state and self.metrics is not None:
+                self.metrics.gauge("kfx_alerts_firing").set(
+                    1 if st.state == FIRING else 0, rule=r.name)
+        return out
+
+    def _transition(self, st: AlertState, to: str, now: float,
+                    out: List[Dict], resolved: bool = False) -> None:
+        frm = st.state
+        st.state = to
+        st.since = now
+        st.transitions += 1
+        reason = REASON_RESOLVED if resolved else \
+            (REASON_FIRING if to == FIRING else REASON_PENDING)
+        val = "n/a" if st.value is None else f"{st.value:g}"
+        message = (f"{st.rule.expr()}: value {val} "
+                   f"({frm} -> {'resolved' if resolved else to})")
+        if st.rule.summary:
+            message = f"{st.rule.summary} — {message}"
+        out.append({"rule": st.rule.name, "from": frm,
+                    "to": "resolved" if resolved else to,
+                    "value": st.value})
+        if self.metrics is not None:
+            self.metrics.counter("kfx_alert_transitions_total").inc(
+                1, rule=st.rule.name, to="resolved" if resolved else to)
+        if self.on_transition is not None:
+            try:
+                self.on_transition(st.rule, reason, st.value, message)
+            except Exception:
+                pass  # alerting is an observer, never a failure path
+
+
+# -- the default pack ---------------------------------------------------------
+
+def default_rules() -> List[Rule]:
+    """The stock pack (docs/observability.md): the five signals the
+    platform's own incidents have needed so far. Thresholds are
+    deliberately loose — a rule that cries on a healthy test fleet
+    teaches operators to ignore the gauge."""
+    return [
+        Rule(name="reconcile-duration-p99",
+             family="kfx_reconcile_duration_seconds", fn="p99",
+             threshold=30.0, window_s=120.0, for_s=10.0,
+             severity="warning",
+             summary="controller reconciles are slow"),
+        Rule(name="router-5xx-rate",
+             family="kfx_router_requests_total", fn="rate",
+             labels={"code": "5xx"}, threshold=0.5, window_s=60.0,
+             for_s=10.0, severity="critical",
+             summary="serving fleet is shedding or failing requests"),
+        Rule(name="replica-restart-rate",
+             family="kfx_replica_restarts_total", fn="delta",
+             threshold=0.5, window_s=60.0, for_s=5.0,
+             severity="critical",
+             summary="serving replicas are restarting (crash or "
+                     "wedged-liveness kill)"),
+        Rule(name="wedged-liveness",
+             family="kfx_replica_restarts_total", fn="delta",
+             labels={"reason": "wedged"}, threshold=0.5,
+             window_s=300.0, for_s=0.0, severity="critical",
+             summary="a decode loop wedged hard enough to be killed"),
+        Rule(name="lm-queue-wait-p99",
+             family="kfx_lm_queue_wait_seconds", fn="p99",
+             threshold=10.0, window_s=120.0, for_s=10.0,
+             severity="warning",
+             summary="LM admission queue is backing up"),
+    ]
+
+
+def load_rules(env: Optional[Dict[str, str]] = None) -> List[Rule]:
+    """The effective pack: defaults overlaid by ``KFX_ALERT_RULES``
+    (a JSON list of rule objects; same ``name`` replaces the default,
+    a new name extends the pack). A malformed override raises — a
+    silently-dropped alert rule is worse than a loud startup error."""
+    env = os.environ if env is None else env
+    pack = {r.name: r for r in default_rules()}
+    raw = env.get(RULES_ENV, "")
+    if raw:
+        try:
+            overrides = json.loads(raw)
+        except ValueError as e:
+            raise ValueError(f"{RULES_ENV} is not valid JSON: {e}") from None
+        if not isinstance(overrides, list):
+            raise ValueError(f"{RULES_ENV} must be a JSON list of rules")
+        for d in overrides:
+            rule = Rule.from_dict(d)
+            pack[rule.name] = rule
+    return list(pack.values())

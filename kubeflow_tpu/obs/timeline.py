@@ -101,6 +101,28 @@ def load_spans(paths: Iterable[str],
     return spans
 
 
+def filter_spans(spans: List[Dict], since_s: float = 0.0,
+                 min_duration_s: float = 0.0,
+                 now: Optional[float] = None) -> List[Dict]:
+    """The `kfx trace --since/--min-ms` filters: keep spans whose
+    interval still overlaps the trailing ``since_s`` window (0 = no
+    time filter) and whose duration is at least ``min_duration_s``.
+    A long-lived serving revision's trace accretes request spans
+    forever — the waterfall needs a recency/size cut to stay
+    readable. Filtering is by span, not by subtree: the tree builder
+    is orphan-tolerant, so a kept child whose parent was cut still
+    renders as a root."""
+    import time as _time
+
+    if not since_s and not min_duration_s:
+        return spans
+    now = _time.time() if now is None else float(now)
+    horizon = now - since_s if since_s else float("-inf")
+    return [r for r in spans
+            if r["ts"] + r["dur"] >= horizon
+            and r["dur"] >= min_duration_s]
+
+
 # -- tree reconstruction ------------------------------------------------------
 
 def build_tree(spans: List[Dict]) -> List[Dict]:

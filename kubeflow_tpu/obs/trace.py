@@ -145,18 +145,27 @@ class _SpanSink:
 
     One open handle, line-buffered JSON — a span is durable the moment
     finish_span returns, so a worker that os._exit()s at a chaos crash
-    still leaves its timeline behind. When the file passes
-    ``MAX_BYTES`` it rotates to ``.1`` (one generation kept): a
-    long-lived plane whose resyncs reconcile forever must not grow a
-    span log without bound. The rotated generation keeps the .jsonl
-    suffix so the timeline collector still merges it."""
+    still leaves its timeline behind. When the file passes the size
+    cap it rotates to ``.1`` (one generation kept): a long-lived plane
+    whose resyncs reconcile forever — or a serving revision writing a
+    span per request — must not grow a span log without bound, so the
+    on-disk footprint is bounded at ~2x the cap per process.
+    ``KFX_SPAN_LOG_MAX_MB`` tunes the cap (default 32; a busy serving
+    fleet typically wants it smaller). The rotated generation keeps
+    the .jsonl suffix so the timeline collector still merges it."""
 
-    MAX_BYTES = 32 * 1024 * 1024
+    DEFAULT_MAX_MB = 32
     ROTATE_CHECK_EVERY = 512
 
     def __init__(self, directory: str, component: str):
         self.directory = os.path.abspath(directory)
         self.component = component
+        try:
+            max_mb = float(os.environ.get("KFX_SPAN_LOG_MAX_MB", "") or
+                           self.DEFAULT_MAX_MB)
+        except ValueError:
+            max_mb = float(self.DEFAULT_MAX_MB)
+        self.max_bytes = max(int(max_mb * 1024 * 1024), 4096)
         self.path = os.path.join(self.directory,
                                  f"{component}-{os.getpid()}.jsonl")
         self._file = None
@@ -175,7 +184,7 @@ class _SpanSink:
             self._file.write(line)
             self.written += 1
             if self.written % self.ROTATE_CHECK_EVERY == 0 and \
-                    self._file.tell() > self.MAX_BYTES:
+                    self._file.tell() > self.max_bytes:
                 self._file.close()
                 os.replace(self.path,
                            self.path[:-len(".jsonl")] + ".1.jsonl")

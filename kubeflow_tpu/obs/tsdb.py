@@ -1,0 +1,520 @@
+"""Fleet telemetry plane: bounded ring-buffer time-series store plus
+the one central scraper that feeds it.
+
+The registry (obs.metrics) and the model servers' /metrics endpoints
+expose *instantaneous* cumulative state; everything that needs metric
+HISTORY — window rates, percentile-over-window, alert `for:` durations,
+`kfx top --watch` rate columns — reads this module instead of
+hand-rolling its own sampling loop (the pre-telemetry tree had three:
+the autoscaler SLO watcher, the serving operator's status sampler and
+`kfx top`, each polling a different surface on a different clock).
+
+Model (a Prometheus-lite, sized for one control plane):
+
+  * a **series** is one (family name, label set) pair holding a ring
+    buffer of ``(unix_ts, value)`` samples — ``max_samples`` per series
+    and ``retention_s`` of history cap both memory and query cost, so
+    a 10k-object soak cannot grow the store without bound;
+  * everything is a scalar series: histogram families arrive from the
+    exposition parser as their ``_bucket``/``_sum``/``_count`` series
+    (the ``le`` label intact), and percentile-over-window is computed
+    from cumulative bucket DELTAS between the window edges — the same
+    interpolation (`obs.metrics.percentile_from_buckets`) every other
+    percentile in the tree uses;
+  * counters are queried as ``rate``/``delta`` with reset tolerance
+    (only positive steps count, the standard ``increase`` rule), so a
+    restarted replica's counter falling to zero never reads as a
+    negative rate;
+  * matching series are SUMMED per scrape timestamp before the window
+    math — `rate(kfx_router_requests_total{isvc="x"})` is the fleet
+    rate across replicas/codes unless the label filter pins one.
+
+The **CentralScraper** is the only writer: on an interval it scrapes
+the control plane's own registry (by parsing its rendered exposition
+text — the scraper deliberately eats its own dog food, which is why
+utils/prom.py's parse path is tier-1-tested against every producer)
+plus every live serving replica's ``/metrics`` (endpoints discovered
+from the serving operator's revision state), stamps fleet labels
+(namespace/isvc/revision/instance) onto the replica samples, and then
+evaluates the alert rules (obs.rules) against the fresh window.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import urllib.request
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..utils.prom import parse_prom_text
+from .metrics import percentile_from_buckets
+
+# One label set, hashable: tuple of sorted (key, value) pairs.
+LabelKey = Tuple[Tuple[str, str], ...]
+
+QUERY_FNS = ("latest", "rate", "delta", "max", "min", "avg",
+             "p50", "p90", "p99")
+
+
+def label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _matches(key: LabelKey, want: Optional[Dict[str, str]]) -> bool:
+    """Subset match: every wanted label must be present with that
+    value; extra labels on the series are fine (the scraper stamps
+    instance labels a caller usually doesn't care about)."""
+    if not want:
+        return True
+    have = dict(key)
+    return all(have.get(k) == str(v) for k, v in want.items())
+
+
+class QueryResult:
+    """One query's answer: the aggregate ``value`` (None when the
+    window holds no evidence) plus the ``points`` [(ts, v)] series the
+    sparkline renders — for rate/delta these are per-interval rates/
+    increases, for everything else the summed raw samples."""
+
+    __slots__ = ("family", "fn", "since_s", "value", "points",
+                 "series_matched")
+
+    def __init__(self, family: str, fn: str, since_s: float,
+                 value: Optional[float], points: List[Tuple[float, float]],
+                 series_matched: int):
+        self.family = family
+        self.fn = fn
+        self.since_s = since_s
+        self.value = value
+        self.points = points
+        self.series_matched = series_matched
+
+    def to_dict(self) -> Dict:
+        return {"family": self.family, "fn": self.fn,
+                "since": self.since_s, "value": self.value,
+                "points": [[round(t, 3), v] for t, v in self.points],
+                "seriesMatched": self.series_matched}
+
+
+class TSDB:
+    """Thread-safe bounded in-memory time-series store.
+
+    Retention math (docs/observability.md): memory is bounded by
+    ``max_series x max_samples`` (ts, value) float pairs, and the
+    usable query horizon is ``min(retention_s,
+    max_samples x scrape_interval)`` — at the defaults (720 samples,
+    1s interval, 600s retention) every window query up to 10 minutes
+    back is fully answerable and the store tops out at a few MB."""
+
+    def __init__(self, retention_s: float = 600.0,
+                 max_samples: int = 720, max_series: int = 8192):
+        self.retention_s = float(retention_s)
+        self.max_samples = int(max_samples)
+        self.max_series = int(max_series)
+        self._lock = threading.Lock()
+        # {family: {label_key: deque[(ts, value)]}}
+        self._series: Dict[str, Dict[LabelKey, Deque[Tuple[float, float]]]] \
+            = {}
+        self._n_series = 0
+        # {(family, label_key): first-ingest ts} — exact birth times,
+        # so "this series was born inside the query window" never has
+        # to be inferred from buffer shape (retention/maxlen eviction
+        # both make that inference lie for long-lived series).
+        self._born: Dict[Tuple[str, LabelKey], float] = {}
+        self.dropped_series = 0  # would-be series past max_series
+        self.last_ingest_ts = 0.0
+        self._ingests = 0
+
+    # How often (in ingest calls) dead series are garbage-collected.
+    GC_EVERY = 128
+
+    # -- write side ----------------------------------------------------------
+    def ingest(self, families: Dict[str, List[Tuple[Dict[str, str], float]]],
+               ts: Optional[float] = None,
+               extra_labels: Optional[Dict[str, str]] = None) -> int:
+        """Append one scrape's samples (the parse_prom_text shape:
+        {name: [(labels, value)]}), all at one timestamp, with
+        ``extra_labels`` stamped onto every sample (the scraper's
+        fleet labels). Returns samples ingested."""
+        ts = time.time() if ts is None else float(ts)
+        horizon = ts - self.retention_s
+        n = 0
+        with self._lock:
+            for name, samples in families.items():
+                fam = self._series.get(name)
+                if fam is None:
+                    fam = self._series[name] = {}
+                for labels, value in samples:
+                    if extra_labels:
+                        labels = {**labels, **extra_labels}
+                    key = label_key(labels)
+                    buf = fam.get(key)
+                    if buf is None:
+                        if self._n_series >= self.max_series:
+                            # Reclaim dead generations (replica churn
+                            # creates fresh instance-labelled series
+                            # forever) before refusing a live one —
+                            # the cap must bound memory, not blind the
+                            # plane to every new replica permanently.
+                            self._gc(horizon)
+                        if self._n_series >= self.max_series:
+                            self.dropped_series += 1
+                            continue
+                        buf = fam[key] = collections.deque(
+                            maxlen=self.max_samples)
+                        self._n_series += 1
+                        self._born[(name, key)] = ts
+                    buf.append((ts, float(value)))
+                    while buf and buf[0][0] < horizon:
+                        buf.popleft()
+                    n += 1
+            self.last_ingest_ts = ts
+            self._ingests += 1
+            if self._ingests % self.GC_EVERY == 0:
+                self._gc(horizon)
+        return n
+
+    def _gc(self, horizon: float) -> None:
+        """Drop series whose NEWEST sample predates the retention
+        horizon (caller holds the lock): a dead replica's series stop
+        arriving and would otherwise pin memory — and the series cap —
+        forever."""
+        # Emptied family dicts are kept: ingest holds a reference to
+        # the family it is filling while calling here, and dropping
+        # the entry would orphan its subsequent inserts. An empty dict
+        # per known family name is negligible.
+        for name, fam in self._series.items():
+            for key in list(fam):
+                buf = fam[key]
+                if not buf or buf[-1][0] < horizon:
+                    del fam[key]
+                    self._born.pop((name, key), None)
+                    self._n_series -= 1
+
+    # -- read side -----------------------------------------------------------
+    def families(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return self._n_series
+
+    def latest_samples(self, family: str,
+                       labels: Optional[Dict[str, str]] = None,
+                       max_age_s: Optional[float] = None
+                       ) -> List[Tuple[Dict[str, str], float]]:
+        """The newest (labels, value) per matching series — what the
+        serving operator's status sampler reads instead of polling
+        every replica's /metrics itself. ``max_age_s`` drops samples
+        older than that (wall clock): a respawned replica's replaced
+        generation keeps its dying gauges in the store until GC, and a
+        LIVE-state reader (engine queue depth, KV pool) must not sum
+        two generations of the same replica slot."""
+        cutoff = time.time() - max_age_s if max_age_s else float("-inf")
+        out = []
+        with self._lock:
+            for key, buf in self._series.get(family, {}).items():
+                if buf and buf[-1][0] >= cutoff and _matches(key, labels):
+                    out.append((dict(key), buf[-1][1]))
+        return out
+
+    def _merged(self, family: str, labels: Optional[Dict[str, str]],
+                since_ts: float) -> Tuple[List[Tuple[float, float]], int]:
+        """Matching series summed per scrape timestamp (scrapes share
+        one ts per ingest cycle), time-ordered, window-clipped."""
+        merged: Dict[float, float] = {}
+        matched = 0
+        with self._lock:
+            for key, buf in self._series.get(family, {}).items():
+                if not _matches(key, labels):
+                    continue
+                matched += 1
+                for ts, v in buf:
+                    if ts >= since_ts:
+                        merged[ts] = merged.get(ts, 0.0) + v
+        return sorted(merged.items()), matched
+
+    def _series_increases(self, family: str,
+                          labels: Optional[Dict[str, str]],
+                          since_ts: float
+                          ) -> Tuple[List[Tuple[float, float]], float,
+                                     float, int, Optional[float]]:
+        """(per-timestamp summed increases, total increase, window
+        span, series matched, earliest window ts) with the delta
+        computed PER SERIES and
+        only then summed — the Prometheus rate-then-sum rule. Summing
+        cumulative values first would turn one missed replica scrape
+        (normal fleet churn) into a dip-and-recover of that replica's
+        whole cumulative count, i.e. a spurious rate spike."""
+        merged: Dict[float, float] = {}
+        total = 0.0
+        t_first: Optional[float] = None
+        t_last: Optional[float] = None
+        matched = 0
+        with self._lock:
+            for key, buf in self._series.get(family, {}).items():
+                if not _matches(key, labels):
+                    continue
+                matched += 1
+                window = [(t, v) for t, v in buf if t >= since_ts]
+                if not window:
+                    continue
+                if t_first is None or window[0][0] < t_first:
+                    t_first = window[0][0]
+                if t_last is None or window[-1][0] > t_last:
+                    t_last = window[-1][0]
+                for (t0, v0), (t1, v1) in zip(window, window[1:]):
+                    inc = max(v1 - v0, 0.0)
+                    merged[t1] = merged.get(t1, 0.0) + inc
+                    total += inc
+        points = sorted(merged.items())
+        span = (t_last - t_first) if t_first is not None and \
+            t_last is not None and t_last > t_first else 0.0
+        return points, total, span, matched, t_first
+
+    def query(self, family: str, fn: str = "latest",
+              labels: Optional[Dict[str, str]] = None,
+              since_s: float = 60.0,
+              now: Optional[float] = None) -> QueryResult:
+        """Evaluate ``fn`` over the trailing ``since_s`` window.
+
+        rate    increase/sec of the summed counter over the window
+        delta   total increase over the window
+        latest  newest summed value
+        max/min/avg  over the summed gauge samples in the window
+        pNN     percentile from the family's ``_bucket`` series:
+                cumulative bucket deltas between window edges fed to
+                the shared interpolation
+        """
+        if fn not in QUERY_FNS:
+            raise ValueError(
+                f"unknown fn {fn!r} (one of {', '.join(QUERY_FNS)})")
+        now = time.time() if now is None else float(now)
+        since_ts = now - max(float(since_s), 0.0)
+        if fn.startswith("p"):
+            q = int(fn[1:]) / 100.0
+            value, matched = self._window_percentile(
+                family, labels, since_ts, q)
+            # Sparkline: observations landing per interval, diffed
+            # per series (the same rate-then-sum rule as counters — a
+            # missed replica scrape must not spike the point series).
+            incs = self._series_increases(f"{family}_count", labels,
+                                          since_ts)[0]
+            return QueryResult(family, fn, since_s, value, incs,
+                               matched)
+        if fn in ("rate", "delta"):
+            incs, total, span, matched, t_first = \
+                self._series_increases(family, labels, since_ts)
+            if span <= 0:
+                # Fewer than two in-window scrapes anywhere: no
+                # evidence, not a zero.
+                return QueryResult(family, fn, since_s, None, incs,
+                                   matched)
+            if fn == "delta":
+                return QueryResult(family, fn, since_s, total, incs,
+                                   matched)
+            # Sparkline points: per-interval instantaneous rates
+            # between consecutive scrape timestamps (the first
+            # interval anchors on the earliest in-window sample).
+            rates = []
+            prev_t = t_first
+            for t, inc in incs:
+                if prev_t is not None and t > prev_t:
+                    rates.append((t, inc / (t - prev_t)))
+                prev_t = t
+            return QueryResult(family, fn, since_s, total / span, rates,
+                               matched)
+        points, matched = self._merged(family, labels, since_ts)
+        if fn == "latest":
+            value = points[-1][1] if points else None
+            return QueryResult(family, fn, since_s, value, points, matched)
+        values = [v for _, v in points]
+        if not values:
+            return QueryResult(family, fn, since_s, None, points, matched)
+        value = {"max": max(values), "min": min(values),
+                 "avg": sum(values) / len(values)}[fn]
+        return QueryResult(family, fn, since_s, value, points, matched)
+
+    def _window_percentile(self, family: str,
+                           labels: Optional[Dict[str, str]],
+                           since_ts: float, q: float
+                           ) -> Tuple[Optional[float], int]:
+        """Percentile of the observations that LANDED inside the
+        window: per-``le`` cumulative deltas between the window's first
+        and last scrape, interpolated by the shared rule."""
+        per_le: Dict[float, Tuple[float, float]] = {}  # le -> (first, last)
+        matched = 0
+        with self._lock:
+            for key, buf in self._series.get(f"{family}_bucket",
+                                             {}).items():
+                have = dict(key)
+                le_s = have.pop("le", None)
+                if le_s is None or not _matches(label_key(have), labels):
+                    continue
+                window = [(t, v) for t, v in buf if t >= since_ts]
+                if not window:
+                    continue
+                matched += 1
+                le = float("inf") if le_s == "+Inf" else float(le_s)
+                first, last = per_le.get(le, (0.0, 0.0))
+                # Multiple series (several instances) fold together. A
+                # series genuinely BORN inside the window (exact birth
+                # ts tracked at first ingest — never inferred from
+                # buffer shape, which retention/maxlen eviction makes
+                # lie for long-lived series) counts all its
+                # observations, so its window base is 0; otherwise the
+                # base is its first in-window cumulative value.
+                born = self._born.get((f"{family}_bucket", key),
+                                      float("-inf"))
+                first_v = 0.0 if born >= since_ts else window[0][1]
+                per_le[le] = (first + first_v, last + window[-1][1])
+        if not per_le:
+            return None, 0
+        buckets = []
+        for le in sorted(per_le):
+            first, last = per_le[le]
+            buckets.append((le, max(int(round(last - first)), 0)))
+        # A single-scrape window has no delta; treat the cumulative
+        # state as the window when the series began inside it.
+        if buckets and buckets[-1][1] == 0:
+            return None, matched
+        return percentile_from_buckets(buckets, q), matched
+
+# -- the central scraper ------------------------------------------------------
+
+# (labels to stamp, /metrics URL) — what a discovery callback returns.
+ScrapeTarget = Tuple[Dict[str, str], str]
+
+
+class CentralScraper:
+    """One scrape loop for the whole plane (the Prometheus role,
+    SURVEY.md §5.5): each cycle ingests the plane registry's own
+    families (parsed from its rendered exposition text) plus every
+    discovered serving replica's /metrics, then evaluates the alert
+    rules. Runs as a daemon thread; ``scrape_once()`` is the
+    deterministic hook tests (and the rule engine's unit drives) use."""
+
+    def __init__(self, tsdb: TSDB, registry, interval_s: float = 1.0,
+                 targets: Optional[Callable[[], List[ScrapeTarget]]] = None,
+                 rules=None, timeout_s: float = 0.75):
+        self.tsdb = tsdb
+        self.registry = registry
+        self.interval_s = max(float(interval_s), 0.05)
+        self.targets = targets or (lambda: [])
+        self.rules = rules
+        self.timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.cycles = 0
+        # Last cycle-level exception (repr), for diagnosis: a scrape
+        # bug degrades to missing history, but it must not degrade to
+        # an invisible one.
+        self.last_error = ""
+        if registry is not None:
+            # Seed the scrape families so `scrape_metrics --require`
+            # holds before the first cycle completes.
+            registry.counter(
+                "kfx_scrape_samples_total",
+                "Samples ingested into the telemetry store by source.",
+            ).inc(0, source="plane")
+            registry.counter(
+                "kfx_scrape_samples_total").inc(0, source="replica")
+            registry.counter(
+                "kfx_scrape_errors_total",
+                "Scrape cycles that failed a target (unreachable or "
+                "malformed exposition).").inc(0, source="replica")
+            registry.gauge(
+                "kfx_scrape_targets",
+                "Replica /metrics endpoints discovered last cycle.",
+            ).set(0)
+            registry.histogram(
+                "kfx_scrape_duration_seconds",
+                "Wall time of one full scrape cycle (registry + every "
+                "replica + rule evaluation).").observe(0.0, n=0)
+
+    # -- one cycle -----------------------------------------------------------
+    def scrape_once(self, now: Optional[float] = None) -> int:
+        """Run one full cycle at ``now`` (wall clock): plane registry,
+        replica targets, rule evaluation. Returns samples ingested."""
+        now = time.time() if now is None else float(now)
+        t0 = time.perf_counter()
+        n = plane_n = replica_n = 0
+        reg = self.registry
+        # The plane's own registry, through its own exposition text:
+        # the scraper consumes exactly what an external Prometheus
+        # would, so a malformed label in any producer breaks HERE (in
+        # tier-1) and not in a real deployment's scrape.
+        if reg is not None:
+            try:
+                families = parse_prom_text(reg.render())
+                plane_n = self.tsdb.ingest(
+                    families, ts=now, extra_labels={"instance": "plane"})
+            except ValueError:
+                reg.counter("kfx_scrape_errors_total").inc(
+                    1, source="plane")
+        targets = list(self.targets() or [])
+        if reg is not None:
+            reg.gauge("kfx_scrape_targets").set(len(targets))
+        for labels, url in targets:
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self.timeout_s) as resp:
+                    text = resp.read().decode()
+                families = parse_prom_text(text)
+            except (OSError, ValueError):
+                # A dying replica mid-scale-in is normal fleet churn,
+                # not an error worth a log line; the counter records it.
+                if reg is not None:
+                    reg.counter("kfx_scrape_errors_total").inc(
+                        1, source="replica")
+                continue
+            replica_n += self.tsdb.ingest(families, ts=now,
+                                          extra_labels=labels)
+        n = plane_n + replica_n
+        if reg is not None:
+            reg.counter("kfx_scrape_samples_total").inc(
+                plane_n, source="plane")
+            reg.counter("kfx_scrape_samples_total").inc(
+                replica_n, source="replica")
+        if self.rules is not None:
+            self.rules.evaluate(now=now)
+        if reg is not None:
+            reg.histogram("kfx_scrape_duration_seconds").observe(
+                time.perf_counter() - t0)
+        self.cycles += 1
+        return n
+
+    # -- lifecycle -----------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scrape_once()
+            except Exception as e:
+                # The telemetry plane is an observer: a scrape-cycle
+                # bug must degrade to missing history, never take the
+                # control plane's thread down with it — but it is
+                # counted and kept for diagnosis, never invisible.
+                self.last_error = repr(e)
+                if self.registry is not None:
+                    try:
+                        self.registry.counter(
+                            "kfx_scrape_errors_total").inc(
+                                1, source="cycle")
+                    except Exception:
+                        pass
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "CentralScraper":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="kfx-scraper")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -126,9 +126,11 @@ class _Revision:
         self.backoff_s = 0.0
         self.backoff_until = 0.0
         self.last_crashes = 0
-        # Decode-engine queue sampling state (autoscaler load signal),
-        # plus the paged-KV pool totals for `kfx top`'s KV% column and
-        # the speculative accept rate for its ACC% column.
+        # Decode-engine load/state projections (autoscaler queue-depth
+        # signal, `kfx top`'s KV%/SKIP%/ACC%/Q columns) — refreshed
+        # each reconcile from the CENTRAL telemetry store (the one
+        # scraper polls every replica's /metrics; the operator owns no
+        # private polling loop).
         self.engine_queue = 0.0
         self.engine_kv_pages = 0.0
         self.engine_kv_free = 0.0
@@ -140,8 +142,6 @@ class _Revision:
         # cache under the router's prefix-affinity map).
         self.engine_prefix_reused = 0.0
         self.engine_prompt_tokens = 0.0
-        self.engine_sampled = float("-inf")
-        self.engine_absent = False
 
     @property
     def engine_kv_util(self):
@@ -391,9 +391,6 @@ class InferenceServiceController(Controller):
     KIND = "InferenceService"
     RESYNC_PERIOD = 1.0
 
-    # How often (at most) a revision's replicas are polled for decode-
-    # engine queue depth — the LM load signal beyond router concurrency.
-    ENGINE_SAMPLE_PERIOD_S = 1.0
     # Liveness (distinct from readiness): consecutive wedged /healthz
     # verdicts before a replica is killed for restart. Two probes one
     # reconcile apart filter a single slow-dispatch blip without
@@ -413,6 +410,10 @@ class InferenceServiceController(Controller):
         # reservations (one replica == one chip), so bursty inference
         # preempts low-priority training and returns chips on scale-in.
         self.scheduler = None
+        # Set by the control plane: the central telemetry store
+        # (obs/tsdb.py). Engine status sampling and rollout SLO windows
+        # read scraped history from here instead of polling replicas.
+        self.telemetry = None
 
     def _reg(self):
         return self.metrics if self.metrics is not None \
@@ -824,7 +825,7 @@ class InferenceServiceController(Controller):
                   revision=rev_name)
             return 0
         peak = backend_set.take_peak_concurrency()
-        queue_depth = self._engine_queue_depth(rev)
+        queue_depth = self._sample_engine(isvc, rev_name, rev)
         asc.observe(now_mono, peak, queue_depth)
         reg.gauge(
             "kfx_router_peak_concurrency",
@@ -1044,57 +1045,79 @@ class InferenceServiceController(Controller):
         self._drain_replicas(isvc, rev_name, rev.replicas,
                              self._drain_window_s(spec), reg)
 
-    def _engine_queue_depth(self, rev: _Revision) -> float:
-        """Best-effort decode-engine queue depth across the revision's
-        ready replicas (the model server's /metrics?format=json engine
-        block) — queued LM requests are unmet concurrency the router's
-        in-flight count can't see. Rate-limited; a non-LM revision is
-        detected once and never polled again."""
-        if rev.engine_absent:
-            return 0.0
-        now = time.monotonic()
-        if now - rev.engine_sampled < self.ENGINE_SAMPLE_PERIOD_S:
+    def _sample_engine(self, isvc: InferenceService, rev_name: str,
+                       rev: _Revision) -> float:
+        """Decode-engine load/state for one revision, read from the
+        CENTRAL telemetry store (obs/tsdb.py) — the scraper already
+        polls every replica's /metrics and stamps namespace/isvc/
+        revision, so the operator's status sampling is a label lookup,
+        not its own HTTP polling loop (the pre-telemetry sampler
+        urllib'd every replica's ?format=json block per reconcile).
+        Returns the summed engine queue depth (the autoscaler's unmet-
+        concurrency signal); classifier revisions simply have no
+        kfx_lm_* series and read as zeros. Without a wired telemetry
+        store (standalone controllers) the projections stay at their
+        last values."""
+        t = self.telemetry
+        if t is None:
             return rev.engine_queue
-        rev.engine_sampled = now
-        total, answered, saw_engine = 0.0, False, False
-        kv_pages, kv_free = 0.0, 0.0
-        reused, admitted = 0.0, 0.0
-        spec_rates: List[float] = []
-        quants: List[str] = []
-        for r in rev.replicas:
-            if not r.ready:
-                continue
-            try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{r.port}/metrics?format=json",
-                        timeout=0.5) as resp:
-                    engine = json.load(resp).get("engine") or {}
-                answered = True
-            except (OSError, ValueError):
-                continue
-            for row in engine.values():
-                saw_engine = True
-                total += float(row.get("queue_depth", 0.0))
-                kv_pages += float(row.get("kv_pages", 0.0))
-                kv_free += float(row.get("kv_pages_free", 0.0))
-                reused += float(row.get("prefix_tokens_reused", 0.0))
-                admitted += float(row.get("prompt_tokens_admitted",
-                                          0.0))
-                if "spec_accept_rate" in row:
-                    spec_rates.append(float(row["spec_accept_rate"]))
-                if row.get("quant"):
-                    quants.append(str(row["quant"]))
-        if answered and not saw_engine:
-            rev.engine_absent = True  # classifier server: stop polling
-        rev.engine_queue = total
-        rev.engine_kv_pages = kv_pages
-        rev.engine_kv_free = kv_free
-        rev.engine_prefix_reused = reused
-        rev.engine_prompt_tokens = admitted
-        rev.engine_spec_rate = (sum(spec_rates) / len(spec_rates)
-                                if spec_rates else None)
-        rev.engine_quant = quants[0] if quants else None
-        return total
+        sel = {"namespace": isvc.namespace, "isvc": isvc.name,
+               "revision": rev_name}
+        # LIVE-state reads only: a respawned replica's replaced
+        # generation keeps its dying per-instance gauges in the store
+        # until GC, and summing two generations of the same slot would
+        # double the queue/KV signal (spurious scale-ups).
+        fresh_s = 10.0
+
+        def total(family: str) -> float:
+            return float(sum(
+                v for _, v in t.latest_samples(family, sel,
+                                               max_age_s=fresh_s)))
+
+        rev.engine_queue = total("kfx_lm_queue_depth")
+        rev.engine_kv_pages = total("kfx_lm_kv_pages")
+        rev.engine_kv_free = total("kfx_lm_kv_pages_free")
+        rev.engine_prefix_reused = total("kfx_lm_prefix_tokens_reused")
+        rev.engine_prompt_tokens = total("kfx_lm_prompt_tokens_admitted")
+        rates = [v for _, v in
+                 t.latest_samples("kfx_lm_spec_accept_rate", sel,
+                                  max_age_s=fresh_s)]
+        rev.engine_spec_rate = (sum(rates) / len(rates)) if rates else None
+        modes = t.latest_samples("kfx_lm_quant_mode", sel,
+                                 max_age_s=fresh_s)
+        if modes:
+            from ..serving.engine import quant_mode_string
+
+            lab = modes[0][0]
+            rev.engine_quant = quant_mode_string(
+                lab.get("weights", "f32"), lab.get("kv", "f32"))
+        else:
+            rev.engine_quant = None
+        return rev.engine_queue
+
+    def scrape_targets(self):
+        """The central scraper's discovery hook: every READY predictor
+        replica's /metrics endpoint, labelled with the fleet identity
+        the telemetry queries filter on. Loading replicas have no HTTP
+        listener yet and graph components speak their own protocol —
+        neither is a target."""
+        out = []
+        with self._lock:
+            runtimes = dict(self._runtimes)
+        for key, rt in runtimes.items():
+            ns, _, name = key.partition("/")
+            for rev_name, rev in list(rt.revisions.items()):
+                if rev.role != "predictor":
+                    continue
+                for r in list(rev.replicas):
+                    if not r.ready:
+                        continue
+                    out.append((
+                        {"namespace": ns, "isvc": name,
+                         "revision": rev_name,
+                         "instance": f"127.0.0.1:{r.port}"},
+                        f"http://127.0.0.1:{r.port}/metrics"))
+        return out
 
     def _finish_cold_start(self, isvc: InferenceService, rt: _IsvcRuntime,
                            rev_name: str, reg) -> None:
@@ -1155,12 +1178,12 @@ class InferenceServiceController(Controller):
             # Re-base the SLO window at activation so pre-rollout
             # traffic never pollutes the first interval's delta.
             ro.window.advance(*revision_slo_state(
-                reg, isvc.namespace, isvc.name, "canary"))
+                self.telemetry, isvc.namespace, isvc.name, "canary"))
         plan = ro.plan
         if plan.due(now):
             p99, err_rate, n = ro.window.advance(
                 *revision_slo_state(
-                    reg, isvc.namespace, isvc.name, "canary"))
+                    self.telemetry, isvc.namespace, isvc.name, "canary"))
             tick = plan.tick(now, p99, err_rate, n)
             ro.last_obs = {
                 "p99Ms": round(p99 * 1000.0, 1) if p99 is not None else None,

@@ -15,7 +15,9 @@ decision surface unit-tests in microseconds:
   * ``SLOWindow`` — windowed p99 / error-rate deltas from cumulative
     histogram + counter state (the per-revision
     ``kfx_serving_request_seconds`` / ``kfx_router_requests_total``
-    families the router records);
+    families the router records, read back out of the CENTRAL
+    telemetry store's scraped history via ``revision_slo_state`` —
+    obs/tsdb.py; no private registry polling);
   * ``RolloutPlan`` — canary percent stepping with automatic rollback
     on SLO breach.
 
@@ -195,31 +197,41 @@ class SLOWindow:
         return p99, rate, n
 
 
-def revision_slo_state(reg, namespace: str, isvc: str, revision: str
+def revision_slo_state(telemetry, namespace: str, isvc: str, revision: str
                        ) -> Tuple[List[Tuple[float, int]], float, float]:
     """Cumulative (latency buckets, 5xx errors, total requests) for one
-    revision, read from the router-recorded plane-registry families —
-    the SLOWindow input. Filtered on namespace AND name: the registry
-    is plane-wide and isvc names are only unique per namespace."""
-    hist = reg.histogram("kfx_serving_request_seconds")
+    revision — the SLOWindow input — read from the CENTRAL telemetry
+    store (obs/tsdb.py), i.e. the newest scraped sample of each
+    router-recorded family. The operator owns no private sampling loop
+    anymore: if the scraper hasn't covered this revision yet the state
+    is empty, which the SLO machinery already treats as "silence is
+    not evidence". Filtered on namespace AND name: the plane is
+    namespace-wide and isvc names are only unique per namespace."""
+    # instance=plane pins the ROUTER-recorded series: the replicas'
+    # own kfx_serving_request_seconds{model,verb} family (scraped with
+    # the same namespace/isvc/revision stamp) uses different buckets
+    # and times a different span — mixing them would corrupt the p99.
+    sel = {"namespace": namespace, "isvc": isvc, "revision": revision,
+           "instance": "plane"}
     buckets: List[Tuple[float, int]] = []
-    for labels, hv in hist.samples():
-        if labels.get("namespace") == namespace and \
-                labels.get("isvc") == isvc and \
-                labels.get("revision") == revision:
-            buckets = hv.buckets
-            break
-    ctr = reg.counter("kfx_router_requests_total")
-    errors = total = 0.0
-    for labels, v in ctr.samples():
-        if labels.get("namespace") != namespace or \
-                labels.get("isvc") != isvc or \
-                labels.get("revision") != revision:
-            continue
-        total += v
-        if labels.get("code") == "5xx":
-            errors += v
-    return buckets, errors, total
+    if telemetry is not None:
+        by_le = {}
+        for labels, v in telemetry.latest_samples(
+                "kfx_serving_request_seconds_bucket", sel):
+            le = labels.get("le")
+            if le is None:
+                continue
+            bound = math.inf if le == "+Inf" else float(le)
+            by_le[bound] = by_le.get(bound, 0) + int(v)
+        buckets = sorted(by_le.items())
+        errors = total = 0.0
+        for labels, v in telemetry.latest_samples(
+                "kfx_router_requests_total", sel):
+            total += v
+            if labels.get("code") == "5xx":
+                errors += v
+        return buckets, errors, total
+    return buckets, 0.0, 0.0
 
 
 # -- canary rollout -----------------------------------------------------------

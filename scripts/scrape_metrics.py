@@ -9,7 +9,7 @@ Prometheus scrape — or a `kfx trace` reconstruction — would drop it.
 
 Usage:
     python scripts/scrape_metrics.py [URL ...] [--spans PATH ...] \
-        [--require FAMILY ...]
+        [--require FAMILY ...] [--inventory]
 
 With no URLs and no --spans, the control plane advertised by the
 current kfx home's server marker (``kfx server``) is scraped. A URL
@@ -18,6 +18,16 @@ fails the scrape unless the named metric family has at least one
 sample on some scraped endpoint — how CI pins the scheduler families
 (``kfx_sched_queue_seconds``, ``kfx_sched_admitted_total``, ...) to
 the plane's exposition output.
+
+``--inventory`` cross-checks every ``kfx_*`` metric family registered
+in the package source (string literals found by AST walk, f-string
+prefixes included) against the families documented in
+docs/observability.md (brace-expansions like
+``kfx_workqueue_{adds,requeues}_total`` understood): a family that
+exists in code but not in the docs FAILS, so new instrumentation
+cannot land undocumented (a tier-1 test runs exactly this check). A
+documented family no longer found in code is only warned — prose may
+legitimately describe derived series.
 """
 
 import os
@@ -128,6 +138,130 @@ def check_span_log(path: str) -> int:
     return problems
 
 
+# String literals in the package that LOOK like families but aren't:
+# module names, env-ish prefixes used as filters.
+INVENTORY_EXCLUDE = {"kfx_transformer"}
+
+# Series suffixes the exposition renderer derives from a histogram
+# family — never registered names of their own.
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _fold_suffix(name: str) -> str:
+    for suffix in _DERIVED_SUFFIXES:
+        if name.endswith(suffix):
+            return name[:-len(suffix)]
+    return name
+
+
+def code_metric_families(pkg_root: str):
+    """(exact family names, prefix patterns) found in the package
+    source: every string literal that is exactly ``kfx_<word>`` (AST
+    walk, so comments don't count but instrument-name literals and
+    docstring exact names do), plus f-string prefixes like
+    ``f"kfx_workqueue_{stat}"`` which become prefix patterns."""
+    import ast
+    import re
+
+    exact, prefixes = set(), set()
+    name_re = re.compile(r"kfx_[a-z][a-z0-9_]*$")
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn)) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    v = node.value
+                    if v in INVENTORY_EXCLUDE:
+                        continue
+                    if name_re.fullmatch(v):
+                        if v.endswith("_"):
+                            # A trailing-underscore literal is a filter
+                            # prefix (e.g. the add_external "kfx_train_"
+                            # bridge), never a family of its own.
+                            prefixes.add(v)
+                        else:
+                            exact.add(_fold_suffix(v))
+                elif isinstance(node, ast.JoinedStr) and node.values:
+                    first = node.values[0]
+                    if isinstance(first, ast.Constant) and \
+                            isinstance(first.value, str) and \
+                            first.value.startswith("kfx_"):
+                        prefixes.add(first.value)
+    return exact, prefixes
+
+
+def documented_families(doc_path: str):
+    """(families, soft) named in docs/observability.md. ``{a,b}``
+    brace tokens are ambiguous — `kfx_workqueue_{adds,requeues}_total`
+    enumerates families while `kfx_train_mfu{job,config}` lists
+    labels — so both the expansions AND the base name count as
+    documented, and everything brace-derived or prefix-shaped lands in
+    ``soft`` (matched, but never warned about when unknown)."""
+    import re
+
+    with open(doc_path) as f:
+        text = f.read()
+    out, soft = set(), set()
+    for m in re.finditer(r"kfx_[a-z0-9_{},]*[a-z0-9_}]", text):
+        token = m.group(0)
+        if "{" in token:
+            bm = re.fullmatch(r"([a-z0-9_]+)\{([a-z0-9_,]+)\}([a-z0-9_]*)",
+                              token)
+            if not bm:
+                continue
+            base = _fold_suffix(bm.group(1).rstrip("_")
+                                if not bm.group(3) else bm.group(1))
+            out.add(base)
+            soft.add(base)
+            for alt in bm.group(2).split(","):
+                name = _fold_suffix(f"{bm.group(1)}{alt}{bm.group(3)}")
+                out.add(name)
+                soft.add(name)
+        elif token.endswith("_"):
+            # A `kfx_foo_*` prose mention: a prefix claim, not a family.
+            out.add(token)
+            soft.add(token)
+        else:
+            out.add(_fold_suffix(token))
+    return out, soft
+
+
+def check_inventory(pkg_root: str = None, doc_path: str = None) -> int:
+    """The --inventory verdict: code families missing from the docs
+    are failures (count returned); documented-but-unfound names warn
+    only. Prefix patterns (f-string families) pass when any documented
+    family carries the prefix."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg_root = pkg_root or os.path.join(repo, "kubeflow_tpu")
+    doc_path = doc_path or os.path.join(repo, "docs", "observability.md")
+    exact, prefixes = code_metric_families(pkg_root)
+    docs, soft = documented_families(doc_path)
+    missing = sorted(f for f in exact if f not in docs)
+    for pre in sorted(prefixes):
+        if not any(d.startswith(pre) and d != pre for d in docs):
+            missing.append(f"{pre}* (f-string family)")
+    unknown = sorted(d for d in docs - soft if d not in exact
+                     and not any(d.startswith(p) for p in prefixes))
+    for name in missing:
+        print(f"FAIL inventory: {name} is registered in code but has "
+              f"no row/mention in {os.path.basename(doc_path)}")
+    for name in unknown:
+        print(f"warn inventory: {name} documented but not found as a "
+              f"literal in {os.path.basename(pkg_root)}/")
+    if not missing:
+        print(f"ok   inventory: {len(exact)} code families all "
+              f"documented ({len(docs)} documented total)")
+    return len(missing)
+
+
 def default_urls() -> list:
     """The apiserver advertised by this home's server marker, if any."""
     from kubeflow_tpu.apiserver import live_server_url
@@ -140,9 +274,13 @@ def default_urls() -> list:
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     urls, span_paths, required = [], [], []
+    inventory = False
     i = 0
     while i < len(args):
-        if args[i] == "--spans":
+        if args[i] == "--inventory":
+            inventory = True
+            i += 1
+        elif args[i] == "--spans":
             if i + 1 >= len(args):
                 print("--spans needs a file or directory",
                       file=sys.stderr)
@@ -159,7 +297,10 @@ def main(argv=None) -> int:
         else:
             urls.append(args[i])
             i += 1
-    if not urls and not span_paths:
+    # A pure --inventory run is a static source/docs check and needs no
+    # endpoint — but --require always needs one, so the default server
+    # discovery still applies when families are demanded.
+    if not urls and not span_paths and (required or not inventory):
         urls = default_urls()
         if not urls:
             print("no URLs given and no live `kfx server` marker found "
@@ -169,6 +310,8 @@ def main(argv=None) -> int:
     seen: set = set()
     failures = sum(check_endpoint(u, seen) for u in urls)
     failures += sum(check_span_log(p) for p in span_paths)
+    if inventory:
+        failures += check_inventory()
     for family in required:
         if family in seen:
             print(f"ok   required family {family} present")

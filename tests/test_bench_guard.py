@@ -150,6 +150,17 @@ class TestBoxGuard:
                     "lm_long_tokens_per_s"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_obs_overhead_keys_in_contract(self):
+        """The telemetry-plane overhead numbers (ISSUE 14: scrape +
+        rule-evaluation cost at a 10k-sample window, and the <= 2%
+        scrape-loop tokens/s tax) ride the compact BENCH_CONTRACT
+        line; pinned like the paged-KV keys."""
+        for key in ("obs_scrape_ms", "obs_rule_eval_ms",
+                    "obs_tsdb_window_samples",
+                    "obs_engine_tokens_per_s",
+                    "obs_engine_tokens_delta_frac"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_own_descendants_are_not_strays(self):
         # A gang worker tree spawned by THIS process is measurement, not
         # contamination — at any depth (mpi ranks are grandchildren).

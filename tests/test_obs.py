@@ -109,6 +109,95 @@ class TestRegistry:
         assert reg.snapshot()["live"]["samples"][0]["value"] == 7
 
 
+class TestHistogramRoundTrip:
+    """parse/validate round-trips on histogram edge cases — the
+    central scraper now parses the plane's OWN exposition output every
+    cycle (obs/tsdb.py), so these shapes must survive the trip, not
+    just render."""
+
+    def _roundtrip(self, reg):
+        text = reg.render()
+        assert validate_exposition(text) == []
+        return parse_prom_text(text), text
+
+    def test_zero_observation_family(self):
+        """A histogram family seeded with observe(v, n=0) (the
+        --require pre-seeding idiom): every bucket renders cumulative
+        0 and the count/sum are 0 — and the parse keeps the series."""
+        reg = MetricsRegistry()
+        reg.histogram("kfx_z_seconds", "seeded",
+                      buckets=[0.1, 1.0]).observe(0.0, n=0, model="m")
+        parsed, _ = self._roundtrip(reg)
+        buckets = {lab["le"]: v
+                   for lab, v in parsed["kfx_z_seconds_bucket"]}
+        assert buckets == {"0.1": 0, "1": 0, "+Inf": 0}
+        assert parsed["kfx_z_seconds_count"][0][1] == 0
+        assert parsed["kfx_z_seconds_sum"][0][1] == 0
+
+    def test_inf_only_bucket(self):
+        """A histogram whose ONLY bound is +Inf (buckets=[]) still
+        renders one le="+Inf" series and round-trips; the percentile
+        clamps to the (nonexistent) finite bound, i.e. 0."""
+        reg = MetricsRegistry()
+        h = reg.histogram("kfx_i_seconds", "inf-only", buckets=[])
+        h.observe(3.0)
+        h.observe(50.0)
+        parsed, _ = self._roundtrip(reg)
+        [(lab, v)] = parsed["kfx_i_seconds_bucket"]
+        assert lab["le"] == "+Inf" and v == 2
+        assert parsed["kfx_i_seconds_sum"][0][1] == 53.0
+        assert h.percentile(0.99) == 0.0  # +Inf landing clamps
+
+    def test_escaped_label_values_on_histogram_series(self):
+        """Hostile label values on HISTOGRAM series (model names ride
+        the le label's row): escaping must survive _bucket/_sum/_count
+        rendering AND the strict parse."""
+        reg = MetricsRegistry()
+        nasty = 'mo"del\\with\nnewline'
+        reg.histogram("kfx_e_seconds", "esc",
+                      buckets=[1.0]).observe(0.5, model=nasty)
+        parsed, text = self._roundtrip(reg)
+        assert r'\n' in text  # the newline is escaped, not raw
+        labs = [lab for lab, _ in parsed["kfx_e_seconds_bucket"]]
+        assert all(lab["model"] == nasty for lab in labs)
+        assert {lab["le"] for lab in labs} == {"1", "+Inf"}
+        [(lab, _)] = parsed["kfx_e_seconds_sum"]
+        assert lab == {"model": nasty}
+
+
+class TestMetricInventory:
+    def test_every_code_family_is_documented(self):
+        """The scrape_metrics --inventory contract as a tier-1 gate: a
+        kfx_* family registered anywhere in the package without a row
+        or mention in docs/observability.md fails here, so new
+        instrumentation cannot land undocumented."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        import scrape_metrics
+
+        assert scrape_metrics.main(["--inventory"]) == 0
+
+    def test_inventory_catches_an_undocumented_family(self, tmp_path):
+        """The checker itself must detect a gap: a synthetic package
+        registering a family the docs never mention fails, and the
+        same family documented passes."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        from scrape_metrics import check_inventory
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'REG.counter("kfx_totally_new_total", "h")\n')
+        doc = tmp_path / "observability.md"
+        doc.write_text("| nothing documented |\n")
+        assert check_inventory(str(pkg), str(doc)) == 1
+        doc.write_text("| `kfx_totally_new_total` | counter | — |\n")
+        assert check_inventory(str(pkg), str(doc)) == 0
+
+
 class TestExpositionValidation:
     def test_flags_malformed_lines(self):
         bad = ('# TYPE ok gauge\nok 1\n'

@@ -1,0 +1,715 @@
+"""Fleet telemetry plane (obs/tsdb.py + obs/rules.py): ring-buffer
+store semantics (retention caps, counter-reset-tolerant rates,
+percentile-over-window from scraped bucket series), the central
+scraper (own-exposition parsing, replica-target labelling, failure
+accounting), the deterministic alert state machine, the /query and
+/alerts surfaces with their `kfx query` / `kfx alerts` verbs, the
+`kfx top --watch` window-rate columns — and the acceptance chaos e2e:
+a 2-replica InferenceService fleet collected by the central scraper,
+a non-empty `kfx query` rate series, and an injected ``engine.wedge``
+driving the restart-rate alert pending -> firing -> resolved with
+matching kind=Alert store events."""
+
+import glob
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubeflow_tpu.obs.metrics import MetricsRegistry
+from kubeflow_tpu.obs.rules import Rule, RuleEngine, default_rules, \
+    load_rules
+from kubeflow_tpu.obs.tsdb import TSDB, CentralScraper
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fill(tsdb, family, points, labels=None):
+    for ts, v in points:
+        tsdb.ingest({family: [(labels or {}, v)]}, ts=ts)
+
+
+class TestTSDB:
+    def test_latest_and_label_subset_match(self):
+        t = TSDB()
+        t.ingest({"kfx_g": [({"a": "1", "b": "x"}, 3.0),
+                            ({"a": "2", "b": "x"}, 4.0)]}, ts=10.0)
+        assert t.query("kfx_g", "latest", None, 60, now=11.0).value == 7.0
+        assert t.query("kfx_g", "latest", {"a": "1"}, 60,
+                       now=11.0).value == 3.0
+        # Extra series labels are fine; a wrong value is not a match.
+        assert t.query("kfx_g", "latest", {"a": "3"}, 60,
+                       now=11.0).value is None
+        got = dict((lab["a"], v)
+                   for lab, v in t.latest_samples("kfx_g", {"b": "x"}))
+        assert got == {"1": 3.0, "2": 4.0}
+
+    def test_rate_and_delta_with_counter_reset(self):
+        t = TSDB()
+        # 0 -> 10 -> 2 (reset) -> 6: increase = 10 + 0 + 4 = 14.
+        _fill(t, "kfx_c_total",
+              [(0.0, 0.0), (10.0, 10.0), (20.0, 2.0), (30.0, 6.0)])
+        res = t.query("kfx_c_total", "delta", None, 60, now=30.0)
+        assert res.value == 14.0
+        rate = t.query("kfx_c_total", "rate", None, 60, now=30.0)
+        assert rate.value == pytest.approx(14.0 / 30.0)
+        # Sparkline points are per-interval rates; the reset interval
+        # contributes zero, never a negative.
+        assert [v for _, v in rate.points] == [1.0, 0.0, 0.4]
+
+    def test_rate_sums_matching_series(self):
+        t = TSDB()
+        t.ingest({"kfx_c_total": [({"i": "a"}, 0.0), ({"i": "b"}, 0.0)]},
+                 ts=0.0)
+        t.ingest({"kfx_c_total": [({"i": "a"}, 5.0), ({"i": "b"}, 7.0)]},
+                 ts=10.0)
+        res = t.query("kfx_c_total", "rate", None, 60, now=10.0)
+        assert res.value == pytest.approx(1.2)
+        assert res.series_matched == 2
+
+    def test_window_clips_and_single_sample_has_no_rate(self):
+        t = TSDB()
+        _fill(t, "kfx_c_total", [(0.0, 0.0), (100.0, 50.0),
+                                 (110.0, 60.0)])
+        # Window [95, 110]: only the last two samples count.
+        assert t.query("kfx_c_total", "delta", None, 15,
+                       now=110.0).value == 10.0
+        assert t.query("kfx_c_total", "rate", None, 5,
+                       now=110.0).value is None
+
+    def test_retention_caps(self):
+        t = TSDB(retention_s=50.0, max_samples=10)
+        _fill(t, "kfx_g", [(float(i), float(i)) for i in range(100)])
+        pts = t.query("kfx_g", "max", None, 1e9, now=99.0).points
+        # max_samples=10 keeps the newest 10; retention_s would allow
+        # 50 — the tighter cap wins.
+        assert len(pts) == 10 and pts[0][0] == 90.0
+        assert t.query("kfx_g", "max", None, 1e9, now=99.0).value == 99.0
+
+    def test_max_series_drops_not_grows(self):
+        t = TSDB(max_series=2)
+        t.ingest({"kfx_g": [({"i": str(i)}, 1.0) for i in range(5)]},
+                 ts=0.0)
+        assert t.series_count() == 2
+        assert t.dropped_series == 3
+
+    def test_dead_series_gc_reclaims_the_cap(self):
+        """Fleet churn (respawns mint fresh instance labels forever)
+        must not permanently blind the store: when the series cap is
+        hit, generations whose newest sample aged past retention are
+        reclaimed and the NEW replica's series are accepted."""
+        t = TSDB(max_series=2, retention_s=50.0)
+        t.ingest({"kfx_g": [({"i": "old-a"}, 1.0),
+                            ({"i": "old-b"}, 1.0)]}, ts=0.0)
+        # Old generation is dead (no samples for > retention); the new
+        # generation arrives at the cap and GC frees the room.
+        t.ingest({"kfx_g": [({"i": "new-a"}, 2.0),
+                            ({"i": "new-b"}, 2.0)]}, ts=100.0)
+        assert t.dropped_series == 0
+        got = {lab["i"] for lab, _ in t.latest_samples("kfx_g")}
+        assert got == {"new-a", "new-b"}
+
+    def test_missed_scrape_is_not_a_rate_spike(self):
+        """The Prometheus rate-then-sum rule: replica B missing ONE
+        scrape cycle (normal fleet churn) must not register its whole
+        cumulative count as an increase — the per-series delta sees a
+        flat counter, not a dip-and-recover."""
+        t = TSDB()
+        t.ingest({"kfx_c_total": [({"i": "a"}, 0.0),
+                                  ({"i": "b"}, 100.0)]}, ts=0.0)
+        t.ingest({"kfx_c_total": [({"i": "a"}, 5.0)]}, ts=10.0)  # b missed
+        t.ingest({"kfx_c_total": [({"i": "a"}, 10.0),
+                                  ({"i": "b"}, 104.0)]}, ts=20.0)
+        res = t.query("kfx_c_total", "delta", None, 60, now=20.0)
+        assert res.value == 14.0  # a: 5+5, b: 4 — NOT b's 100 re-counted
+
+    def test_latest_samples_staleness_cutoff(self):
+        """A dead generation's last gauge values linger until GC; a
+        live-state reader (the operator's engine sampler) filters them
+        with max_age_s so two generations of one replica slot never
+        sum."""
+        t = TSDB()
+        now = time.time()
+        t.ingest({"kfx_g": [({"i": "dead"}, 8.0)]}, ts=now - 120.0)
+        t.ingest({"kfx_g": [({"i": "live"}, 8.0)]}, ts=now)
+        assert len(t.latest_samples("kfx_g")) == 2
+        fresh = t.latest_samples("kfx_g", max_age_s=30.0)
+        assert [lab["i"] for lab, _ in fresh] == ["live"]
+
+    def test_percentile_full_buffer_is_not_born_inside(self):
+        """Once ring-buffer eviction has eaten the pre-window samples,
+        a window covering the whole buffer must diff against the
+        oldest RETAINED sample — not zero, which would attribute the
+        series' all-time counts to the window."""
+        t = TSDB(max_samples=4)
+        for i in range(8):  # cumulative fast observations, 0..70s
+            t.ingest({"kfx_lat_seconds_bucket": [
+                ({"le": "0.1"}, float(10 + i)),
+                ({"le": "+Inf"}, float(10 + i))]}, ts=float(i * 10))
+        # Buffer holds ts 40..70 (full); window covers all of it. The
+        # delta is 3 observations (67→70), never the all-time 70.
+        res = t.query("kfx_lat_seconds", "p99", None, 1000, now=70.0)
+        assert res.value is not None and res.value <= 0.1
+
+    def test_percentile_retention_trimmed_buffer_keeps_its_base(self):
+        """Retention eviction (not maxlen) trims a long-lived series
+        below capacity; a window covering the whole retained buffer
+        must still diff against the oldest retained sample — exact
+        birth tracking, never buffer-shape inference. Old fast
+        observations before the window must not dilute the fresh slow
+        regression into a green p99."""
+        t = TSDB(retention_s=60.0, max_samples=720)
+        for i in range(200):  # fast until t=140, slow after
+            fast = float(min(i, 140))
+            t.ingest({"kfx_lat_seconds_bucket": [
+                ({"le": "0.1"}, fast),
+                ({"le": "1"}, float(i)),
+                ({"le": "+Inf"}, float(i))]}, ts=float(i))
+        res = t.query("kfx_lat_seconds", "p99", None, 60, now=199.0)
+        assert res.value is not None and 0.1 < res.value <= 1.0
+
+    def test_percentile_over_window_from_bucket_deltas(self):
+        t = TSDB()
+        # Cumulative buckets at t=0: 10 fast obs; at t=60: +10 slow.
+        def buckets(fast, slow):
+            return {"kfx_lat_seconds_bucket": [
+                ({"le": "0.1"}, float(fast)),
+                ({"le": "1"}, float(fast + slow)),
+                ({"le": "+Inf"}, float(fast + slow))]}
+
+        t.ingest(buckets(10, 0), ts=0.0)
+        t.ingest(buckets(10, 10), ts=60.0)
+        # A window spanning both scrapes diffs the cumulative buckets:
+        # only the slow DELTA shapes the percentile (0.1 < p99 <= 1.0)
+        # — the old fast traffic is the base, never dilution.
+        p99 = t.query("kfx_lat_seconds", "p99", None, 65, now=60.0)
+        assert p99.value is not None and 0.1 < p99.value <= 1.0
+        # No new observations in the window -> no evidence.
+        t.ingest(buckets(10, 10), ts=70.0)
+        assert t.query("kfx_lat_seconds", "p99", None, 15,
+                       now=70.0).value is None
+
+    def test_unknown_fn_rejected(self):
+        with pytest.raises(ValueError, match="unknown fn"):
+            TSDB().query("kfx_g", "stddev")
+
+
+class TestRuleEngine:
+    def _tsdb_restarts(self, values):
+        t = TSDB()
+        for ts, v in values:
+            t.ingest({"kfx_replica_restarts_total": [({}, v)]}, ts=ts)
+        return t
+
+    def test_pending_firing_resolved_deterministic(self):
+        t = self._tsdb_restarts([(0.0, 0.0), (1.0, 0.0)])
+        reg = MetricsRegistry()
+        events = []
+        eng = RuleEngine(
+            t, [Rule(name="restarts", fn="delta",
+                     family="kfx_replica_restarts_total",
+                     threshold=0.5, window_s=10.0, for_s=2.0)],
+            metrics=reg,
+            on_transition=lambda r, reason, v, msg:
+                events.append((r.name, reason)))
+        assert eng.evaluate(now=1.0) == []
+        # The restart lands at t=2.
+        t.ingest({"kfx_replica_restarts_total": [({}, 1.0)]}, ts=2.0)
+        trans = eng.evaluate(now=2.0)
+        assert [x["to"] for x in trans] == ["pending"]
+        assert eng.evaluate(now=3.0) == []   # for_s not yet held
+        trans = eng.evaluate(now=4.0)
+        assert [x["to"] for x in trans] == ["firing"]
+        assert reg.gauge("kfx_alerts_firing").value(rule="restarts") == 1
+        assert eng.firing() == ["restarts"]
+        # The delta leaves the 10s window -> resolved.
+        t.ingest({"kfx_replica_restarts_total": [({}, 1.0)]}, ts=13.0)
+        trans = eng.evaluate(now=13.0)
+        assert [x["to"] for x in trans] == ["resolved"]
+        assert reg.gauge("kfx_alerts_firing").value(rule="restarts") == 0
+        assert events == [("restarts", "AlertPending"),
+                          ("restarts", "AlertFiring"),
+                          ("restarts", "AlertResolved")]
+        assert reg.counter("kfx_alert_transitions_total").value(
+            rule="restarts", to="firing") == 1
+
+    def test_for_zero_fires_in_one_pass(self):
+        t = self._tsdb_restarts([(0.0, 0.0), (1.0, 5.0)])
+        eng = RuleEngine(t, [Rule(name="r", fn="delta",
+                                  family="kfx_replica_restarts_total",
+                                  threshold=0.5, window_s=60.0)])
+        trans = eng.evaluate(now=1.0)
+        assert [x["to"] for x in trans] == ["pending", "firing"]
+
+    def test_pending_clears_without_firing(self):
+        t = self._tsdb_restarts([(0.0, 0.0), (1.0, 1.0)])
+        eng = RuleEngine(t, [Rule(name="r", fn="delta",
+                                  family="kfx_replica_restarts_total",
+                                  threshold=0.5, window_s=5.0,
+                                  for_s=30.0)])
+        assert [x["to"] for x in eng.evaluate(now=1.0)] == ["pending"]
+        t.ingest({"kfx_replica_restarts_total": [({}, 1.0)]}, ts=10.0)
+        assert [x["to"] for x in eng.evaluate(now=10.0)] == ["resolved"]
+
+    def test_default_pack_and_env_override(self, monkeypatch):
+        names = {r.name for r in default_rules()}
+        assert {"reconcile-duration-p99", "router-5xx-rate",
+                "replica-restart-rate", "wedged-liveness",
+                "lm-queue-wait-p99"} <= names
+        monkeypatch.setenv(
+            "KFX_ALERT_RULES",
+            json.dumps([{"name": "replica-restart-rate",
+                         "family": "kfx_replica_restarts_total",
+                         "fn": "delta", "threshold": 0.5,
+                         "window_s": 8, "for_s": 0.6},
+                        {"name": "extra", "family": "kfx_gangs",
+                         "fn": "max", "threshold": 3}]))
+        pack = {r.name: r for r in load_rules()}
+        assert pack["replica-restart-rate"].window_s == 8
+        assert "extra" in pack and len(pack) == len(names) + 1
+
+    def test_malformed_override_is_loud(self, monkeypatch):
+        monkeypatch.setenv("KFX_ALERT_RULES", "{not json")
+        with pytest.raises(ValueError, match="not valid JSON"):
+            load_rules()
+        monkeypatch.setenv("KFX_ALERT_RULES",
+                           json.dumps([{"name": "x", "family": "f",
+                                        "nope": 1}]))
+        with pytest.raises(ValueError, match="unknown field"):
+            load_rules()
+
+
+class _StubMetrics(threading.Thread):
+    """A fake replica /metrics endpoint (exposition text)."""
+
+    def __init__(self, text):
+        super().__init__(daemon=True)
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = stub.text.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.text = text
+        self.httpd = HTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        self.start()
+
+    def run(self):
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestCentralScraper:
+    def test_scrapes_registry_and_targets_with_labels(self):
+        reg = MetricsRegistry()
+        reg.gauge("kfx_gangs", "g").set(2)
+        stub = _StubMetrics("# TYPE kfx_lm_slots gauge\n"
+                            'kfx_lm_slots{model="m"} 8\n')
+        t = TSDB()
+        try:
+            sc = CentralScraper(
+                t, reg, targets=lambda: [(
+                    {"namespace": "ns", "isvc": "svc",
+                     "revision": "default",
+                     "instance": f"127.0.0.1:{stub.port}"},
+                    f"http://127.0.0.1:{stub.port}/metrics")])
+            n = sc.scrape_once(now=100.0)
+            assert n > 0
+            # Plane families stamped instance=plane.
+            [(lab, v)] = t.latest_samples("kfx_gangs")
+            assert v == 2 and lab["instance"] == "plane"
+            # Replica families stamped with the fleet identity.
+            [(lab, v)] = t.latest_samples("kfx_lm_slots")
+            assert v == 8 and lab["isvc"] == "svc" and lab["model"] == "m"
+            assert reg.gauge("kfx_scrape_targets").value() == 1
+        finally:
+            stub.stop()
+
+    def test_dead_target_counts_error_not_crash(self):
+        reg = MetricsRegistry()
+        t = TSDB()
+        sc = CentralScraper(
+            t, reg, targets=lambda: [({"instance": "gone"},
+                                      "http://127.0.0.1:9/metrics")])
+        sc.scrape_once(now=100.0)
+        assert reg.counter("kfx_scrape_errors_total").value(
+            source="replica") == 1
+
+    def test_rules_evaluated_on_cycle(self):
+        reg = MetricsRegistry()
+        reg.counter("kfx_replica_restarts_total").inc(0)
+        t = TSDB()
+        eng = RuleEngine(t, [Rule(name="r", fn="delta",
+                                  family="kfx_replica_restarts_total",
+                                  threshold=0.5, window_s=60.0)],
+                         metrics=reg)
+        sc = CentralScraper(t, reg, rules=eng)
+        sc.scrape_once(now=100.0)
+        assert eng.states()[0]["state"] == "inactive"
+        reg.counter("kfx_replica_restarts_total").inc(2)
+        sc.scrape_once(now=101.0)
+        assert eng.states()[0]["state"] == "firing"
+
+
+class TestQuerySurfaces:
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            yield cp
+
+    def test_query_alerts_endpoints_and_cli(self, plane, capsys):
+        from kubeflow_tpu.apiserver import ApiServer
+        from kubeflow_tpu.cli import KfxCLI
+
+        deadline = time.monotonic() + 20
+        while plane.scraper.cycles < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        with ApiServer(plane, port=0) as srv:
+            with urllib.request.urlopen(
+                    f"{srv.url}/query?family=kfx_gangs&fn=latest"
+                    "&since=60", timeout=10) as r:
+                out = json.load(r)
+            assert out["points"] and out["value"] == 0.0
+            with urllib.request.urlopen(f"{srv.url}/alerts",
+                                        timeout=10) as r:
+                alerts = json.load(r)["alerts"]
+            assert {a["name"] for a in alerts} >= {
+                "router-5xx-rate", "replica-restart-rate"}
+        cli = KfxCLI(plane)
+        assert cli.query("kfx_gangs", "latest", "", 60) == 0
+        text = capsys.readouterr().out
+        assert "kfx_gangs latest[60s]" in text
+        assert cli.query("kfx_nope", "rate", "", 60) == 1
+        capsys.readouterr()
+        rc = cli.alerts()
+        text = capsys.readouterr().out
+        assert "replica-restart-rate" in text and rc == 0
+
+    def test_bad_query_params_are_400(self, plane):
+        from kubeflow_tpu.apiserver import ApiError, ApiServer, Client
+
+        with ApiServer(plane, port=0) as srv:
+            client = Client(srv.url)
+            with pytest.raises(ApiError) as ei:
+                client.query("kfx_gangs", "stddev")
+            assert ei.value.status == 400
+            with pytest.raises(ApiError) as ei:
+                client._json("/query?fn=latest")
+            assert ei.value.status == 400
+            # The remote client query/alerts round-trip.
+            out = client.query("kfx_gangs", "latest")
+            assert out["family"] == "kfx_gangs"
+            assert any(a["name"] == "router-5xx-rate"
+                       for a in client.alerts())
+
+
+class TestTopWatchRates:
+    def test_revision_window_rates_from_history(self):
+        from kubeflow_tpu.cli import _revision_window_rates
+
+        t = TSDB()
+        sel = {"namespace": "ns", "isvc": "svc", "revision": "default"}
+        for i, ts in enumerate((0.0, 10.0)):
+            t.ingest({
+                "kfx_lm_generated_tokens_total": [(sel, 100.0 * i)],
+                "kfx_router_requests_total": [
+                    ({**sel, "code": "2xx"}, 20.0 * i)],
+                "kfx_lm_prefix_tokens_reused": [(sel, 30.0 * i)],
+                "kfx_lm_prompt_tokens_admitted": [(sel, 60.0 * i)],
+            }, ts=ts)
+        now = 10.0
+        tok_s, rps, skip = _revision_window_rates(
+            lambda fam, fn, labels, since: t.query(fam, fn, labels,
+                                                   since, now=now),
+            "ns", "svc", "default", 60.0)
+        assert tok_s == pytest.approx(10.0)
+        assert rps == pytest.approx(2.0)
+        assert skip == pytest.approx(0.5)
+
+    def test_serving_top_rows_window_rates_and_fallback(self):
+        from kubeflow_tpu.api.serving import InferenceService
+        from kubeflow_tpu.cli import _serving_top_rows
+
+        isvc = InferenceService.from_dict({
+            "metadata": {"name": "svc", "namespace": "ns"},
+            "spec": {"predictor": {"jax": {"storageUri": "file:///m"}}},
+        })
+        isvc.status = {"replicas": {"default": 1},
+                       "autoscaling": {"default": {
+                           "desired": 1, "target": 4,
+                           "prefillSkip": 0.9}}}
+        rows = _serving_top_rows(
+            [isvc], rates_fn=lambda ns, name, rev: (12.3, 4.5, 0.25))
+        # Window rates fill TOK/S + RPS, and the WINDOW skip replaces
+        # the cumulative status snapshot.
+        assert rows[0][7] == "25%"
+        assert rows[0][11] == "12.3" and rows[0][12] == "4.5"
+        # Without history the snapshot and "-" cells remain.
+        rows = _serving_top_rows(
+            [isvc], rates_fn=lambda ns, name, rev: (None, None, None))
+        assert rows[0][7] == "90%"
+        assert rows[0][11] == "-" and rows[0][12] == "-"
+
+    def test_top_watch_single_shot(self, tmp_path, capsys):
+        from kubeflow_tpu.cli import KfxCLI
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        with ControlPlane(home=str(tmp_path / "kfx"),
+                          worker_platform="cpu") as cp:
+            assert KfxCLI(cp).top(watch=0.0) == 0
+            out = capsys.readouterr().out
+            assert "slice: capacity=" in out
+
+
+class TestTraceFilters:
+    def test_filter_spans_since_and_min_duration(self):
+        from kubeflow_tpu.obs.timeline import filter_spans
+
+        spans = [
+            {"name": "old", "ts": 0.0, "dur": 5.0},
+            {"name": "recent", "ts": 95.0, "dur": 2.0},
+            {"name": "tiny", "ts": 99.0, "dur": 0.001},
+            {"name": "straddles", "ts": 80.0, "dur": 15.0},
+        ]
+        got = [s["name"] for s in
+               filter_spans(spans, since_s=10.0, now=100.0)]
+        assert got == ["recent", "tiny", "straddles"]
+        got = [s["name"] for s in
+               filter_spans(spans, min_duration_s=0.5, now=100.0)]
+        assert got == ["old", "recent", "straddles"]
+        assert filter_spans(spans) is spans  # no filters = no copy
+
+    def test_span_sink_rotation_cap_env(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.obs.trace import _SpanSink
+
+        monkeypatch.setenv("KFX_SPAN_LOG_MAX_MB", "0.000001")  # floor
+        sink = _SpanSink(str(tmp_path), "unit")
+        assert sink.max_bytes == 4096  # clamped floor
+        rec = {"name": "s", "trace": "t", "span": "x", "parent": "",
+               "ts": 1.0, "dur": 0.0, "status": "ok",
+               "pad": "y" * 64}
+        for _ in range(sink.ROTATE_CHECK_EVERY * 3):
+            sink.write(rec)
+        sink.close()
+        rotated = os.path.join(str(tmp_path), "unit-%d.1.jsonl"
+                               % os.getpid())
+        live = os.path.join(str(tmp_path), "unit-%d.jsonl"
+                            % os.getpid())
+        assert os.path.exists(rotated) and os.path.exists(live)
+        # Bounded at ~2x the cap per process: one live + one rotated
+        # generation, both still merge-able .jsonl files.
+        assert os.path.getsize(live) <= sink.max_bytes * 2
+
+
+# -- the acceptance chaos e2e -------------------------------------------------
+
+
+MANIFEST = """
+apiVersion: serving.kubeflow.org/v1beta1
+kind: InferenceService
+metadata:
+  name: tele
+spec:
+  predictor:
+    minReplicas: 2
+    maxReplicas: 2
+    drainWindowSeconds: 4
+    speculative: {{enabled: false}}
+    jax:
+      storageUri: file://{export}
+"""
+
+
+@pytest.fixture(scope="module")
+def lm_export(tmp_path_factory):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from kubeflow_tpu.serving.lm_server import export_lm
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            head_dim=16, n_layers=2, d_ff=64,
+                            max_seq_len=64, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return export_lm(str(tmp_path_factory.mktemp("tele-lm")), cfg,
+                     params)
+
+
+class TestTelemetryFleetE2E:
+    def test_scrape_query_and_wedge_alert_lifecycle(
+            self, lm_export, tmp_path, monkeypatch, capsys):
+        """The ISSUE-14 acceptance e2e on one 2-replica LM isvc:
+
+        1. the central scraper collects the fleet (replica-scraped
+           kfx_lm_* series carry the namespace/isvc/revision stamp;
+           the operator's status sampling reads them back out of the
+           store — kvUtil appears in status without any operator
+           polling loop);
+        2. `kfx query` returns a non-empty rate series for
+           kfx_router_requests_total (CLI and /query agree);
+        3. a chaos-injected engine.wedge (deterministic seeded plan,
+           shared state file across replica respawns) stalls one
+           replica's decode loop -> liveness kill (reason=wedged) ->
+           the restart-rate alert walks pending -> firing -> resolved
+           with matching kind=Alert store events, and the in-flight
+           request recovers on the peer."""
+        from kubeflow_tpu.apiserver import ApiServer
+        from kubeflow_tpu.cli import KfxCLI
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        state = str(tmp_path / "chaos-wedge.json")
+        monkeypatch.setenv("KFX_OBS_INTERVAL", "0.25")
+        monkeypatch.setenv("KFX_LM_STALL_S", "1")
+        # One wedge, drawn by the first busy decode loop (the shared
+        # state file spends the budget exactly once fleet-wide, even
+        # across the respawn).
+        monkeypatch.setenv(
+            "KFX_CHAOS",
+            f"state={state};engine.wedge:count=1,delay=25")
+        # Tighten the restart-rate rule so resolution happens inside
+        # the test budget (the documented KFX_ALERT_RULES override).
+        monkeypatch.setenv("KFX_ALERT_RULES", json.dumps([
+            {"name": "replica-restart-rate",
+             "family": "kfx_replica_restarts_total", "fn": "delta",
+             "threshold": 0.5, "window_s": 8, "for_s": 0.6}]))
+
+        def wait_for(pred, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                time.sleep(0.2)
+            raise AssertionError(f"timed out waiting for {what}")
+
+        with ControlPlane(home=str(tmp_path / "kfx")) as cp:
+            cp.apply_text(MANIFEST.format(export=lm_export))
+            cp.wait_for_condition("InferenceService", "tele", "Ready",
+                                  timeout=240)
+            url = cp.store.get("InferenceService", "tele").status["url"]
+            gen = f"{url}/v1/models/tele:generate"
+            body = json.dumps({"prompt_tokens": [[5, 9, 11, 3]],
+                               "max_new_tokens": 6,
+                               "seed": 0}).encode()
+
+            def post():
+                req = urllib.request.Request(
+                    gen, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=90) as r:
+                    return json.load(r)["generated_tokens"][0]
+
+            # First request wedges one replica's loop; the operator's
+            # liveness kill severs it mid-request and the router
+            # recovers it on the peer — the client still gets 6
+            # tokens.
+            assert len(post()) == 6
+            for _ in range(4):
+                assert len(post()) == 6
+
+            def restarts_wedged():
+                return sum(
+                    int(v) for labels, v in cp.metrics.counter(
+                        "kfx_replica_restarts_total").samples()
+                    if labels.get("reason") == "wedged")
+
+            wait_for(lambda: restarts_wedged() >= 1, 60,
+                     "wedged liveness kill")
+
+            # (1) fleet collection: replica-scraped engine series wear
+            # the fleet identity...
+            wait_for(lambda: cp.telemetry.latest_samples(
+                "kfx_lm_slots", {"isvc": "tele"}), 30,
+                "replica engine series in the central store")
+            [*slots] = cp.telemetry.latest_samples(
+                "kfx_lm_slots", {"isvc": "tele"})
+            assert all(lab["namespace"] == "default" and
+                       lab["revision"] == "default"
+                       for lab, _ in slots)
+            # ...and the operator's status sampling reads the SAME
+            # store (its urllib polling loop is gone): kvUtil lands in
+            # status.autoscaling off scraped history.
+            wait_for(lambda: "kvUtil" in (
+                (cp.store.get("InferenceService", "tele").status
+                 .get("autoscaling") or {}).get("default") or {}), 30,
+                "status kvUtil sampled from the central store")
+
+            # (2) non-empty rate series, CLI + endpoint agreeing. The
+            # plane is scrape-based: the counter lands in the registry
+            # the moment the router records it, but history needs the
+            # NEXT scrape cycles to pick it up — wait for two samples
+            # (a rate needs a delta), like any Prometheus consumer.
+            wait_for(lambda: cp.telemetry.query(
+                "kfx_router_requests_total", "rate",
+                {"isvc": "tele"}, 120).value is not None, 15,
+                "scraped router-request history")
+            assert not cp.scraper.last_error, cp.scraper.last_error
+            capsys.readouterr()
+            cli = KfxCLI(cp)
+            assert cli.query("kfx_router_requests_total", "rate",
+                             "isvc=tele", 120) == 0
+            out = capsys.readouterr().out
+            assert "kfx_router_requests_total rate[120s]" in out
+            assert "min" in out  # the sparkline stats line rendered
+            with ApiServer(cp, port=0) as srv:
+                with urllib.request.urlopen(
+                        f"{srv.url}/query?family="
+                        "kfx_router_requests_total&fn=rate&since=120"
+                        "&labels=isvc%3Dtele", timeout=10) as r:
+                    res = json.load(r)
+                assert res["points"] and res["value"] is not None
+
+            # (3) the alert lifecycle, in order, as store events.
+            def alert_reasons():
+                return [e.reason for e in cp.store.events_for(
+                    "Alert", "replica-restart-rate")]
+
+            wait_for(lambda: "AlertFiring" in alert_reasons(), 30,
+                     "restart-rate alert firing")
+            assert cp.metrics.gauge("kfx_alerts_firing").value(
+                rule="replica-restart-rate") == 1
+            capsys.readouterr()
+            cli.alerts()
+            assert "firing" in capsys.readouterr().out
+            # The restart delta ages out of the 8s window -> resolved.
+            wait_for(lambda: "AlertResolved" in alert_reasons(), 40,
+                     "restart-rate alert resolution")
+            reasons = alert_reasons()
+            assert reasons.index("AlertPending") < \
+                reasons.index("AlertFiring") < \
+                reasons.index("AlertResolved")
+            assert cp.metrics.gauge("kfx_alerts_firing").value(
+                rule="replica-restart-rate") == 0
+            # Scrape health families live on the plane's /metrics.
+            sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+            import scrape_metrics
+
+            with ApiServer(cp, port=0) as srv:
+                assert scrape_metrics.main(
+                    [f"{srv.url}/metrics",
+                     "--require", "kfx_scrape_samples_total",
+                     "--require", "kfx_scrape_targets",
+                     "--require", "kfx_alerts_firing",
+                     "--require", "kfx_alert_transitions_total"]) == 0
