@@ -268,6 +268,51 @@ class InferenceService(Resource):
                         raise ValidationError(
                             f"spec.{rev}.speculative.enabled",
                             "must be a boolean")
+                ad = spec.get("adapters")
+                if ad is not None:
+                    if not isinstance(ad, dict):
+                        raise ValidationError(
+                            f"spec.{rev}.adapters",
+                            "must be an object {artifacts, default, "
+                            "slots, rank, fallback}")
+                    arts = ad.get("artifacts")
+                    if not isinstance(arts, dict) or not arts:
+                        raise ValidationError(
+                            f"spec.{rev}.adapters.artifacts",
+                            "must be a non-empty object "
+                            "{name: artifact URI}")
+                    for aname, uri in arts.items():
+                        if not str(aname) or not isinstance(uri, str) \
+                                or not uri:
+                            raise ValidationError(
+                                f"spec.{rev}.adapters."
+                                f"artifacts[{aname!r}]",
+                                "artifact URI must be a non-empty "
+                                "string")
+                    dflt = ad.get("default")
+                    if dflt is not None and (
+                            not isinstance(dflt, str)
+                            or (dflt and dflt not in arts)):
+                        raise ValidationError(
+                            f"spec.{rev}.adapters.default",
+                            "must name one of adapters.artifacts "
+                            "(or '' for the base model)")
+                    # bool subclasses int: `slots: true` must be a 400
+                    # at apply, not slot count 1 at revision startup.
+                    for field in ("slots", "rank"):
+                        v = ad.get(field)
+                        if v is not None and (isinstance(v, bool)
+                                              or not isinstance(v, int)
+                                              or v < 1):
+                            raise ValidationError(
+                                f"spec.{rev}.adapters.{field}",
+                                "must be an integer >= 1")
+                    fb = ad.get("fallback")
+                    if fb is not None and fb not in ("base", "error"):
+                        raise ValidationError(
+                            f"spec.{rev}.adapters.fallback",
+                            "'base' (degrade to base-only) or "
+                            "'error' (503 + Retry-After)")
                 q = spec.get("quantization")
                 if q is not None:
                     if not isinstance(q, dict):

@@ -96,7 +96,8 @@ KNOWN_POINTS = frozenset({
     "checkpoint.save", "checkpoint.restore",
     "serving.request", "serving.predict", "engine.admit",
     "engine.kv_alloc", "engine.spec_verify", "engine.kv_quant",
-    "engine.wedge", "replica.kill", "router.affinity",
+    "engine.adapter_load", "engine.wedge", "replica.kill",
+    "router.affinity",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
 })

@@ -520,7 +520,9 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
     fraction (SKIP% — the signal prefix-affinity routing moves),
     speculative-decode accept rate and quantization mode (Q column:
     "w8"/"kv8"/"w8+kv8"/"d8"/"f32"; paged LM revisions — "-" for
-    classifiers and engines with the signal absent), cumulative
+    classifiers and engines with the signal absent), the adapter-slot
+    pool as "pinned/total" (ADPT column — multi-tenant LoRA revisions
+    only), cumulative
     replica restarts (crashes + liveness wedge-kills, the
     kfx_replica_restarts_total number), window-rate TOK/S + RPS
     columns, plus the canary traffic split.
@@ -545,6 +547,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
             kv = a.get("kvUtil")
             acc = a.get("specAcceptRate")
             skip = a.get("prefillSkip")
+            adpt = a.get("adapters")  # "pinned/total" or absent
             tok_s = rps = None
             if rates_fn is not None:
                 tok_s, rps, window_skip = rates_fn(
@@ -560,6 +563,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
                 f"{skip * 100:.0f}%" if skip is not None else "-",
                 f"{acc * 100:.0f}%" if acc is not None else "-",
                 str(a.get("quant") or "-"),
+                str(adpt) if adpt else "-",
                 str(a["restarts"]) if a.get("restarts") is not None
                 else "-",
                 f"{tok_s:.1f}" if tok_s is not None else "-",
@@ -574,7 +578,8 @@ def _print_serving_top(rows: List[List[str]]) -> None:
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
                         "DESIRED", "TARGET", "KV%", "SKIP%", "ACC%",
-                        "Q", "RESTARTS", "TOK/S", "RPS", "CANARY%"])
+                        "Q", "ADPT", "RESTARTS", "TOK/S", "RPS",
+                        "CANARY%"])
 
 
 def _revision_window_rates(query, namespace: str, isvc: str,

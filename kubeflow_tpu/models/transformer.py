@@ -139,6 +139,19 @@ class TransformerConfig:
     # of ``quant``. Requires kv_page_size > 0 (the dense one-shot
     # oracle stays full-precision).
     kv_quant: str = ""
+    # LoRA fine-tuning (Hu et al., 2021): rank > 0 adds trainable
+    # low-rank ``<proj>_lora_a`` / ``<proj>_lora_b`` factor params on
+    # the attention q/k/v/out and dense-MLP wi/wo projections —
+    # ``y = base(x) + (x @ A) @ B * (alpha / rank)`` with B
+    # zero-initialised, so a fresh fine-tune starts byte-identical to
+    # the base model and only the factors need training (the base
+    # stays frozen; training/lora.py owns that loop). Train-time knob
+    # only: SERVING many adapters over one base goes through the
+    # batched-gather ``lora``/``adapter_ids`` call arguments below
+    # (serving/adapters.py stacks), never through these params.
+    # Dense FFN only (MoE experts are not LoRA targets).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "flash", "xla", "naive", "ring"):
@@ -172,6 +185,13 @@ class TransformerConfig:
             raise ValueError(
                 "kv_quant requires the paged cache (kv_page_size > 0): "
                 "the dense one-shot layout is the full-precision oracle")
+        if self.lora_rank < 0:
+            raise ValueError("lora_rank must be >= 0 (0 = no LoRA)")
+        if self.lora_rank > 0 and self.n_experts > 0:
+            raise ValueError(
+                "lora_rank targets the dense FFN (mlp.wi/wo); MoE "
+                "expert weights are not LoRA targets — fine-tune a "
+                "dense config or set lora_rank=0")
 
     @property
     def qkv_features(self) -> int:
@@ -396,6 +416,64 @@ def params_quantized(params) -> bool:
                for x in jax.tree_util.tree_leaves(params))
 
 
+def lora_gather_delta(x, entry, adapter_ids, dtype):
+    """Batched-gather LoRA (S-LoRA / Punica): one projection's
+    low-rank correction for a batch where EVERY ROW may wear a
+    different adapter. ``entry`` is the serving stack for this
+    projection — ``{"a": [n_adapter_slots, d_in, r],
+    "b": [n_adapter_slots, r, d_out]}`` (the per-adapter alpha/rank
+    scale is folded into ``b`` at pool load time, serving/adapters.py)
+    — and ``adapter_ids`` [B] selects each row's slot (-1 = base-only:
+    the row's delta is masked to exactly 0, so its output is the base
+    projection's bit pattern up to the identity ``y + 0``). x is the
+    projection INPUT [B, S, d_in]; returns the delta [B, S, d_out] in
+    the compute dtype. Two thin einsums, so the whole correction rides
+    the MXU inside the same fused decode dispatch as the base matmul —
+    no per-adapter dispatch, no weight swap."""
+    ids = jnp.maximum(adapter_ids, 0)
+    a = jnp.take(entry["a"], ids, axis=0).astype(dtype)  # [B, d_in, r]
+    b = jnp.take(entry["b"], ids, axis=0).astype(dtype)  # [B, r, d_out]
+    h = jnp.einsum("bsd,bdr->bsr", x.astype(dtype), a)
+    d = jnp.einsum("bsr,bro->bso", h, b)
+    return jnp.where((adapter_ids >= 0)[:, None, None], d,
+                     jnp.zeros_like(d))
+
+
+def _lora_apply(mdl, cfg, name, y, inp, lora, adapter_ids):
+    """Add every configured LoRA correction for projection ``name`` to
+    its base output ``y`` (any trailing feature shape): the TRAIN-time
+    per-module ``<name>_lora_a``/``<name>_lora_b`` params when
+    ``cfg.lora_rank > 0``, and the SERVING-time batched-gather stacks
+    when ``lora`` carries an entry for ``name``. ``inp`` is the
+    projection input (flattened to [B, S, d_in] here). With neither
+    configured this is an exact no-op — the traced graph is identical
+    to a pre-LoRA build."""
+    entry = (lora or {}).get(name)
+    if cfg.lora_rank <= 0 and entry is None:
+        return y
+    B, S = y.shape[0], y.shape[1]
+    flat_in = inp.reshape(B, S, -1)
+    d_out = 1
+    for n in y.shape[2:]:
+        d_out *= n
+    delta = None
+    if cfg.lora_rank > 0:
+        r = cfg.lora_rank
+        a = mdl.param(f"{name}_lora_a", nn.initializers.normal(0.02),
+                      (flat_in.shape[-1], r), jnp.float32)
+        # B starts at zero: step 0 of a fine-tune IS the base model.
+        b = mdl.param(f"{name}_lora_b", nn.initializers.zeros,
+                      (r, d_out), jnp.float32)
+        h = jnp.einsum("bsd,dr->bsr", flat_in.astype(cfg.dtype),
+                       a.astype(cfg.dtype))
+        delta = (jnp.einsum("bsr,ro->bso", h, b.astype(cfg.dtype))
+                 * (cfg.lora_alpha / r)).astype(cfg.dtype)
+    if entry is not None:
+        g = lora_gather_delta(flat_in, entry, adapter_ids, cfg.dtype)
+        delta = g if delta is None else delta + g
+    return y + delta.reshape(y.shape).astype(y.dtype)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -426,7 +504,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, block_tables=None,
-                 write_locations=None):
+                 write_locations=None, lora=None, adapter_ids=None):
         cfg = self.cfg
         B, S, _ = x.shape
         if cfg.quant == "int8":
@@ -465,12 +543,17 @@ class Attention(nn.Module):
             y = checkpoint_name(y.reshape(B_, S_, H_ * D_), name)
             return y.reshape(B_, S_, H_, D_)
 
-        q = tagged_heads("attn_q",
-                         proj("query", (cfg.n_heads, cfg.head_dim))(x))
-        k = tagged_heads("attn_k",
-                         proj("key", (cfg.n_heads, cfg.head_dim))(x))
-        v = tagged_heads("attn_v",
-                         proj("value", (cfg.n_heads, cfg.head_dim))(x))
+        # LoRA corrections land at the PROJECTION OUTPUT — before rope
+        # and the head scaling — exactly where a merged-weight kernel
+        # (W + scale·A·B) would put them, so the dense merged oracle
+        # and the batched-gather path compute the same function.
+        def hproj(name):
+            y = proj(name, (cfg.n_heads, cfg.head_dim))(x)
+            return _lora_apply(self, cfg, name, y, x, lora, adapter_ids)
+
+        q = tagged_heads("attn_q", hproj("query"))
+        k = tagged_heads("attn_k", hproj("key"))
+        v = tagged_heads("attn_v", hproj("value"))
         # RoPE with absolute positions (pads carry -1; their rows are
         # masked out of every decode-mode attention, so the garbage
         # rotation never contributes).
@@ -561,7 +644,9 @@ class Attention(nn.Module):
             mix = nn.DenseGeneral(x.shape[-1], axis=(-2, -1),
                                   use_bias=False, dtype=cfg.dtype,
                                   param_dtype=cfg.param_dtype, name="out")
-        return checkpoint_name(mix(out), "attn_out")
+        y = _lora_apply(self, cfg, "out", mix(out), out, lora,
+                        adapter_ids)
+        return checkpoint_name(y, "attn_out")
 
     def _decode_attend(self, q, k, v, positions, block_tables=None,
                        write_locations=None):
@@ -726,7 +811,7 @@ class DenseFFN(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lora=None, adapter_ids=None):
         cfg = self.cfg
         if cfg.quant == "int8":
             dense = lambda name, feats: QuantDenseGeneral(
@@ -735,10 +820,14 @@ class DenseFFN(nn.Module):
             dense = lambda name, feats: nn.Dense(
                 feats, use_bias=False, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype, name=name)
-        wi = checkpoint_name(dense("wi", 2 * cfg.d_ff)(x), "mlp_wi")
+        wi = _lora_apply(self, cfg, "wi", dense("wi", 2 * cfg.d_ff)(x),
+                         x, lora, adapter_ids)
+        wi = checkpoint_name(wi, "mlp_wi")
         gate, up = jnp.split(wi, 2, axis=-1)
         h = nn.silu(gate) * up  # SwiGLU
-        return checkpoint_name(dense("wo", x.shape[-1])(h), "mlp_wo")
+        wo = _lora_apply(self, cfg, "wo", dense("wo", x.shape[-1])(h),
+                         h, lora, adapter_ids)
+        return checkpoint_name(wo, "mlp_wo")
 
 
 class MoEFFN(nn.Module):
@@ -838,8 +927,9 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, block_tables=None,
-                 write_locations=None):
+                 write_locations=None, lora=None, adapter_ids=None):
         cfg = self.cfg
+        lora = lora or {}
 
         def sp_shard(y):
             """Sequence-dim activation sharding between matmul regions:
@@ -857,11 +947,14 @@ class Block(nn.Module):
         x = sp_shard(x)
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.dtype, name="ln1")(x), positions, block_tables,
-            write_locations)
+            write_locations, lora.get("attn"), adapter_ids)
         x = sp_shard(x)
-        ffn = MoEFFN(cfg, name="moe") if cfg.n_experts > 0 else \
-            DenseFFN(cfg, name="mlp")
-        x = x + ffn(RMSNorm(cfg.dtype, name="ln2")(x))
+        h = RMSNorm(cfg.dtype, name="ln2")(x)
+        if cfg.n_experts > 0:
+            x = x + MoEFFN(cfg, name="moe")(h)
+        else:
+            x = x + DenseFFN(cfg, name="mlp")(h, lora.get("mlp"),
+                                              adapter_ids)
         return x, None
 
 
@@ -873,8 +966,17 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False, positions=None,
                  return_hidden: bool = False, block_tables=None,
-                 write_locations=None):
+                 write_locations=None, lora=None, adapter_ids=None):
         cfg = self.cfg
+        # Multi-tenant LoRA serving args (serving/adapters.py): ``lora``
+        # is the per-projection adapter STACK pytree (leaves carry a
+        # leading layers axis the scan slices) and ``adapter_ids`` [B]
+        # selects each batch row's slot (-1 = base-only). Empty/None
+        # means no adapter machinery: the traced graph is byte-for-byte
+        # the pre-adapter program.
+        lora = lora or {}
+        if lora and adapter_ids is None:
+            adapter_ids = jnp.full((tokens.shape[0],), -1, jnp.int32)
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
         if cfg.cp > 1:
@@ -970,18 +1072,19 @@ class TransformerLM(nn.Module):
             block,
             variable_axes={"params": 0, "aux_loss": 0, "cache": 0},
             split_rngs={"params": True},
-            in_axes=nn.broadcast,  # positions broadcast to every layer
+            # positions/tables/ids broadcast to every layer; the lora
+            # stacks carry a leading layers axis the scan slices (each
+            # layer sees ITS adapters' factors — in_axes=0).
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, 0,
+                     nn.broadcast),
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        if cfg.kv_page_size > 0:
-            if write_locations is None:
-                write_locations = positions
-            x, _ = ScanBlock(cfg, name="layers")(x, positions,
-                                                 block_tables,
-                                                 write_locations)
-        else:
-            x, _ = ScanBlock(cfg, name="layers")(x, positions)
+        if cfg.kv_page_size > 0 and write_locations is None:
+            write_locations = positions
+        x, _ = ScanBlock(cfg, name="layers")(x, positions, block_tables,
+                                             write_locations, lora,
+                                             adapter_ids)
 
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
         if return_hidden:

@@ -90,7 +90,8 @@ class _Revision:
                  container: Optional[dict] = None,
                  speculative: Optional[dict] = None,
                  quantization: Optional[dict] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 adapters: Optional[dict] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -111,6 +112,11 @@ class _Revision:
         # spec.<rev>.prefillChunkTokens (api/serving.py) — exported as
         # KFX_LM_PREFILL_CHUNK; None leaves the predictor's default.
         self.prefill_chunk = prefill_chunk
+        # Multi-tenant LoRA adapters ({artifacts, default, slots, rank,
+        # fallback}, api/serving.py) — exported as the KFX_LM_ADAPTER*
+        # knobs the LMPredictor reads at load; classifier frameworks
+        # ignore them.
+        self.adapters = adapters
         # KFServing custom-predictor parity: a user-provided container
         # command serves the port instead of a framework server. The
         # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
@@ -136,6 +142,11 @@ class _Revision:
         self.engine_kv_free = 0.0
         self.engine_spec_rate: Optional[float] = None
         self.engine_quant: Optional[str] = None
+        # Adapter-slot pool (multi-tenant LoRA): total/free HBM slots
+        # summed across replicas — `kfx top`'s ADPT column; zero on
+        # classifier or base-only LM revisions.
+        self.engine_adapter_slots = 0.0
+        self.engine_adapter_free = 0.0
         # Prefix-reuse token totals summed across replicas — the
         # revision-level prefill-skipped fraction for `kfx top`'s
         # SKIP% column (the per-replica caches compose into a fleet
@@ -223,6 +234,7 @@ class _Revision:
         self._spec_env(env)
         self._quant_env(env)
         self._prefill_env(env)
+        self._adapter_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
@@ -253,6 +265,24 @@ class _Revision:
         if self.prefill_chunk is None or self.role != "predictor":
             return
         env["KFX_LM_PREFILL_CHUNK"] = str(int(self.prefill_chunk))
+
+    def _adapter_env(self, env: dict) -> None:
+        """spec.<rev>.adapters -> the LMPredictor's multi-tenant LoRA
+        knobs: the artifacts map rides as JSON (KFX_LM_ADAPTERS), the
+        optional default/slots/rank/fallback knobs export only when
+        explicit (the predictor owns the defaults)."""
+        ad = self.adapters
+        if ad is None or self.role != "predictor":
+            return
+        env["KFX_LM_ADAPTERS"] = json.dumps(ad.get("artifacts") or {})
+        if ad.get("default") is not None:
+            env["KFX_LM_ADAPTER_DEFAULT"] = str(ad["default"])
+        if ad.get("slots") is not None:
+            env["KFX_LM_ADAPTER_SLOTS"] = str(int(ad["slots"]))
+        if ad.get("rank") is not None:
+            env["KFX_LM_ADAPTER_RANK"] = str(int(ad["rank"]))
+        if ad.get("fallback") is not None:
+            env["KFX_LM_ADAPTER_FALLBACK"] = str(ad["fallback"])
 
     def _quant_env(self, env: dict) -> None:
         """spec.<rev>.quantization -> the LMPredictor's quantization
@@ -533,12 +563,14 @@ class InferenceServiceController(Controller):
             speculative = spec.get("speculative")
             quantization = spec.get("quantization")
             prefill_chunk = spec.get("prefillChunkTokens")
+            adapters = spec.get("adapters")
             if rev is None or rev.model_dir != model_dir \
                     or rev.device != device or rev.batcher != batcher \
                     or rev.container != container \
                     or rev.speculative != speculative \
                     or rev.quantization != quantization \
-                    or rev.prefill_chunk != prefill_chunk:
+                    or rev.prefill_chunk != prefill_chunk \
+                    or rev.adapters != adapters:
                 if rev is not None:
                     # Revision respawn (model/device/batcher/spec-env
                     # change): drop the doomed replicas from the router
@@ -561,6 +593,7 @@ class InferenceServiceController(Controller):
                     speculative=speculative,
                     quantization=quantization,
                     prefill_chunk=prefill_chunk,
+                    adapters=adapters,
                 )
                 # The restart tally is cumulative per revision NAME
                 # (matching kfx_replica_restarts_total's label): a
@@ -778,6 +811,10 @@ class InferenceServiceController(Controller):
         canary_rev = rt.revisions.get("canary")
         if default_rev is not None:
             rt.router.default.set_endpoints(default_rev.endpoints())
+            # Default-adapter traffic must derive the same affinity
+            # root the engine resolves (router._affinity_from_body).
+            rt.router.default_adapter = str(
+                (default_rev.adapters or {}).get("default") or "")
         if canary_rev is not None:
             rt.router.canary.set_endpoints(canary_rev.endpoints())
             rt.router.canary_percent = self._reconcile_rollout(isvc, rt, reg)
@@ -890,6 +927,13 @@ class InferenceServiceController(Controller):
             # Engine quantization mode ("w8", "kv8", "w8+kv8", "d8",
             # "f32") — `kfx top`'s Q column.
             status["quant"] = rev.engine_quant
+        if rev.engine_adapter_slots > 0:
+            # Adapter-slot pool "pinned/total" (multi-tenant LoRA) —
+            # `kfx top`'s ADPT column; absent on base-only revisions.
+            used = max(0, int(rev.engine_adapter_slots
+                              - rev.engine_adapter_free))
+            status["adapters"] = \
+                f"{used}/{int(rev.engine_adapter_slots)}"
         rt.autoscaling_status[rev_name] = status
         return decision.desired
 
@@ -1079,6 +1123,8 @@ class InferenceServiceController(Controller):
         rev.engine_kv_free = total("kfx_lm_kv_pages_free")
         rev.engine_prefix_reused = total("kfx_lm_prefix_tokens_reused")
         rev.engine_prompt_tokens = total("kfx_lm_prompt_tokens_admitted")
+        rev.engine_adapter_slots = total("kfx_lm_adapter_slots")
+        rev.engine_adapter_free = total("kfx_lm_adapter_slots_free")
         rates = [v for _, v in
                  t.latest_samples("kfx_lm_spec_accept_rate", sel,
                                   max_age_s=fresh_s)]

@@ -144,14 +144,32 @@ in-flight slots finish — the operator drains before every deliberate
 kill (scale-in, revision respawn) so planned churn never loses a
 request.
 
+Multi-tenant LoRA adapters (serving/adapters.py, S-LoRA/Punica): an
+HBM-resident ``[n_layers, adapter_slots, ...]`` A/B stack pool with a
+BlockManager-style allocator (refcounts + LRU paging from the artifact
+store), per-request adapter ids gathered into the SAME fused
+prefill/decode/verify dispatches (batched-gather LoRA — one compiled
+function serves a batch where every slot wears a different adapter;
+id -1 = base-only, bit-identical to an adapterless engine), the prefix
+cache chain-rooted at the adapter name (cached pages hold ADAPTER KV —
+same tokens under different adapters never share a page), and
+per-tenant weighted-round-robin admission (FairQueue) so one adapter's
+burst queues behind itself. Greedy output with a single adapter is
+byte-identical to the dense merged-weights (W + alpha/rank·A·B) oracle
+— the one compiled engine IS N merged deployments, at base + stacks
+HBM instead of N bases.
+
 Chaos points ``engine.admit``, ``engine.kv_alloc``,
 ``engine.spec_verify`` (a full-rejection wave: every proposal treated
 as rejected for that iteration — throughput falls to the
 non-speculative floor, correctness untouched), ``engine.kv_quant``
 (int8 KV only: crushes the cached scale planes to the worst case —
-quality/accept-rate degrade observably, never a crash or page leak)
-and ``engine.wedge`` (stalls the decode loop with slots active — the
-deterministic liveness-failure probe; docs/chaos.md).
+quality/accept-rate degrade observably, never a crash or page leak),
+``engine.adapter_load`` (forces adapter paging failure — the request
+degrades to base-only or sheds 503 + Retry-After per the
+``adapters.fallback`` spec knob) and ``engine.wedge`` (stalls the
+decode loop with slots active — the deterministic liveness-failure
+probe; docs/chaos.md).
 
 jax is imported lazily (inside methods): server.py imports this module
 for ``EngineOverloaded`` on its own import path.
@@ -220,6 +238,24 @@ class PageAllocError(EngineOverloaded):
     shed-load contract (503 + Retry-After) covers it."""
 
 
+class AdapterSlotError(PageAllocError):
+    """Every HBM adapter slot is pinned by an in-flight request —
+    pool pressure exactly like KV-page exhaustion (the admission path
+    requeues behind in-flight work, and a lone unplaceable request
+    fails with the 503 + Retry-After shed contract). Subclassing
+    PageAllocError keeps the engine's requeue/preempt handling ONE
+    code path for both pools."""
+
+
+class AdapterLoadError(EngineOverloaded):
+    """An adapter artifact failed to page in (unknown name, unreadable
+    or mismatched artifact, or the ``engine.adapter_load`` chaos
+    point). Per the spec's ``adapters.fallback`` knob the engine
+    either degrades the request to base-only (-1) or fails it with
+    this error — an EngineOverloaded, so the server answers
+    503 + Retry-After and the router re-dispatches."""
+
+
 class Request:
     """One in-flight generation: token budget, sampling knobs, and a
     completion event the submitting thread waits on. ``tokens`` doubles
@@ -228,17 +264,19 @@ class Request:
     prompt+generated on re-admission."""
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
-                 "stop", "tokens", "rng", "error", "t_enqueue",
-                 "t_done", "counted", "trace_id", "span_id", "_event")
+                 "stop", "adapter", "tokens", "rng", "error",
+                 "t_enqueue", "t_admitted", "t_done", "counted",
+                 "trace_id", "span_id", "_event")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
-                 top_k: int, seed: int, stop: int):
+                 top_k: int, seed: int, stop: int, adapter: str = ""):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.top_k = top_k
         self.seed = seed
         self.stop = stop              # -1 = no stop token
+        self.adapter = adapter        # "" = base model (tenant key)
         self.tokens: List[int] = []   # generated ids, filled by the loop
         # RNG stream stashed at preemption ([2] uint32); None until
         # then — a fresh admission derives the stream from ``seed``.
@@ -251,6 +289,10 @@ class Request:
         self.counted = False
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.monotonic()
+        # First-admission stamp (queue-wait = t_admitted - t_enqueue;
+        # 0.0 until admitted) — what the fairness tests read per
+        # TENANT, where the aggregate histogram can't discriminate.
+        self.t_admitted = 0.0
         self.t_done = 0.0
         # Captured on the submitting thread so the engine thread's
         # admit/chunk spans join the request's trace tree (the same
@@ -369,17 +411,22 @@ class PrefixCache:
     def _touch(self, e: _PrefixEntry) -> None:
         self._lru.move_to_end((e.partial, e.key))
 
-    def match(self, tokens: Sequence[int], max_reuse: int
+    def match(self, tokens: Sequence[int], max_reuse: int,
+              root: bytes = b""
               ) -> Tuple[List[int], Optional[Tuple[int, int]], int, bytes]:
         """Longest cached prefix of ``tokens`` reusable within
         ``max_reuse`` (the caller caps at len-1: the last prompt token
         must run through the model for its logits). Returns
         (full_pages, cow, matched_tokens, chain_key) where ``cow`` is
         (source_page, n_tokens) when a partial boundary page extends
-        the match via copy-on-write."""
+        the match via copy-on-write. ``root`` seeds the chain: the
+        engine passes the request's ADAPTER name, because cached pages
+        hold adapter-specific KV (the k/v projections wear the
+        adapter) — identical tokens under different adapters must
+        never share a page."""
         ps = self.mgr.page_size
         pages: List[int] = []
-        key, matched = b"", 0
+        key, matched = root, 0
         while matched + ps <= max_reuse:
             nxt = _chain_hash(key, tokens[matched:matched + ps])
             e = self.full.get(nxt)
@@ -489,7 +536,13 @@ class DecodeEngine:
                  kv_quant: str = "",
                  draft_quant: str = "",
                  stall_threshold_s: float = 10.0,
-                 prefill_chunk_tokens: int = 0):
+                 prefill_chunk_tokens: int = 0,
+                 adapters: Optional[Dict[str, str]] = None,
+                 adapter_slots: int = 8,
+                 adapter_rank: int = 0,
+                 adapter_default: str = "",
+                 adapter_fallback: str = "base",
+                 tenant_weights: Optional[Dict[str, int]] = None):
         import jax
 
         from ..models.generate import decode_config
@@ -632,6 +685,34 @@ class DecodeEngine:
         self._spec_lock = threading.Lock()
         self._spec_window: "deque[Tuple[float, int, int]]" = deque()
 
+        # -- multi-tenant LoRA adapters (serving/adapters.py): an
+        # HBM-resident [n_layers, adapter_slots, ...] A/B stack pool
+        # with LRU paging from the artifact store; per-request adapter
+        # ids gather into the SAME fused dispatches (batched-gather
+        # LoRA), id -1 = base-only. Enabled iff ``adapters`` (name ->
+        # artifact URI) is non-empty.
+        if adapter_fallback not in ("base", "error"):
+            raise ValueError(
+                f"unknown adapter_fallback {adapter_fallback!r} "
+                "(expected 'base' or 'error')")
+        self.adapter_fallback = adapter_fallback
+        self.adapter_default = adapter_default or ""
+        if adapters:
+            from .adapters import AdapterPool
+
+            self._apool: Optional["AdapterPool"] = AdapterPool(
+                self.cfg, n_slots=adapter_slots, sources=adapters,
+                rank=adapter_rank, draft_layers=draft_layers,
+                name=name, registry=self._reg)
+        else:
+            self._apool = None
+        if self.adapter_default and (
+                self._apool is None
+                or not self._apool.known(self.adapter_default)):
+            raise ValueError(
+                f"adapter_default {self.adapter_default!r} is not a "
+                "configured adapter")
+
         # -- device state (touched only by the loop thread after start)
         self._cache = self._init_cache()
         self._logbuf = self._init_logbuf()
@@ -663,6 +744,10 @@ class DecodeEngine:
         self._draft_slot_pages: List[List[int]] = [[] for _ in range(B)]
         self._spec_ok = np.zeros((B,), np.bool_)
         self._pending = np.full((B,), -1, np.int32)
+        # Per-slot adapter ids ([B] int32, -1 = base) — gathered into
+        # every hot dispatch; the slot holds one AdapterPool reference
+        # per id >= 0 for its lifetime.
+        self._aids = np.full((B,), -1, np.int32)
         # Chunked-prefill cursors: slot -> {"req", "full", "n",
         # "next" (absolute index of the next chunk's first token),
         # "key"/"reg_block" (incremental prefix-cache registration
@@ -709,7 +794,14 @@ class DecodeEngine:
         self._building = 0
 
         self._cond = threading.Condition()
-        self._queue: "deque[Request]" = deque()
+        # Per-tenant fair admission (serving/adapters.py FairQueue):
+        # requests queue under their adapter name and pop weighted
+        # round-robin, so one adapter's burst queues behind itself —
+        # the bounded queue, drain and overflow contracts are
+        # unchanged (len() is the global depth).
+        from .adapters import FairQueue
+
+        self._queue = FairQueue(tenant_weights)
         # The request currently inside _admit (popped from the queue,
         # not yet in a slot): without tracking it, drain()/heartbeat()
         # would read an admitting engine as empty and the operator
@@ -784,6 +876,45 @@ class DecodeEngine:
         return {"proposed": self._spec_proposed,
                 "accepted": self._spec_accepted,
                 "degraded": self._spec_degraded}
+
+    def adapter_stats(self) -> Dict[str, int]:
+        """Cumulative adapter-pool counters (zeros without a pool):
+        artifact loads, LRU evictions, slot capacity and free slots.
+        Public surface for bench/test deltas."""
+        if self._apool is None:
+            return {"loads": 0, "evictions": 0, "slots": 0, "free": 0}
+        return {"loads": self._apool.loads,
+                "evictions": self._apool.evictions,
+                "slots": self._apool.n_slots,
+                "free": self._apool.n_free}
+
+    def hbm_bytes(self) -> Dict[str, int]:
+        """Measured device-buffer accounting — actual array bytes, not
+        estimates, valid on any backend: base weights, target/draft KV
+        pools (entries + scale planes + position ids), the draft's
+        truncated weights, the adapter stacks and the logits buffer.
+        The multi-tenant headline divides ``total`` by a base-only
+        engine's: N adapters over ONE base costs base + stacks, vs ~N
+        bases for N merged deployments (docs/serving.md, BENCH
+        ``lm_adapters_hbm_ratio``)."""
+        import jax
+
+        def nbytes(tree) -> int:
+            return int(sum(
+                int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+                for x in jax.tree_util.tree_leaves(tree)))
+
+        out = {
+            "params": nbytes(self.params),
+            "kv_pool": nbytes(self._cache),
+            "logits": nbytes(self._logbuf),
+            "draft": (nbytes(self.draft_params)
+                      + nbytes(self._draft_cache)) if self.spec else 0,
+            "adapters": self._apool.nbytes()
+            if self._apool is not None else 0,
+        }
+        out["total"] = sum(out.values())
+        return out
 
     def _spec_accept_rate(self, window_s: float = 30.0) -> float:
         """Accepted/proposed over the trailing window (0 when idle or
@@ -873,6 +1004,33 @@ class DecodeEngine:
                       "dispatch, per engine iteration.",
                       buckets=QUEUE_WAIT_BUCKETS).observe(
                           0.0, n=0, model=self.name)
+        # Adapter families, seeded iff the engine HAS an adapter pool
+        # (their absence marks a base-only engine, the same contract
+        # as the speculative families below): slot gauges for `kfx
+        # top`'s ADPT column and capacity planning, load/eviction
+        # counters for paging churn, the fallback counter for the
+        # chaos degrade path, and the per-tenant request counter.
+        if self._apool is not None:
+            reg.gauge("kfx_lm_adapter_slots",
+                      "HBM adapter slots (stacked LoRA A/B capacity)."
+                      ).set(self._apool.n_slots, model=self.name)
+            reg.gauge("kfx_lm_adapter_slots_free",
+                      "Adapter slots not pinned by in-flight requests "
+                      "(free + loaded-but-idle LRU candidates).").set(
+                          self._apool.n_free, model=self.name)
+            reg.counter("kfx_lm_adapter_loads_total",
+                        "Adapters paged into HBM slots from the "
+                        "artifact store.").inc(0, model=self.name)
+            reg.counter("kfx_lm_adapter_evictions_total",
+                        "Adapters evicted from HBM slots (LRU paging)."
+                        ).inc(0, model=self.name)
+            reg.counter("kfx_lm_adapter_fallbacks_total",
+                        "Requests degraded to base-only after an "
+                        "adapter load failure (adapters.fallback="
+                        "base).").inc(0, model=self.name)
+            reg.counter("kfx_lm_adapter_requests_total",
+                        "Admitted client requests by adapter tenant."
+                        ).inc(0, model=self.name, adapter="base")
         # Speculative families are seeded iff the engine HAS a draft —
         # their absence is the signal (the server's JSON engine block
         # omits spec_accept_rate and `kfx top` renders "-", never a
@@ -934,8 +1092,7 @@ class DecodeEngine:
         operator calls this right before killing the replica."""
         with self._cond:
             self._draining = True
-            queued = list(self._queue)
-            self._queue.clear()
+            queued = self._queue.drain_all()
             self._cond.notify_all()
         err = EngineDraining(
             f"engine {self.name} is draining; retry another replica")
@@ -1019,6 +1176,23 @@ class DecodeEngine:
         return jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), cache)
 
+    def _lora_tree(self, draft: bool = False):
+        """The adapter A/B stack pytree every hot dispatch takes as an
+        ARGUMENT (the pool mutates it when paging adapters, so it can
+        never be a compile-time constant). Empty dict without a pool —
+        a zero-leaf jit arg, so adapterless engines trace the exact
+        pre-adapter program."""
+        if self._apool is None:
+            return {}
+        return self._apool.draft_tree if draft else self._apool.tree
+
+    def _lora_specs(self, draft: bool = False):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self._lora_tree(draft))
+
     def _build(self, build_fn, *args):
         """Run one AOT build under the ``_building`` marker so the
         liveness heartbeat can tell "slow: compiling" from "stuck".
@@ -1053,7 +1227,7 @@ class DecodeEngine:
         model = self.model
 
         def run(params, cache, logbuf, tokens, table, slot, true_len,
-                start):
+                start, lora, aid):
             """tokens [1, P] right-padded prompt TAIL starting at
             absolute position ``start`` (0 for a cache miss; the
             matched prefix length on a hit — earlier positions are
@@ -1062,12 +1236,16 @@ class DecodeEngine:
             last real token's logits at ``logbuf[slot]``. Pads carry
             position -1: their writes are dropped and they are masked
             out of every attention, so padding never changes the
-            numbers (the LMGenerator contract, unchanged)."""
+            numbers (the LMGenerator contract, unchanged). ``aid``
+            [1] is the slot's adapter id: prompt KV is ADAPTER KV —
+            the k/v projections wear the adapter, which is why the
+            prefix cache chains per adapter."""
             pos = jnp.arange(P, dtype=jnp.int32)[None, :]
             pos = jnp.where(pos < true_len, start + pos, -1)
             logits, vars_ = model.apply(
                 {"params": params, "cache": cache}, tokens,
-                positions=pos, block_tables=table, mutable=["cache"])
+                positions=pos, block_tables=table, lora=lora,
+                adapter_ids=aid, mutable=["cache"])
             last = jax.lax.dynamic_slice_in_dim(
                 logits, true_len - 1, 1, axis=1)[0, 0]  # [V]
             logbuf = jax.lax.dynamic_update_slice_in_dim(
@@ -1087,6 +1265,8 @@ class DecodeEngine:
             jax.ShapeDtypeStruct((), np.int32),
             jax.ShapeDtypeStruct((), np.int32),
             jax.ShapeDtypeStruct((), np.int32),
+            self._lora_specs(),
+            jax.ShapeDtypeStruct((1,), np.int32),
         )
         return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
 
@@ -1118,7 +1298,7 @@ class DecodeEngine:
             )(logits, keys, temp, topk)
 
         def run(params, cache, logbuf, tables, pos, loc, active,
-                produced, rngs, temp, topk, stop, max_new):
+                produced, rngs, temp, topk, stop, max_new, lora, aids):
             def step(carry, _):
                 cache, logits, pos, loc, active, produced, rngs = carry
                 split = jax.vmap(jax.random.split)(rngs)  # [B, 2, 2]
@@ -1143,7 +1323,8 @@ class DecodeEngine:
                 logits2, vars_ = model.apply(
                     {"params": params, "cache": cache}, feed[:, None],
                     positions=eff_pos[:, None], block_tables=tables,
-                    write_locations=eff_loc[:, None], mutable=["cache"])
+                    write_locations=eff_loc[:, None], lora=lora,
+                    adapter_ids=aids, mutable=["cache"])
                 pos2 = jnp.where(active, pos + 1, pos)
                 loc2 = jnp.where(active, loc + 1, loc)
                 return ((vars_["cache"], logits2[:, 0], pos2, loc2,
@@ -1174,6 +1355,8 @@ class DecodeEngine:
             sds((B,), np.int32),      # topk
             sds((B,), np.int32),      # stop
             sds((B,), np.int32),      # max_new
+            self._lora_specs(),
+            sds((B,), np.int32),      # adapter ids
         )
         return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
 
@@ -1335,17 +1518,21 @@ class DecodeEngine:
 
         model = self.draft_model
 
-        def run(dparams, dcache, tokens, table, true_len):
+        def run(dparams, dcache, tokens, table, true_len, dlora, aid):
             """tokens [1, P] right-padded FULL prompt. Writes the
             prompt's draft KV through the slot's draft block table; no
             logits are kept — the propose scan always starts by
             feeding the pending token, so the draft never samples from
-            its prefill logits."""
+            its prefill logits. The draft wears the SAME adapter as
+            the target (truncated stacks) so draft KV and proposals
+            stay in-distribution — a wrong draft costs only accept
+            rate, but a free one is free."""
             pos = jnp.arange(P, dtype=jnp.int32)[None, :]
             pos = jnp.where(pos < true_len, pos, -1)
             _, vars_ = model.apply(
                 {"params": dparams, "cache": dcache}, tokens,
-                positions=pos, block_tables=table, mutable=["cache"])
+                positions=pos, block_tables=table, lora=dlora,
+                adapter_ids=aid, mutable=["cache"])
             return vars_["cache"]
 
         donate = (1,) if self._donate else ()
@@ -1357,6 +1544,8 @@ class DecodeEngine:
             jax.ShapeDtypeStruct((1, P), np.int32),
             jax.ShapeDtypeStruct((1, self.n_blocks), np.int32),
             jax.ShapeDtypeStruct((), np.int32),
+            self._lora_specs(draft=True),
+            jax.ShapeDtypeStruct((1,), np.int32),
         )
         return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
 
@@ -1461,7 +1650,7 @@ class DecodeEngine:
 
         def run(params, dparams, cache, dcache, tables, dtables,
                 pending, pos, loc, max_loc, spec_on, draft_live,
-                active, rngs, temp, topk):
+                active, rngs, temp, topk, lora, dlora, aids):
             # spec_on: this iteration proposes/accepts for the slot;
             # draft_live: the slot HOLDS draft pages (spec_on implies
             # draft_live; a chaos full-rejection wave clears spec_on
@@ -1490,7 +1679,8 @@ class DecodeEngine:
                 logits, vars_ = draft_model.apply(
                     {"params": dparams, "cache": dcache}, feed[:, None],
                     positions=eff_pos[:, None], block_tables=dtables,
-                    write_locations=eff_loc[:, None], mutable=["cache"])
+                    write_locations=eff_loc[:, None], lora=dlora,
+                    adapter_ids=aids, mutable=["cache"])
                 lg = logits[:, 0]
                 nxt = sample_slots(lg, sub, temp, topk)
                 return ((vars_["cache"], nxt, dpos + 1, dloc + 1,
@@ -1518,7 +1708,8 @@ class DecodeEngine:
             logits, vars_ = model.apply(
                 {"params": params, "cache": cache}, feed,
                 positions=eff_pos, block_tables=tables,
-                write_locations=eff_loc, mutable=["cache"])
+                write_locations=eff_loc, lora=lora,
+                adapter_ids=aids, mutable=["cache"])
             cache = vars_["cache"]
 
             # -- 3. accept (rngs: one split for uniforms, one for the
@@ -1591,7 +1782,8 @@ class DecodeEngine:
                 {"params": dparams, "cache": dcache},
                 jnp.where(active, last, 0)[:, None],
                 positions=eff_pos[:, None], block_tables=dtables,
-                write_locations=eff_loc[:, None], mutable=["cache"])
+                write_locations=eff_loc[:, None], lora=dlora,
+                adapter_ids=aids, mutable=["cache"])
             dcache = vars_["cache"]
             return cache, dcache, rngs, D, a, bonus
 
@@ -1616,6 +1808,9 @@ class DecodeEngine:
             sds((B, 2), np.uint32),   # rngs
             sds((B,), np.float32),    # temp
             sds((B,), np.int32),      # topk
+            self._lora_specs(),
+            self._lora_specs(draft=True),
+            sds((B,), np.int32),      # adapter ids
         )
         return jax.jit(run, donate_argnums=donate).lower(*specs).compile()
 
@@ -1661,7 +1856,8 @@ class DecodeEngine:
     # -- submission ----------------------------------------------------------
     def _make_request(self, prompt: Sequence[int], max_new_tokens: int,
                       temperature: float, top_k: int, seed: int,
-                      stop_token: Optional[int]) -> Request:
+                      stop_token: Optional[int],
+                      adapter: Optional[str] = None) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -1672,9 +1868,20 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the cache capacity {L}")
+        # Adapter selection: explicit name, else the engine default;
+        # "" always means base. Unknown names are a client mistake
+        # (ValueError -> 400 at the server), never a 503.
+        name = adapter if adapter is not None else self.adapter_default
+        name = str(name or "")
+        if name and (self._apool is None
+                     or not self._apool.known(name)):
+            raise ValueError(
+                f"unknown adapter {name!r} (configured: "
+                f"{sorted(self._apool.sources) if self._apool else []})")
         return Request(prompt, int(max_new_tokens), float(temperature),
                        int(top_k), int(seed),
-                       -1 if stop_token is None else int(stop_token))
+                       -1 if stop_token is None else int(stop_token),
+                       adapter=name)
 
     def _enqueue(self, reqs: List[Request]) -> None:
         """All-or-nothing enqueue: a batch that does not fit the
@@ -1701,7 +1908,8 @@ class DecodeEngine:
                 # is stuck mid-admission must not reset the stall
                 # clock of a genuinely wedged loop.)
                 self._last_progress = time.monotonic()
-            self._queue.extend(reqs)
+            for r in reqs:
+                self._queue.push(r)
             depth = len(self._queue)
             self._cond.notify()
         self._reg().gauge("kfx_lm_queue_depth",
@@ -1710,19 +1918,22 @@ class DecodeEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-               stop_token: Optional[int] = None) -> Request:
+               stop_token: Optional[int] = None,
+               adapter: Optional[str] = None) -> Request:
         """Enqueue one prompt; returns the request handle (wait with
-        ``.result(timeout)``). Raises EngineOverloaded when the bounded
-        admission queue is full."""
+        ``.result(timeout)``). ``adapter`` selects a configured LoRA
+        adapter by name (None = engine default, "" = base). Raises
+        EngineOverloaded when the bounded admission queue is full."""
         req = self._make_request(prompt, max_new_tokens, temperature,
-                                 top_k, seed, stop_token)
+                                 top_k, seed, stop_token, adapter)
         self._enqueue([req])
         return req
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0,
-                 stop_token: Optional[int] = None) -> List[List[int]]:
+                 stop_token: Optional[int] = None,
+                 adapter: Optional[str] = None) -> List[List[int]]:
         """Blocking convenience mirroring LMGenerator.generate: one
         request per prompt (seeded seed+i), results in prompt order.
         The batch enqueues atomically, and one deadline covers the
@@ -1730,7 +1941,7 @@ class DecodeEngine:
         backend timeout — per-request fresh clocks could stack past
         it)."""
         reqs = [self._make_request(p, max_new_tokens, temperature,
-                                   top_k, seed + i, stop_token)
+                                   top_k, seed + i, stop_token, adapter)
                 for i, p in enumerate(prompts)]
         self._enqueue(reqs)
         deadline = time.monotonic() + self.request_timeout_s
@@ -1787,6 +1998,13 @@ class DecodeEngine:
         self._active[slot] = False
         self._release_draft(slot)
         self._pending[slot] = -1
+        aid = int(self._aids[slot])
+        if aid >= 0 and self._apool is not None:
+            # Unpin the slot's adapter; the FACTORS stay resident (LRU
+            # keeps hot adapters in HBM across requests — paging out
+            # happens only under slot pressure).
+            self._apool.release(aid)
+        self._aids[slot] = -1
 
     def _release_draft(self, slot: int) -> None:
         if self._draft_mgr is not None and self._draft_slot_pages[slot]:
@@ -1851,7 +2069,7 @@ class DecodeEngine:
                 free = [i for i, r in enumerate(self._slots) if r is None]
                 if not free or not self._queue:
                     break
-                req = self._queue.popleft()
+                req = self._queue.pop()
                 # Same locked step as the pop: drain()/heartbeat()
                 # must never observe the gap where the request has
                 # left the queue but is not yet tracked as admitting.
@@ -1864,7 +2082,7 @@ class DecodeEngine:
                     req._finish(e)
                 else:
                     with self._cond:
-                        self._queue.appendleft(req)
+                        self._queue.push_front(req)
                     requeued = True
             except Exception as e:
                 # A failed prefill (compile/OOM) fails THIS request —
@@ -1880,11 +2098,33 @@ class DecodeEngine:
                 break
         self._touch_gauges()
 
+    def _resolve_adapter(self, req: Request) -> int:
+        """The request's adapter id for this admission: acquire (and
+        page in, if needed) its named adapter, pinning the slot for
+        the request's residency. A LOAD failure — bad artifact or the
+        ``engine.adapter_load`` chaos point — honors the
+        ``adapter_fallback`` knob: "base" degrades the request to the
+        base model (-1, counted kfx_lm_adapter_fallbacks_total);
+        "error" re-raises AdapterLoadError (-> 503 + Retry-After).
+        AdapterSlotError (every slot pinned) always propagates — it is
+        pool pressure, handled exactly like KV-page exhaustion."""
+        if self._apool is None or not req.adapter:
+            return -1
+        try:
+            return self._apool.acquire(req.adapter)
+        except AdapterSlotError:
+            raise
+        except AdapterLoadError:
+            if self.adapter_fallback == "error":
+                raise
+            self._reg().counter(
+                "kfx_lm_adapter_fallbacks_total",
+                "Requests degraded to base-only after an adapter "
+                "load failure (adapters.fallback=base).").inc(
+                    1, model=self.name)
+            return -1
+
     def _admit(self, req: Request, slot: int) -> None:
-        import jax
-
-        from ..models.generate import pow2_bucket
-
         # Fault point: admission failure/latency — the engine-era
         # analogue of serving.predict (docs/chaos.md).
         inj = chaos.draw("engine.admit", target=self.name)
@@ -1895,6 +2135,29 @@ class DecodeEngine:
                 req._finish(RuntimeError(
                     f"chaos[engine.admit]: {self.name}"))
                 return
+        # Adapter resolution BEFORE any page work: prompt KV is
+        # adapter KV, so the id must be live for the prefill dispatch.
+        # AdapterLoadError in fallback="error" mode fails this request
+        # via _admit_ready's net; AdapterSlotError requeues like page
+        # pressure. Any later failure that does not install the
+        # request in the slot releases the pin (the finally below).
+        aid = self._resolve_adapter(req)
+        try:
+            self._admit_resolved(req, slot, aid)
+        finally:
+            # _fail_inflight (donated-dispatch death) may already have
+            # dropped every pin via release_all(); ref 0 means this
+            # pin is gone — releasing again would corrupt the count.
+            if aid >= 0 and self._slots[slot] is not req \
+                    and self._apool.ref[aid] > 0:
+                self._apool.release(aid)
+
+    def _admit_resolved(self, req: Request, slot: int,
+                        aid: int) -> None:
+        import jax
+
+        from ..models.generate import pow2_bucket
+
         L, ps = self.cfg.max_seq_len, self.page_size
         # Recompute continuation: a preempted request re-prefills
         # prompt + already-generated (teacher forcing — same values
@@ -1906,12 +2169,21 @@ class DecodeEngine:
         bucket = pow2_bucket(n, L - remaining)
         # Shared-prefix reuse, capped at n-1: the last prompt token
         # must run through the model to produce the next-token logits.
+        # The chain roots at the ADAPTER name: cached pages hold
+        # adapter-specific KV, so identical tokens under different
+        # adapters never collide. The root follows the RESOLVED id,
+        # not the requested name — a request degraded to base-only
+        # (adapters.fallback=base) writes BASE KV and must chain with
+        # base traffic, never poison the adapter's chain.
+        root = req.adapter.encode() if (req.adapter and aid >= 0) \
+            else b""
         shared: List[int] = []
         cow = None
         matched = 0
-        key = b""
+        key = root
         if self._prefix is not None:
-            shared, cow, matched, key = self._prefix.match(full, n - 1)
+            shared, cow, matched, key = self._prefix.match(
+                full, n - 1, root=root)
         tail = full[matched:]
         if self.prefill_chunk_tokens and \
                 len(tail) > self.prefill_chunk_tokens:
@@ -1921,7 +2193,7 @@ class DecodeEngine:
             # cursor; the loop advances it one chunk per iteration.
             return self._admit_chunked(req, slot, full, n, remaining,
                                        bucket, shared, cow, matched,
-                                       key)
+                                       key, aid)
         P = pow2_bucket(len(tail), L)
         fn = self._prefill_for(P)       # compile OUTSIDE the mutation
         cfn = self._copy_fn() if cow else None  # window: failing here
@@ -1972,7 +2244,8 @@ class DecodeEngine:
                 self._cache, self._logbuf = fn(
                     self.params, self._cache, self._logbuf, tokens,
                     row[None, :], np.int32(slot), np.int32(len(tail)),
-                    np.int32(matched))
+                    np.int32(matched), self._lora_tree(),
+                    np.full((1,), aid, np.int32))
             except Exception as e:
                 if self._donate:
                     # A failed DISPATCH may have died after the
@@ -2027,6 +2300,7 @@ class DecodeEngine:
         self._stop[slot] = req.stop
         self._max_new[slot] = req.max_new
         self._pending[slot] = -1  # next iteration samples from logbuf
+        self._aids[slot] = aid
         self._slots[slot] = req
         if self.spec:
             self._admit_draft(req, slot, full, n)
@@ -2055,8 +2329,11 @@ class DecodeEngine:
         tokens = np.zeros((1, Pf), np.int32)
         tokens[0, :n] = full
         try:
-            self._draft_cache = fn(self.draft_params, self._draft_cache,
-                                   tokens, row[None, :], np.int32(n))
+            self._draft_cache = fn(
+                self.draft_params, self._draft_cache, tokens,
+                row[None, :], np.int32(n),
+                self._lora_tree(draft=True),
+                np.full((1,), int(self._aids[slot]), np.int32))
         except Exception:
             if self._donate:
                 # The donated draft cache may be dead — every slot's
@@ -2090,11 +2367,20 @@ class DecodeEngine:
         if req.counted:
             return False
         req.counted = True
-        wait = time.monotonic() - req.t_enqueue
+        req.t_admitted = time.monotonic()
+        wait = req.t_admitted - req.t_enqueue
         self._reg().histogram(
             "kfx_lm_queue_wait_seconds",
             "Decode-engine admission wait (enqueue to slot prefill).",
             buckets=QUEUE_WAIT_BUCKETS).observe(wait, model=self.name)
+        if self._apool is not None:
+            # Per-tenant traffic accounting — the fairness story's
+            # observable ("" requests count as the base tenant).
+            self._reg().counter(
+                "kfx_lm_adapter_requests_total",
+                "Admitted client requests by adapter tenant.").inc(
+                    1, model=self.name,
+                    adapter=req.adapter or "base")
         if self._prefix is not None:
             if matched:
                 self._count_prefix_hit(matched)
@@ -2142,7 +2428,7 @@ class DecodeEngine:
     def _admit_chunked(self, req: Request, slot: int, full: List[int],
                        n: int, remaining: int, bucket: int,
                        shared: List[int], cow, matched: int,
-                       key: bytes) -> None:
+                       key: bytes, aid: int = -1) -> None:
         """Chunked admission: place the request in the slot WITHOUT a
         prompt prefill dispatch — pin the matched prefix pages (and
         clone the COW boundary page, a one-page compiled copy), record
@@ -2181,6 +2467,7 @@ class DecodeEngine:
         self._slot_pages[slot] = shared + own
         self._active[slot] = False
         self._pending[slot] = -1
+        self._aids[slot] = aid
         self._slots[slot] = req
         self._prefilling[slot] = {
             "req": req, "full": full, "n": n, "next": matched,
@@ -2268,7 +2555,9 @@ class DecodeEngine:
                     self.params, self._cache, self._logbuf, tokens,
                     np.ascontiguousarray(
                         self._tables[slot])[None, :],
-                    np.int32(slot), np.int32(length), np.int32(start))
+                    np.int32(slot), np.int32(length), np.int32(start),
+                    self._lora_tree(),
+                    np.full((1,), int(self._aids[slot]), np.int32))
             except Exception as e:
                 if self._donate:
                     self._fail_inflight(e)
@@ -2297,8 +2586,14 @@ class DecodeEngine:
         only when a DONATED COW dispatch died (the carried cache is
         gone, every request already failed via _fail_inflight — the
         caller must stop touching this cursor)."""
+        req = cur["req"]
+        # Same resolved-id rule as admission: a degraded slot (aid -1)
+        # holds base KV and must match the base chain.
+        aid = int(self._aids[slot])
         shared, cow, matched, key = self._prefix.match(
-            cur["full"], cur["n"] - 1)
+            cur["full"], cur["n"] - 1,
+            root=req.adapter.encode() if (req.adapter and aid >= 0)
+            else b"")
         if not matched:
             return True
         pinned = shared + ([cow[0]] if cow is not None else [])
@@ -2448,7 +2743,7 @@ class DecodeEngine:
             "Slots preempted (recompute-requeued) on pool exhaustion."
             ).inc(1, model=self.name)
         with self._cond:
-            self._queue.appendleft(req)
+            self._queue.push_front(req)
 
     def _ensure_spec_pages(self) -> None:
         """Spec-mode page budget for the next verify window, at the
@@ -2611,7 +2906,9 @@ class DecodeEngine:
                 np.ascontiguousarray(self._draft_tables),
                 self._pending, self._pos, self._loc, self._max_loc,
                 spec_on, draft_live, self._active, self._rngs,
-                self._temp, self._topk)
+                self._temp, self._topk, self._lora_tree(),
+                self._lora_tree(draft=True),
+                np.ascontiguousarray(self._aids))
             (self._cache, self._draft_cache, rngs, D, A, bonus) = out
             D = np.asarray(D)          # [B, k]
             A = np.asarray(A)          # [B]
@@ -2681,7 +2978,8 @@ class DecodeEngine:
                 self.params, self._cache, self._logbuf,
                 np.ascontiguousarray(self._tables), self._pos,
                 self._loc, self._active, self._produced, self._rngs,
-                self._temp, self._topk, self._stop, self._max_new)
+                self._temp, self._topk, self._stop, self._max_new,
+                self._lora_tree(), np.ascontiguousarray(self._aids))
         (self._cache, self._logbuf, pos, loc, active, produced, rngs,
          toks, emits) = out
         # np.array (copy): admission mutates these rows in place, and a
@@ -2722,6 +3020,12 @@ class DecodeEngine:
                 self._slots[slot] = None
                 req._finish(e)
         self._prefilling.clear()
+        if self._apool is not None:
+            # Every wearer just failed; loaded adapters stay resident
+            # (the stacks are never donated, so a dead dispatch cannot
+            # have corrupted them).
+            self._apool.release_all()
+        self._aids[:] = -1
         self._active[:] = False
         self._tables[:, :] = -1
         self._slot_pages = [[] for _ in range(self.n_slots)]
@@ -2754,8 +3058,7 @@ class DecodeEngine:
             if self._stopped:
                 return
             self._stopped = True
-            queued = list(self._queue)
-            self._queue.clear()
+            queued = self._queue.drain_all()
             self._cond.notify_all()
         self._thread.join(timeout=10.0)
         err = RuntimeError("engine closed")

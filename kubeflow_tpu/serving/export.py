@@ -38,6 +38,79 @@ from flax import serialization
 # field as v1 — every pre-versioning export stays loadable.
 FORMAT_VERSION = 2
 
+# LoRA adapter artifacts (a separate, parallel format: an adapter is
+# not a model — it is a rank-r correction to one, a few hundred KB
+# against the base's GBs, which is the entire multi-tenant economics).
+ADAPTER_CONFIG_FILE = "adapter_config.json"
+ADAPTER_FORMAT_VERSION = 1
+
+
+def export_adapter(directory: str, name: str, cfg, lora_flat,
+                   rank: int, alpha: float) -> str:
+    """Write one versioned LoRA adapter artifact: a directory with
+    ``adapter_config.json`` (format version, adapter name, rank/alpha,
+    the base dims the factors were trained against — enough for the
+    serving pool to validate fit without loading the tensors) and
+    ``params.msgpack`` holding the flat target tree
+    ``{"attn.query": {"a": [L, d_in, r], "b": [L, r, d_out]}, ...}``
+    (serving/adapters.py ``extract_lora`` produces it from a trained
+    param tree). The factors are stored UNSCALED — alpha/rank is
+    metadata, folded in at pool load time."""
+    if rank < 1:
+        raise ValueError("adapter rank must be >= 1")
+    if not lora_flat:
+        raise ValueError("lora_flat is empty — nothing to export "
+                         "(did the fine-tune config set lora_rank?)")
+    os.makedirs(directory, exist_ok=True)
+    config = {
+        "format_version": ADAPTER_FORMAT_VERSION,
+        "kind": "lora_adapter",
+        "name": str(name),
+        "rank": int(rank),
+        "alpha": float(alpha),
+        "targets": sorted(lora_flat),
+        "base": {
+            "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+            "n_heads": cfg.n_heads, "head_dim": cfg.head_dim,
+            "d_ff": cfg.d_ff, "vocab_size": cfg.vocab_size,
+        },
+    }
+    with open(os.path.join(directory, ADAPTER_CONFIG_FILE), "w") as f:
+        json.dump(config, f)
+    payload = {k: {kk: np.asarray(jax.device_get(vv))
+                   for kk, vv in v.items()}
+               for k, v in lora_flat.items()}
+    with open(os.path.join(directory, "params.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    return directory
+
+
+def _adapter_path(uri: str) -> str:
+    return uri[len("file://"):] if uri.startswith("file://") else uri
+
+
+def load_adapter(uri: str) -> Tuple[Dict[str, Any], Any]:
+    """Load an adapter artifact. Returns (config, flat A/B tree).
+    Accepts a bare path or file:// URI; rejects non-adapter artifacts
+    loudly (pointing an adapters.artifacts entry at a MODEL export is
+    a manifest bug, not a tensor-shape surprise three layers later)."""
+    path = _adapter_path(uri)
+    with open(os.path.join(path, ADAPTER_CONFIG_FILE)) as f:
+        config = json.load(f)
+    if config.get("kind") != "lora_adapter":
+        raise ValueError(f"{uri} is not a LoRA adapter artifact")
+    with open(os.path.join(path, "params.msgpack"), "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return config, payload
+
+
+def peek_adapter_rank(uri: str) -> int:
+    """The declared rank from an artifact's config alone (no tensor
+    IO) — the pool's auto-rank sizing reads every configured source
+    once at engine construction."""
+    with open(os.path.join(_adapter_path(uri), ADAPTER_CONFIG_FILE)) as f:
+        return int(json.load(f).get("rank", 0))
+
 
 def quantize_tree_int8(tree: Any) -> Any:
     """Per-output-channel symmetric int8 quantization of a generic

@@ -6,8 +6,13 @@ dtypes as strings) + ``params.msgpack``. The predictor serves a
 
     POST /v1/models/{m}:generate
     {"prompt_tokens": [[1,2,3], ...], "max_new_tokens": 32,
-     "temperature": 0.7, "top_k": 40, "seed": 1, "stop_token": 2}
+     "temperature": 0.7, "top_k": 40, "seed": 1, "stop_token": 2,
+     "adapter": "tenant-a"}
     -> {"generated_tokens": [[...], ...]}
+
+(``adapter`` selects a LoRA adapter configured by
+``spec.<rev>.adapters`` — multi-tenant serving, docs/serving.md;
+absent = the revision's default adapter, "" = the base model.)
 
 Two decode backends share the same model and the same HTTP contract:
 
@@ -204,6 +209,28 @@ class LMPredictor(Predictor):
         self.quant = os.environ.get("KFX_LM_QUANT", "")
         self.kv_quant = os.environ.get("KFX_LM_KV_QUANT", "")
         self.draft_quant = os.environ.get("KFX_LM_QUANT_DRAFT", "")
+        # Multi-tenant LoRA adapters (docs/serving.md): KFX_LM_ADAPTERS
+        # is a JSON object {name: artifact URI} (spec.<rev>.adapters.
+        # artifacts via the operator); requests select one with the
+        # body field "adapter". DEFAULT applies when the body names
+        # none; SLOTS sizes the HBM stack pool; RANK 0 = auto (max
+        # declared by the artifacts); FALLBACK is the load-failure
+        # policy ("base" = degrade to base-only, "error" = 503 +
+        # Retry-After).
+        try:
+            self.adapters = json.loads(
+                os.environ.get("KFX_LM_ADAPTERS", "") or "{}")
+        except ValueError as e:
+            raise ValueError(
+                f"KFX_LM_ADAPTERS is not valid JSON: {e}") from e
+        self.adapter_default = os.environ.get(
+            "KFX_LM_ADAPTER_DEFAULT", "")
+        self.adapter_slots = int(
+            os.environ.get("KFX_LM_ADAPTER_SLOTS", "8"))
+        self.adapter_rank = int(
+            os.environ.get("KFX_LM_ADAPTER_RANK", "0"))
+        self.adapter_fallback = os.environ.get(
+            "KFX_LM_ADAPTER_FALLBACK", "base")
         # Liveness: seconds of decode-loop stall (while busy) before
         # the engine's heartbeat reads wedged and /healthz fails the
         # probe. Size it well above one worst-case dispatch (a chunk on
@@ -263,7 +290,12 @@ class LMPredictor(Predictor):
                 kv_quant="int8" if self.kv_quant == "int8" else "",
                 draft_quant="int8" if self.draft_quant == "int8" else "",
                 stall_threshold_s=self.stall_threshold_s,
-                prefill_chunk_tokens=max(0, self.prefill_chunk))
+                prefill_chunk_tokens=max(0, self.prefill_chunk),
+                adapters=self.adapters or None,
+                adapter_slots=self.adapter_slots,
+                adapter_rank=self.adapter_rank,
+                adapter_default=self.adapter_default,
+                adapter_fallback=self.adapter_fallback)
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".
@@ -374,6 +406,17 @@ class LMPredictor(Predictor):
                 raise ValueError(
                     "stop_token requires the engine path "
                     "(KFX_LM_ENGINE=1)")
+        # Per-request adapter selection (multi-tenant LoRA): a string
+        # adapter name from spec.<rev>.adapters.artifacts; absent =
+        # the revision's default adapter; "" = explicitly the base
+        # model. Unknown names are a client 400, not a 503.
+        adapter = body.get("adapter")
+        if adapter is not None and not isinstance(adapter, str):
+            raise ValueError("adapter must be a string adapter name")
+        if adapter is not None and self._engine is None:
+            raise ValueError(
+                "adapter selection requires the engine path "
+                "(KFX_LM_ENGINE=1)")
         prompts = [list(map(int, p)) for p in prompts]
         kw = dict(max_new_tokens=int(body.get("max_new_tokens", 32)),
                   temperature=float(body.get("temperature", 0.0)),
@@ -381,7 +424,8 @@ class LMPredictor(Predictor):
                   seed=int(body.get("seed", 0)))
         t0 = time.perf_counter()
         if self._engine is not None:
-            out = self._engine.generate(prompts, stop_token=stop, **kw)
+            out = self._engine.generate(prompts, stop_token=stop,
+                                        adapter=adapter, **kw)
         else:
             out = self._gen.generate(prompts, **kw)
         elapsed = time.perf_counter() - t0

@@ -55,13 +55,18 @@ def chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
 
 def affinity_key(tokens: Sequence[int],
                  page_tokens: int = AFFINITY_PAGE_TOKENS,
-                 max_pages: int = AFFINITY_MAX_PAGES) -> str:
+                 max_pages: int = AFFINITY_MAX_PAGES,
+                 root: str = "") -> str:
     """Routing affinity key for a prompt: the hex chain hash of its
     leading full ``page_tokens``-sized pages, capped at ``max_pages``.
     Empty string when the prompt has no full page (nothing worth
-    pinning — a sub-page prompt re-prefills in one dispatch anyway)."""
+    pinning — a sub-page prompt re-prefills in one dispatch anyway).
+    ``root`` seeds the chain with the request's ADAPTER name (the
+    engine's prefix cache is adapter-scoped — cached pages hold
+    adapter KV — so same-prompt requests under different adapters have
+    nothing to share and should not be co-located for it)."""
     toks = list(tokens)
-    key = b""
+    key = root.encode() if root else b""
     n = 0
     while n + page_tokens <= len(toks) and n // page_tokens < max_pages:
         key = chain_hash(key, toks[n:n + page_tokens])

@@ -282,6 +282,13 @@ class Router:
         self.affinity_capacity = int(affinity_capacity)
         self._affinity: "OrderedDict[str, str]" = OrderedDict()
         self._aff_lock = threading.Lock()
+        # The predictor's default adapter name (spec.predictor.
+        # adapters.default, stamped by the operator each reconcile):
+        # a body that OMITS "adapter" is served with this adapter by
+        # the engine, so its affinity key must root there too — else
+        # default-adapter traffic keys as base and splits one
+        # shareable chain from explicitly-named requests.
+        self.default_adapter = ""
         # Per-revision observability (the autoscaler/SLO-watcher input):
         # when a registry is wired (the operator passes the control
         # plane's), every forwarded request records
@@ -437,8 +444,7 @@ class Router:
             while len(self._affinity) > self.affinity_capacity:
                 self._affinity.popitem(last=False)
 
-    @staticmethod
-    def _affinity_from_body(data: bytes) -> str:
+    def _affinity_from_body(self, data: bytes) -> str:
         """Header-less clients: derive the prefix key from the
         buffered ``:generate`` body (the router already buffers it for
         cross-replica recovery). Multi-prompt bodies key on the first
@@ -447,12 +453,22 @@ class Router:
         if not data:
             return ""
         try:
-            prompts = json.loads(data).get("prompt_tokens") or []
+            body = json.loads(data)
+            prompts = body.get("prompt_tokens") or []
             if prompts and isinstance(prompts[0], int):
                 prompts = [prompts]
             if not prompts or not isinstance(prompts[0], list):
                 return ""
-            return affinity_key(prompts[0])
+            # Adapter-scoped: the engine's prefix cache chains per
+            # adapter, so the affinity key must too — otherwise two
+            # tenants sharing a prompt template would co-locate for
+            # pages they can never share. An ABSENT field means the
+            # revision's default adapter (the engine's resolution
+            # rule); an explicit "" means base.
+            adapter = body.get("adapter")
+            if adapter is None:
+                adapter = self.default_adapter
+            return affinity_key(prompts[0], root=str(adapter or ""))
         except (ValueError, TypeError, AttributeError):
             return ""
 

@@ -536,6 +536,10 @@ class ModelServer:
                                "prefix_tokens_reused"),
                               ("kfx_lm_prompt_tokens_admitted",
                                "prompt_tokens_admitted"),
+                              ("kfx_lm_adapter_slots",
+                               "adapter_slots"),
+                              ("kfx_lm_adapter_slots_free",
+                               "adapter_slots_free"),
                               ("kfx_lm_spec_accept_rate",
                                "spec_accept_rate")):
             for labels, value in self.metrics.gauge(family).samples():

@@ -1,0 +1,135 @@
+"""LoRA fine-tuning: train rank-r A/B factors against a FROZEN base.
+
+The multi-tenant serving story (serving/adapters.py) starts here: a
+tenant's "model" is not a new set of base weights, it is a low-rank
+correction — ``y = base(x) + (x @ A) @ B · alpha/rank`` on the
+attention q/k/v/out and dense-MLP wi/wo projections
+(models/transformer.py, ``lora_rank``/``lora_alpha``). This module owns
+the training loop:
+
+  * the base params are CLOSED OVER as a frozen jit argument — grads
+    are taken with respect to the LoRA leaf tree ONLY, so freezing is
+    structural (there is no optimizer state for the base, nothing to
+    mask, nothing that can drift);
+  * B initialises to zero, so step 0 of every fine-tune IS the base
+    model bit-for-bit — a fine-tune can only move away from known-good;
+  * ``export(dir, name)`` writes the small versioned adapter artifact
+    (serving/export.py ``export_adapter``) the serving AdapterPool
+    pages into HBM slots — a few hundred KB per tenant against the
+    base's GBs;
+  * ``merged_params()`` folds scale·A·B into the base kernels — the
+    dense merged-weights ORACLE the engine's batched-gather serving
+    path is parity-tested against (and the escape hatch for serving
+    one adapter the old-fashioned way).
+
+Fine-tunes are deliberately single-device and optax-plain: the whole
+point of LoRA economics is that the trainable state is tiny. Sharded
+base-model pretraining stays in parallel/lm_train.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from ..models.transformer import TransformerConfig, TransformerLM
+
+
+class LoRAFineTuner:
+    """Owns one fine-tune: base config + frozen base params, the LoRA
+    leaf tree, its optimizer state, and ONE jitted step (donated
+    lora/opt buffers; the base rides as a non-donated argument so the
+    compiled program never embeds it as constants)."""
+
+    def __init__(self, cfg: TransformerConfig, base_params,
+                 rank: int = 8, alpha: float = 16.0,
+                 learning_rate: float = 1e-3, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..serving.adapters import graft_lora, split_lora_tree
+
+        if rank < 1:
+            raise ValueError("rank must be >= 1")
+        if cfg.n_experts > 0:
+            raise ValueError("LoRA fine-tuning targets the dense FFN; "
+                             "MoE configs are not supported")
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+        self.cfg = dataclasses.replace(cfg, lora_rank=self.rank,
+                                       lora_alpha=self.alpha,
+                                       decode=False, kv_page_size=0,
+                                       kv_pages=0, kv_quant="")
+        self.model = TransformerLM(self.cfg)
+        self.base = jax.device_put(base_params)
+        # Init a LoRA-enabled tree only to mint the factor leaves (A
+        # random small, B exactly zero); the base leaves it also
+        # produced are discarded — the caller's trained base is the
+        # truth.
+        sample = jnp.zeros((1, min(8, self.cfg.max_seq_len)), jnp.int32)
+        full = self.model.init(jax.random.PRNGKey(seed),
+                               sample)["params"]
+        _, self.lora = split_lora_tree(full)
+        self.tx = optax.adamw(learning_rate)
+        self.opt_state = self.tx.init(self.lora)
+        self.step = 0
+        self._graft = graft_lora
+
+        def train_step(base, lora, opt_state, tokens):
+            import optax as _optax
+
+            def loss_fn(lp):
+                params = graft_lora(base, lp)
+                inputs, targets = tokens[:, :-1], tokens[:, 1:]
+                logits = self.model.apply({"params": params}, inputs)
+                ce = _optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets)
+                return ce.mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(lora)
+            updates, opt_state = self.tx.update(grads, opt_state, lora)
+            return _optax.apply_updates(lora, updates), opt_state, loss
+
+        self._step = jax.jit(train_step, donate_argnums=(1, 2))
+
+    def train_step(self, tokens) -> float:
+        """One optimizer step over ``tokens`` [B, S+1] (inputs ||
+        shifted targets, the LMTrainLoop batch convention). Returns the
+        loss. Only the LoRA leaves move."""
+        self.lora, self.opt_state, loss = self._step(
+            self.base, self.lora, self.opt_state, tokens)
+        self.step += 1
+        return float(loss)
+
+    def train(self, batches) -> list:
+        return [self.train_step(t) for t in batches]
+
+    # -- outputs -------------------------------------------------------------
+    def lora_flat(self) -> Dict[str, Dict[str, Any]]:
+        """The artifact-form factor tree
+        ({"attn.query": {"a", "b"}, ...})."""
+        from ..serving.adapters import extract_lora
+
+        return extract_lora(self.lora)
+
+    def params(self):
+        """Base + LoRA leaves grafted — the apply-form tree for
+        eval/generation through the ``lora_rank`` model."""
+        return self._graft(self.base, self.lora)
+
+    def merged_params(self):
+        """The dense merged-weights tree (``W + alpha/rank·A·B``): the
+        serving parity oracle, and a drop-in for any base-shaped
+        consumer (LMGenerator, export_lm)."""
+        from ..serving.adapters import merge_lora_params
+
+        return merge_lora_params(self.base, self.lora_flat(),
+                                 self.rank, self.alpha)
+
+    def export(self, directory: str, name: str) -> str:
+        """Write the versioned adapter artifact serving pages in."""
+        from ..serving.export import export_adapter
+
+        return export_adapter(directory, name, self.cfg,
+                              self.lora_flat(), self.rank, self.alpha)

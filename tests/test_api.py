@@ -455,6 +455,53 @@ spec:
                            match=r"spec\.canary\.quantization"):
             load_manifests(bad)
 
+    def test_adapters_field_paths(self):
+        """spec.predictor.adapters {artifacts, default, slots, rank,
+        fallback} (multi-tenant LoRA): artifacts is a required
+        non-empty {name: URI} map, default must name one of them (''
+        = base), slots/rank are integers >= 1 (`slots: true` is a 400
+        at apply, never slot count 1 at startup), fallback is
+        'base'|'error' — all with field-path errors."""
+        ok = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    adapters:\n"
+            "      artifacts: {a: 'file:///tmp/ad/a'}\n"
+            "      default: a\n      slots: 4\n      rank: 8\n"
+            "      fallback: base\n", 1)
+        (isvc,) = load_manifests(ok)
+        assert isvc.predictor()["adapters"]["artifacts"] == {
+            "a": "file:///tmp/ad/a"}
+        for bad_val, path in (
+                ("{artifacts: {}}", "adapters.artifacts"),
+                ("{artifacts: [a]}", "adapters.artifacts"),
+                ("{artifacts: {a: 3}}", r"adapters\.artifacts\['a'\]"),
+                ("{artifacts: {a: x}, default: b}", "adapters.default"),
+                ("{artifacts: {a: x}, default: 2}", "adapters.default"),
+                ("{artifacts: {a: x}, slots: true}", "adapters.slots"),
+                ("{artifacts: {a: x}, slots: 0}", "adapters.slots"),
+                ("{artifacts: {a: x}, rank: 1.5}", "adapters.rank"),
+                ("{artifacts: {a: x}, fallback: retry}",
+                 "adapters.fallback"),
+                ("lora", r"spec\.predictor\.adapters")):
+            bad = self.ISVC_YAML.replace(
+                "predictor:\n",
+                f"predictor:\n    adapters: {bad_val}\n", 1)
+            with pytest.raises(ValidationError, match=path):
+                load_manifests(bad)
+        # '' default = explicitly the base model: valid.
+        base_dflt = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    adapters: {artifacts: {a: x}, "
+            "default: ''}\n", 1)
+        load_manifests(base_dflt)
+        # The canary revision is validated on its own field path.
+        bad = self.ISVC_YAML + (
+            "  canary:\n    adapters: {artifacts: {}}\n"
+            "    jax: {storageUri: 'file:///tmp/models/resnet'}\n")
+        with pytest.raises(ValidationError,
+                           match=r"spec\.canary\.adapters"):
+            load_manifests(bad)
+
     def test_drain_window_field_path(self):
         """spec.predictor.drainWindowSeconds bounds drain-before-kill:
         any number >= 0 passes (0 = kill immediately, the escape

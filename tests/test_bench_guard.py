@@ -138,6 +138,18 @@ class TestBoxGuard:
                     "lm_mixed_affinity_hits"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_adapter_keys_in_contract(self):
+        """The multi-tenant adapter acceptance numbers (ISSUE 15: one
+        engine serving 8 LoRA adapters with lm_adapters_hbm_ratio <=
+        1.5x a base engine, vs the ~Nx separate-engines estimate) ride
+        the compact BENCH_CONTRACT line; pinned like the paged-KV
+        keys."""
+        for key in ("lm_adapters_n", "lm_adapters_tokens_per_s",
+                    "lm_adapters_base_tokens_per_s",
+                    "lm_adapters_hbm_mb", "lm_adapters_hbm_ratio",
+                    "lm_adapters_sep_engines_hbm_ratio"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_lm_mfu_keys_in_contract(self):
         """The training-MFU acceptance numbers (ISSUE 8: lm_best_mfu >=
         0.60, lm_long_mfu >= 0.45, no step-time-variance regression)

@@ -939,7 +939,8 @@ class TestSpeculative:
         out = fn(eng.params, eng.draft_params, cache, dcache,
                  tables, np.array(tables), pending, pos, loc, max_loc,
                  on, on, on, rngs, np.zeros((B,), np.float32),
-                 np.zeros((B,), np.int32))
+                 np.zeros((B,), np.int32), {}, {},
+                 np.full((B,), -1, np.int32))
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 out[0])[0]:
             if getattr(path[-1], "key", "") == "cached_pos":

@@ -150,7 +150,8 @@ class TestKfxVerbs:
                 "desired": 2, "target": 8,
                 "kvUtil": 0.42, "prefillSkip": 0.63,
                 "specAcceptRate": 0.87,
-                "quant": "w8+kv8", "restarts": 3}},
+                "quant": "w8+kv8", "adapters": "3/8",
+                "restarts": 3}},
         }
         clf = InferenceService.from_dict({
             "metadata": {"name": "clf", "namespace": "default"},
@@ -168,12 +169,17 @@ class TestKfxVerbs:
         # Q column: the engine's quantization mode; "-" when the
         # operator never sampled one (classifier revisions).
         assert rows[0][9] == "w8+kv8"
+        # ADPT column: the adapter-slot pool as pinned/total
+        # (multi-tenant LoRA revisions; "-" when the engine has no
+        # adapter pool).
+        assert rows[0][10] == "3/8"
         # RESTARTS column, fed from the operator's restart accounting
         # (same number kfx_replica_restarts_total counts).
-        assert rows[0][10] == "3"
+        assert rows[0][11] == "3"
         assert rows[1][6] == "-" and rows[1][7] == "-"
         assert rows[1][8] == "-" and rows[1][9] == "-"
-        assert rows[1][10] == "-"  # operator never reported restarts
+        assert rows[1][10] == "-"  # no adapter pool sampled
+        assert rows[1][11] == "-"  # operator never reported restarts
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main

@@ -569,6 +569,45 @@ class TestPrefillEnvPlumbing:
         assert env == {}
 
 
+class TestAdapterEnvPlumbing:
+    def test_adapters_spec_exports_env(self):
+        """spec.predictor.adapters -> the replica's KFX_LM_ADAPTER*
+        env (the multi-tenant LoRA knobs LMPredictor reads at load):
+        the artifacts map rides as JSON, the optional knobs export
+        only when explicit (the predictor owns the defaults), and
+        non-predictor roles export nothing."""
+        import json as _json
+
+        from kubeflow_tpu.operators.serving import _Revision
+
+        rev = _Revision(name="default", model_name="m", model_dir="d",
+                        workdir="w", batcher=None,
+                        adapters={"artifacts": {"a": "file:///ad/a"},
+                                  "default": "a", "slots": 4,
+                                  "rank": 8, "fallback": "error"})
+        env: dict = {}
+        rev._adapter_env(env)
+        assert _json.loads(env["KFX_LM_ADAPTERS"]) == {
+            "a": "file:///ad/a"}
+        assert env["KFX_LM_ADAPTER_DEFAULT"] == "a"
+        assert env["KFX_LM_ADAPTER_SLOTS"] == "4"
+        assert env["KFX_LM_ADAPTER_RANK"] == "8"
+        assert env["KFX_LM_ADAPTER_FALLBACK"] == "error"
+        env = {}
+        rev.adapters = {"artifacts": {"a": "file:///ad/a"}}
+        rev._adapter_env(env)
+        assert set(env) == {"KFX_LM_ADAPTERS"}
+        env = {}
+        rev.adapters = None
+        rev._adapter_env(env)
+        assert env == {}
+        rev.adapters = {"artifacts": {"a": "file:///ad/a"}}
+        rev.role = "transformer"
+        env = {}
+        rev._adapter_env(env)
+        assert env == {}
+
+
 @pytest.mark.slow
 class TestInferenceServiceE2E:
     def test_speculative_spec_exports_env(self):
