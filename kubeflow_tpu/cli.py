@@ -327,11 +327,13 @@ class KfxCLI:
         return rc
 
     def query(self, family: str, fn: str, labels: str,
-              since: float) -> int:
+              since: float, as_json: bool = False) -> int:
         """Windowed telemetry query (`kfx query FAMILY --fn rate`):
         the central store's history for any scraped family, rendered
         as the aggregate value plus an ASCII sparkline of the window's
-        points. Shares the /query endpoint's semantics exactly."""
+        points (or the raw result dict with ``--json`` — scriptable
+        incident tooling; rc semantics identical). Shares the /query
+        endpoint's semantics exactly."""
         from .apiserver import parse_label_selector
 
         try:
@@ -341,13 +343,15 @@ class KfxCLI:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-        return _print_query(res.to_dict())
+        return _print_query(res.to_dict(), as_json=as_json)
 
-    def alerts(self) -> int:
+    def alerts(self, as_json: bool = False) -> int:
         """Alert-rule states (`kfx alerts`): the rule pack with each
         rule's live state/value — transitions land as kind=Alert store
         events (`kfx events` territory); this is the "what is firing
-        right now" view."""
+        right now" view. ``--json`` emits the raw state list (rc still
+        1 while anything fires — same scriptable health-check
+        contract)."""
         if self.cp.alerts.last_eval == 0:
             # A passive (read-only) plane never scrapes or evaluates:
             # rendering every rule as "inactive" would read as a green
@@ -356,7 +360,84 @@ class KfxCLI:
                   "process (passive plane) — run inside `kfx server` "
                   "or set KFX_SERVER to query the live plane",
                   file=sys.stderr)
-        return _print_alerts(self.cp.alerts.states())
+        return _print_alerts(self.cp.alerts.states(), as_json=as_json)
+
+    def postmortem(self, name: str, namespace: str,
+                   bundle: str = "") -> int:
+        """List an InferenceService's postmortem bundles (`kfx
+        postmortem <isvc>`) and render the newest one's flight ring as
+        an ASCII timeline with the stalled iteration marked — the
+        incident-bridge view of what the replica's engine was doing
+        when the operator killed (or reaped) it. ``--bundle PATH``
+        renders a specific bundle instead of the newest."""
+        import glob
+
+        from .obs.flightrec import render_timeline
+
+        self.cp.store.get("InferenceService", name, namespace)
+        pattern = os.path.join(self.cp.home, "serving", "*",
+                               "postmortem", "*")
+        bundles = []
+        for d in sorted(glob.glob(pattern)):
+            meta = _read_json(os.path.join(d, "meta.json")) or {}
+            if meta.get("isvc") == name and \
+                    meta.get("namespace") == namespace:
+                bundles.append((d, meta))
+        if not bundles:
+            print(f"no postmortem bundles for {namespace}/{name} "
+                  f"(searched {pattern})")
+            return 1
+        rows = [[os.path.basename(d), str(meta.get("reason", "-")),
+                 str(meta.get("revision", "-")),
+                 str(meta.get("port", "-")), d]
+                for d, meta in bundles]
+        _print_table(rows, ["BUNDLE", "REASON", "REVISION", "PORT",
+                            "PATH"])
+        chosen = bundle or bundles[-1][0]
+        flight = _read_json(os.path.join(chosen, "flight.json"))
+        if flight is None:
+            print(f"error: {chosen}/flight.json unreadable",
+                  file=sys.stderr)
+            return 1
+        print(f"\nflight ring from {chosen}:")
+        for model, snap in sorted(
+                _flight_models(flight).items()):
+            print(f"[{model}]")
+            print(render_timeline(snap.get("records") or [],
+                                  heartbeat=snap.get("heartbeat")))
+        return 0
+
+    def flight(self, name: str, namespace: str) -> int:
+        """Live flight-ring view (`kfx flight <isvc>`): render the
+        newest /healthz-refreshed flight snapshot file each replica of
+        the InferenceService wrote into its revision workdir — the
+        same timeline `kfx postmortem` renders, before anything has
+        died. Host-local (reads workdir files), like `kfx trace`."""
+        import glob
+
+        from .obs.flightrec import render_timeline
+
+        self.cp.store.get("InferenceService", name, namespace)
+        snaps = sorted(glob.glob(os.path.join(
+            self.cp.home, "serving", "*", "flight", "*.json")),
+            key=lambda p: os.path.getmtime(p))
+        if not snaps:
+            print(f"no flight snapshots under "
+                  f"{os.path.join(self.cp.home, 'serving')} (replicas "
+                  f"write them on /healthz; KFX_FLIGHT=0 disables)")
+            return 1
+        rendered = 0
+        for snap_path in snaps[-4:]:
+            doc = _read_json(snap_path)
+            if doc is None:
+                continue
+            print(f"{snap_path}:")
+            for model, snap in sorted(_flight_models(doc).items()):
+                print(f"[{model}]")
+                print(render_timeline(snap.get("records") or [],
+                                      heartbeat=snap.get("heartbeat")))
+                rendered += 1
+        return 0 if rendered else 1
 
     def queue(self) -> int:
         """Gang-scheduler view (`kfx queue`): slice capacity, the gangs
@@ -663,13 +744,36 @@ def _fmt_value(v, fn: str) -> str:
     return f"{v:.4g}/s" if fn == "rate" else f"{v:.4g}"
 
 
-def _print_query(res: dict) -> int:
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _flight_models(doc: dict) -> dict:
+    """{model: snapshot} out of a flight document — both the server's
+    /debug/flight body and the on-disk snapshot file nest snapshots
+    under "models" (a bare single-model snapshot also renders)."""
+    if not isinstance(doc, dict):
+        return {}
+    models = doc.get("models")
+    if isinstance(models, dict):
+        return models
+    return {"model": doc} if "records" in doc else {}
+
+
+def _print_query(res: dict, as_json: bool = False) -> int:
     """Render one /query result (shared by local and remote `kfx
     query`). rc 1 when the window holds no samples at all — the
-    scriptable 'is there history' signal."""
+    scriptable 'is there history' signal (same rc with --json)."""
     pts = res.get("points") or []
     value = res.get("value")
     fn = res.get("fn", "latest")
+    if as_json:
+        print(json.dumps(res, indent=1))
+        return 1 if (value is None and not pts) else 0
     print(f"{res.get('family')} {fn}[{res.get('since'):g}s] = "
           f"{_fmt_value(value, fn)}  "
           f"({res.get('seriesMatched', 0)} series, {len(pts)} points)")
@@ -686,15 +790,17 @@ def _print_query(res: dict) -> int:
     return 0
 
 
-def _print_alerts(states: List[dict]) -> int:
+def _print_alerts(states: List[dict], as_json: bool = False) -> int:
     """Render the rule states (shared by local and remote `kfx
     alerts`). rc 1 while anything is firing — scriptable like a
-    health check."""
+    health check (same rc with --json)."""
+    firing = sum(1 for st in states if st.get("state") == "firing")
+    if as_json:
+        print(json.dumps({"alerts": states, "firing": firing},
+                         indent=1))
+        return 1 if firing else 0
     rows = []
-    firing = 0
     for st in states:
-        if st.get("state") == "firing":
-            firing += 1
         val = st.get("value")
         rows.append([st.get("name", ""), st.get("severity", ""),
                      str(st.get("state", "")),
@@ -890,9 +996,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="label selector, e.g. isvc=fleet,code=5xx")
     sp.add_argument("--since", type=float, default=60.0,
                     help="window in seconds (default 60)")
+    sp.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw result dict (rc unchanged)")
 
-    sub.add_parser("alerts", help="alert-rule states (pending/firing/"
-                                  "resolved ride kind=Alert events)")
+    sp = sub.add_parser("alerts", help="alert-rule states (pending/"
+                                       "firing/resolved ride "
+                                       "kind=Alert events)")
+    sp.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw state list (rc still 1 while "
+                         "anything fires)")
+
+    sp = sub.add_parser(
+        "postmortem", help="list an InferenceService's postmortem "
+                           "bundles and render the newest flight ring "
+                           "(stalled iteration marked)")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--bundle", default="",
+                    help="render this bundle dir instead of the newest")
+
+    sp = sub.add_parser(
+        "flight", help="render the live flight-recorder snapshots of "
+                       "an InferenceService's replicas (workdir files "
+                       "refreshed on every liveness probe)")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
 
     sub.add_parser("queue", help="gang-scheduler state: slice capacity, "
                                  "running gangs (incl. serving "
@@ -986,12 +1114,15 @@ def _main(argv: Optional[List[str]] = None) -> int:
                      "alerts")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
-    if os.environ.get("KFX_SERVER") and args.cmd == "trace":
+    if os.environ.get("KFX_SERVER") and args.cmd in ("trace",
+                                                     "postmortem",
+                                                     "flight"):
         # Falling through to a local passive plane would diagnose "not
         # found" against the LOCAL home while the job lives on the
-        # server — a misleading answer. Span files are host-local; run
-        # the verb where the server's home is.
-        print(f"error: `kfx trace` reads span files from the server's "
+        # server — a misleading answer. Span files, postmortem bundles
+        # and flight snapshots are host-local; run the verb where the
+        # server's home is.
+        print(f"error: `kfx {args.cmd}` reads files from the server's "
               f"home on its own host and is not supported in "
               f"KFX_SERVER client mode; run it on the host of "
               f"{os.environ['KFX_SERVER']} (unset KFX_SERVER there)",
@@ -1035,7 +1166,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # above.
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
                            "delete", "kill-replica", "top", "trace",
-                           "queue", "rollout", "query", "alerts")
+                           "queue", "rollout", "query", "alerts",
+                           "postmortem", "flight")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -1097,9 +1229,14 @@ def _main(argv: Optional[List[str]] = None) -> int:
             return cli.top(watch=args.watch, window_s=args.window)
         if args.cmd == "query":
             return cli.query(args.family, args.fn, args.labels,
-                             args.since)
+                             args.since, as_json=args.as_json)
         if args.cmd == "alerts":
-            return cli.alerts()
+            return cli.alerts(as_json=args.as_json)
+        if args.cmd == "postmortem":
+            return cli.postmortem(args.name, args.namespace,
+                                  bundle=args.bundle)
+        if args.cmd == "flight":
+            return cli.flight(args.name, args.namespace)
         if args.cmd == "queue":
             return cli.queue()
         if args.cmd == "rollout":
@@ -1349,12 +1486,13 @@ def _remote_dispatch(client, args) -> int:
         try:
             return _print_query(client.query(
                 args.family, args.fn,
-                _selector_dict(args.labels), args.since))
+                _selector_dict(args.labels), args.since),
+                as_json=args.as_json)
         except (ApiError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
     if args.cmd == "alerts":
-        return _print_alerts(client.alerts())
+        return _print_alerts(client.alerts(), as_json=args.as_json)
     if args.cmd == "queue":
         print(_remote_capacity_summary(client))
         running, queued = _slice_state(_remote_jobs(client))

@@ -201,6 +201,30 @@ class TSDB:
         with self._lock:
             return self._n_series
 
+    def window(self, labels: Optional[Dict[str, str]] = None,
+               since_s: float = 120.0,
+               now: Optional[float] = None) -> Dict[str, list]:
+        """Export every matching series' samples inside the trailing
+        window as plain JSON-able data: ``{family: [{"labels": {...},
+        "points": [[ts, v], ...]}, ...]}``. The postmortem-bundle
+        exporter — subset label match (usually ``{"instance": ...}``)
+        over all families, bounded by retention."""
+        now = time.time() if now is None else float(now)
+        horizon = now - max(float(since_s), 0.0)
+        out: Dict[str, list] = {}
+        with self._lock:
+            for name, fam in self._series.items():
+                rows = []
+                for key, buf in fam.items():
+                    if not _matches(key, labels):
+                        continue
+                    pts = [[ts, v] for ts, v in buf if ts >= horizon]
+                    if pts:
+                        rows.append({"labels": dict(key), "points": pts})
+                if rows:
+                    out[name] = rows
+        return out
+
     def latest_samples(self, family: str,
                        labels: Optional[Dict[str, str]] = None,
                        max_age_s: Optional[float] = None

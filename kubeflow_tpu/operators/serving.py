@@ -27,6 +27,7 @@ splitting default/canary traffic, KPA scaling on concurrency. Here:
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
 import os
 import subprocess
@@ -132,6 +133,7 @@ class _Revision:
         self.backoff_s = 0.0
         self.backoff_until = 0.0
         self.last_crashes = 0
+        self.last_dead: List[tuple] = []  # (pid, port) per reaped corpse
         # Decode-engine load/state projections (autoscaler queue-depth
         # signal, `kfx top`'s KV%/SKIP%/ACC%/Q columns) — refreshed
         # each reconcile from the CENTRAL telemetry store (the one
@@ -323,14 +325,20 @@ class _Revision:
         kfx_replica_restarts_total{reason="crashed"}."""
         alive = []
         crashed = 0
+        dead = []
         for r in self.replicas:
             if r.proc.poll() is None:
                 alive.append(r)
             else:
                 crashed += 1
                 self.restarts += 1
+                dead.append((getattr(r.proc, "pid", 0), r.port))
         self.replicas = alive
         self.last_crashes = crashed
+        # (pid, port) of this reap's corpses — what the controller's
+        # crash-postmortem path matches against the flight-snapshot
+        # files the replicas left in the workdir.
+        self.last_dead = dead
         now = time.monotonic()
         if crashed:
             self.backoff_s = min(max(self.backoff_s * 2, 0.5), 30.0)
@@ -714,6 +722,13 @@ class InferenceServiceController(Controller):
                     isvc, "Warning", "ReplicaCrashed",
                     f"{rev_name}: {rev.last_crashes} replica(s) exited; "
                     f"respawn backoff {rev.backoff_s:.1f}s")
+                # Crash-reap forensics: the corpse can't answer HTTP,
+                # but its /healthz-refreshed flight-snapshot file may
+                # survive in the workdir — bundle that instead.
+                for pid, port in rev.last_dead:
+                    self._capture_postmortem(isvc, rev_name, rev, reg,
+                                             reason="crashed",
+                                             port=port, pid=pid)
             reg.gauge(
                 "kfx_autoscaler_replicas",
                 "Replica processes running per revision (spawned, "
@@ -981,6 +996,13 @@ class InferenceServiceController(Controller):
             if r.live_fails < self.LIVENESS_FAILS:
                 continue
             rev.replicas.remove(r)
+            # Forensics BEFORE the SIGKILL: the wedged loop has stopped
+            # appending, but the replica's HTTP threads still answer —
+            # /debug/flight is exactly the state that would otherwise
+            # die with the process.
+            self._capture_postmortem(isvc, rev_name, rev, reg,
+                                     reason="wedged", port=r.port,
+                                     pid=r.proc.pid)
             if r.proc.poll() is None:
                 r.proc.kill()
             rev.restarts += 1
@@ -991,6 +1013,96 @@ class InferenceServiceController(Controller):
                 f"({json.dumps(body.get('models') or {})}); killed for "
                 "restart")
             self.queue.add(isvc.key)
+
+    def _capture_postmortem(self, isvc: InferenceService, rev_name: str,
+                            rev: _Revision, reg, reason: str,
+                            port: int, pid: Optional[int]) -> None:
+        """Bundle a dying replica's forensic state into
+        ``<rev.workdir>/postmortem/<ts>-<pid>/`` (what `kfx postmortem`
+        lists and renders): the flight ring + recent requests (fetched
+        over HTTP for a wedged-but-answering replica, read from the
+        /healthz-refreshed snapshot file when the corpse already
+        exited), the replica's span JSONL tail, and the central TSDB's
+        window of that replica's scraped series. Records a
+        ``ReplicaPostmortem`` event with the path and counts
+        kfx_postmortems_total{reason}. Best-effort throughout — a
+        failed capture must never block the kill/respawn path."""
+        flight = requests_doc = None
+        if reason == "wedged":
+            for path, into in (("/debug/flight", "flight"),
+                               ("/debug/requests", "requests")):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}{path}",
+                            timeout=2.0) as resp:
+                        doc = json.load(resp)
+                except (OSError, ValueError):
+                    doc = None
+                if into == "flight":
+                    flight = doc
+                else:
+                    requests_doc = doc
+        if flight is None and pid is not None:
+            # The snapshot file the server piggybacks on /healthz —
+            # the only flight source a crashed corpse leaves behind.
+            for snap in sorted(glob.glob(os.path.join(
+                    rev.workdir, "flight", f"*-{pid}.json"))):
+                try:
+                    with open(snap) as f:
+                        flight = json.load(f)
+                    break
+                except (OSError, ValueError):
+                    continue
+        if flight is None:
+            return  # nothing recorded and no corpse file: no bundle
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        bundle = os.path.join(rev.workdir, "postmortem", f"{ts}-{pid}")
+        try:
+            os.makedirs(bundle, exist_ok=True)
+            with open(os.path.join(bundle, "flight.json"), "w") as f:
+                json.dump(flight, f, indent=1)
+            if requests_doc is not None:
+                with open(os.path.join(bundle, "requests.json"),
+                          "w") as f:
+                    json.dump(requests_doc, f, indent=1)
+            # Span tail: the replica's own JSONL sink(s), last 200
+            # records — enough to see the final dispatches without
+            # copying a soak's worth of spans.
+            tail: List[str] = []
+            for sp in sorted(glob.glob(os.path.join(
+                    rev.workdir, "spans", f"*-{pid}.jsonl"))):
+                try:
+                    with open(sp) as f:
+                        tail.extend(f.readlines()[-200:])
+                except OSError:
+                    continue
+            if tail:
+                with open(os.path.join(bundle, "spans.tail.jsonl"),
+                          "w") as f:
+                    f.writelines(tail[-200:])
+            if self.telemetry is not None:
+                window = self.telemetry.window(
+                    {"instance": f"127.0.0.1:{port}"}, since_s=120.0)
+                with open(os.path.join(bundle, "tsdb.json"), "w") as f:
+                    json.dump(window, f)
+            with open(os.path.join(bundle, "meta.json"), "w") as f:
+                json.dump({"reason": reason, "pid": pid, "port": port,
+                           "revision": rev_name,
+                           "namespace": isvc.namespace,
+                           "isvc": isvc.name,
+                           "captured_at": time.time()}, f, indent=1)
+        except OSError:
+            return
+        reg.counter(
+            "kfx_postmortems_total",
+            "Postmortem bundles captured for dying replicas, by "
+            "reason (wedged|crashed).").inc(
+                1, namespace=isvc.namespace, isvc=isvc.name,
+                revision=rev_name, reason=reason)
+        self.record_event(
+            isvc, "Warning", "ReplicaPostmortem",
+            f"{rev_name} replica :{port} ({reason}): flight ring + "
+            f"span tail + tsdb window captured at {bundle}")
 
     def _maybe_kill_replica(self, isvc: InferenceService, rev_name: str,
                             rev: _Revision) -> None:

@@ -178,6 +178,7 @@ for ``EngineOverloaded`` on its own import path.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
@@ -266,7 +267,11 @@ class Request:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
                  "stop", "adapter", "tokens", "rng", "error",
                  "t_enqueue", "t_admitted", "t_done", "counted",
-                 "trace_id", "span_id", "_event")
+                 "trace_id", "span_id", "_event", "rid", "events",
+                 "t_first", "stall_s", "preempts", "spec_prop",
+                 "spec_acc", "_flight")
+
+    _rid_counter = itertools.count(1)
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  top_k: int, seed: int, stop: int, adapter: str = ""):
@@ -299,6 +304,18 @@ class Request:
         # contract MicroBatcher uses for batcher.flush).
         self.trace_id = obs_trace.current_trace_id()
         self.span_id = obs_trace.current_span_id()
+        # Flight-recorder trail: small per-request event list (loop
+        # thread appends) + attribution counters folded into a latency
+        # breakdown at retirement. ``_flight`` is the engine's recorder
+        # (None when recording is disabled — every hook is skipped).
+        self.rid = next(Request._rid_counter)
+        self.events: List[dict] = []
+        self.t_first = 0.0            # first generated token landed
+        self.stall_s = 0.0            # stall seconds while active
+        self.preempts = 0
+        self.spec_prop = 0            # draft tokens proposed for us
+        self.spec_acc = 0             # ...and accepted
+        self._flight = None
         self._event = threading.Event()
 
     def done(self) -> bool:
@@ -307,6 +324,10 @@ class Request:
     def _finish(self, error: Optional[BaseException] = None) -> None:
         self.error = error
         self.t_done = time.monotonic()
+        if self._flight is not None:
+            self._flight.event(self, "retire",
+                               err=type(error).__name__ if error else None)
+            self._flight.retire(self)
         self._event.set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -807,6 +828,18 @@ class DecodeEngine:
         # would read an admitting engine as empty and the operator
         # could kill the replica mid-prefill.
         self._admitting: Optional[Request] = None
+        # Flight recorder: one bounded ring of per-iteration state +
+        # a recent-requests ring (obs/flightrec.py). Constructed before
+        # the loop thread starts so the first iteration can record.
+        # KFX_FLIGHT=0 leaves it None and every hook is skipped.
+        from ..obs import flightrec as _flightrec
+
+        self.flight = _flightrec.FlightRecorder() \
+            if _flightrec.enabled_from_env() else None
+        # Cumulative preemption count (loop thread) — mirrored into
+        # every flight record so a postmortem can see preemption churn
+        # without scraping metrics.
+        self._preempts = 0
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"kfx-engine-{name}")
@@ -1047,6 +1080,11 @@ class DecodeEngine:
                       "window (0 when idle).").set(
                           round(self._spec_accept_rate(), 4),
                           model=self.name)
+        if self.flight is not None:
+            reg.gauge("kfx_lm_flight_ring_records",
+                      "Iteration records currently held in the flight "
+                      "recorder ring (caps at KFX_FLIGHT_RING).").set(
+                          len(self.flight), model=self.name)
 
     def _active_count(self) -> int:
         return sum(1 for r in self._slots if r is not None)
@@ -1127,6 +1165,13 @@ class DecodeEngine:
         inj = chaos.draw("engine.wedge", target=self.name)
         if inj is None:
             return
+        # The stall hits mid-iteration, before the end-of-loop flight
+        # append — record the in-flight iteration first so the ring's
+        # last entry shows what was on the device when the loop hung
+        # (the record a postmortem needs; its ``it`` matches the frozen
+        # heartbeat counter).
+        if self.flight is not None:
+            self._record_flight()
         stall = inj.delay if inj.delay > 0 else 30.0
         deadline = time.monotonic() + stall
         while time.monotonic() < deadline and not self._stopped:
@@ -1878,10 +1923,12 @@ class DecodeEngine:
             raise ValueError(
                 f"unknown adapter {name!r} (configured: "
                 f"{sorted(self._apool.sources) if self._apool else []})")
-        return Request(prompt, int(max_new_tokens), float(temperature),
-                       int(top_k), int(seed),
-                       -1 if stop_token is None else int(stop_token),
-                       adapter=name)
+        req = Request(prompt, int(max_new_tokens), float(temperature),
+                      int(top_k), int(seed),
+                      -1 if stop_token is None else int(stop_token),
+                      adapter=name)
+        req._flight = self.flight
+        return req
 
     def _enqueue(self, reqs: List[Request]) -> None:
         """All-or-nothing enqueue: a batch that does not fit the
@@ -1940,13 +1987,26 @@ class DecodeEngine:
         whole batch (request_timeout_s sits under the router's 60s
         backend timeout — per-request fresh clocks could stack past
         it)."""
+        reqs = self.submit_batch(prompts, max_new_tokens, temperature,
+                                 top_k, seed, stop_token, adapter)
+        deadline = time.monotonic() + self.request_timeout_s
+        return [r.result(max(0.001, deadline - time.monotonic()))
+                for r in reqs]
+
+    def submit_batch(self, prompts: Sequence[Sequence[int]],
+                     max_new_tokens: int = 32, temperature: float = 0.0,
+                     top_k: int = 0, seed: int = 0,
+                     stop_token: Optional[int] = None,
+                     adapter: Optional[str] = None) -> List[Request]:
+        """`generate` minus the blocking wait: one request per prompt
+        (seeded seed+i), enqueued atomically, handles returned — so a
+        caller (the model server's timing block) can read per-request
+        flight state after collecting results."""
         reqs = [self._make_request(p, max_new_tokens, temperature,
                                    top_k, seed + i, stop_token, adapter)
                 for i, p in enumerate(prompts)]
         self._enqueue(reqs)
-        deadline = time.monotonic() + self.request_timeout_s
-        return [r.result(max(0.001, deadline - time.monotonic()))
-                for r in reqs]
+        return reqs
 
     # -- page allocation -----------------------------------------------------
     def _alloc_pages(self, n: int) -> List[int]:
@@ -2042,8 +2102,17 @@ class DecodeEngine:
                             "prefill dispatch, per engine iteration.",
                             buckets=QUEUE_WAIT_BUCKETS).observe(
                                 self._iter_stall, model=self.name)
+                        # Attribute the stall to every active request
+                        # that waited through it — the ``stalled_s``
+                        # leg of the flight-recorder breakdown.
+                        if self.flight is not None:
+                            for slot, r in enumerate(self._slots):
+                                if r is not None and self._active[slot]:
+                                    r.stall_s += self._iter_stall
                     if bool(self._active.any()):
                         self._decode_once()
+                if self.flight is not None:
+                    self._record_flight()
                 # The progress heartbeat: one completed iteration. A
                 # loop stuck inside a dispatch (or the wedge stall
                 # above) never reaches this line, so /healthz sees the
@@ -2055,6 +2124,32 @@ class DecodeEngine:
                 time.sleep(0.01)        # KeyboardInterrupt/SystemExit
                 #                         propagate (they are shutdown,
                 #                         not request failures)
+
+    def _record_flight(self) -> None:
+        """Append this iteration's flight record (loop thread, end of
+        iteration — so a wedge mid-iteration leaves the ring frozen at
+        the last COMPLETED tick, which is what a postmortem reads).
+        Queue depth is read without the lock: a one-record-stale depth
+        is fine for forensics and keeps the hot path lock-free."""
+        active, prefilling = [], []
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            if slot in self._prefilling:
+                prefilling.append((slot, r.rid))
+            elif self._active[slot]:
+                active.append((slot, r.rid))
+        self.flight.record_iteration(
+            iteration=self._iterations,
+            active=active, prefilling=prefilling,
+            pages_free=self._mgr.n_free,
+            draft_pages_free=(self._draft_mgr.n_free
+                              if self._draft_mgr is not None else 0),
+            spec_proposed=self._spec_proposed,
+            spec_accepted=self._spec_accepted,
+            stall_s=self._iter_stall,
+            queue_depth=len(self._queue),
+            preemptions=self._preempts)
 
     def _admit_ready(self) -> None:
         """Admit queued requests into free slots (runs between chunks —
@@ -2368,6 +2463,8 @@ class DecodeEngine:
             return False
         req.counted = True
         req.t_admitted = time.monotonic()
+        if self.flight is not None:
+            self.flight.event(req, "admit", matched=matched, prompt=n)
         wait = req.t_admitted - req.t_enqueue
         self._reg().histogram(
             "kfx_lm_queue_wait_seconds",
@@ -2569,6 +2666,9 @@ class DecodeEngine:
             "kfx_lm_prefill_chunks_total",
             "Prompt-chunk prefill dispatches (chunked admission).").inc(
                 1, model=self.name)
+        if self.flight is not None:
+            self.flight.event(req, "prefill_chunk", start=start,
+                              tokens=length)
         cur["next"] = start + length
         self._register_prefix_pages(slot, cur, final=last)
         if last:
@@ -2742,6 +2842,10 @@ class DecodeEngine:
             "kfx_lm_kv_preemptions_total",
             "Slots preempted (recompute-requeued) on pool exhaustion."
             ).inc(1, model=self.name)
+        self._preempts += 1
+        req.preempts += 1
+        if self.flight is not None:
+            self.flight.event(req, "preempt", slot=slot)
         with self._cond:
             self._queue.push_front(req)
 
@@ -2837,6 +2941,10 @@ class DecodeEngine:
             if len(req.tokens) >= req.max_new:
                 done = True
                 break
+        if landed and req.t_first == 0.0:
+            req.t_first = time.monotonic()
+            if self.flight is not None:
+                self.flight.event(req, "first_token")
         if done:
             self._slots[slot] = None
             self._release_slot(slot)
@@ -2933,6 +3041,10 @@ class DecodeEngine:
             a = int(A[slot])
             if spec_on[slot]:
                 accepted += a
+                # Per-request speculation attribution (spec_accept in
+                # the flight-recorder breakdown).
+                req.spec_prop += k
+                req.spec_acc += a
             toks = [int(t) for t in D[slot, :a]] + [int(bonus[slot])]
             landed = self._emit_host(slot, toks)
             emitted += landed
@@ -3004,6 +3116,10 @@ class DecodeEngine:
             hits = np.flatnonzero(emits[:, slot])
             req.tokens.extend(int(t) for t in toks[hits, slot])
             emitted += len(hits)
+            if len(hits) and req.t_first == 0.0:
+                req.t_first = time.monotonic()
+                if self.flight is not None:
+                    self.flight.event(req, "first_token")
             if not self._active[slot]:
                 self._slots[slot] = None
                 self._release_slot(slot)

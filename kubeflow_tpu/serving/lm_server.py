@@ -362,6 +362,24 @@ class LMPredictor(Predictor):
             return None
         return self._engine.heartbeat()
 
+    def flight_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The /debug/flight payload: the engine's flight ring plus
+        the current heartbeat (None when there is no engine or the
+        recorder is disabled). Reading is safe from any HTTP thread —
+        the ring is a deque the loop appends to atomically, and a
+        wedged loop has stopped appending entirely."""
+        if self._engine is None or self._engine.flight is None:
+            return None
+        return self._engine.flight.snapshot(
+            heartbeat=self._engine.heartbeat())
+
+    def flight_requests(self) -> Optional[Dict[str, Any]]:
+        """The /debug/requests payload: recently retired requests with
+        their latency breakdowns (None when recording is off)."""
+        if self._engine is None or self._engine.flight is None:
+            return None
+        return self._engine.flight.requests()
+
     def drain(self, wait_s: float = 0.0) -> bool:
         """Stop admitting and wait up to ``wait_s`` for in-flight
         generations to finish (serving/engine.py drain contract).
@@ -423,9 +441,17 @@ class LMPredictor(Predictor):
                   top_k=int(body.get("top_k", 0)),
                   seed=int(body.get("seed", 0)))
         t0 = time.perf_counter()
+        reqs = None
         if self._engine is not None:
-            out = self._engine.generate(prompts, stop_token=stop,
-                                        adapter=adapter, **kw)
+            # submit_batch + result instead of generate(): identical
+            # semantics (same atomic enqueue, same batch deadline), but
+            # the Request handles survive for the per-request timing
+            # block the flight recorder computes.
+            reqs = self._engine.submit_batch(prompts, stop_token=stop,
+                                             adapter=adapter, **kw)
+            deadline = time.monotonic() + self._engine.request_timeout_s
+            out = [r.result(max(0.001, deadline - time.monotonic()))
+                   for r in reqs]
         else:
             out = self._gen.generate(prompts, **kw)
         elapsed = time.perf_counter() - t0
@@ -448,5 +474,12 @@ class LMPredictor(Predictor):
             "kfx_lm_generate_seconds",
             "Wall time of generate calls.").observe(elapsed,
                                                     model=self.name)
-        return {"generated_tokens": out,
-                "tokens_per_second": round(tps, 2)}
+        result = {"generated_tokens": out,
+                  "tokens_per_second": round(tps, 2)}
+        if reqs is not None and self._engine.flight is not None:
+            # Per-request latency attribution, one breakdown per
+            # prompt in order — the server also folds the first into
+            # the X-Kfx-Timing response header.
+            flight = self._engine.flight
+            result["timing"] = [flight.timing(r) for r in reqs]
+        return result

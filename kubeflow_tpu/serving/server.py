@@ -400,6 +400,27 @@ class MicroBatcher:
             reply.put(RuntimeError("batcher closed while request queued"))
 
 
+TIMING_HEADER = "X-Kfx-Timing"
+
+
+def _timing_header(result: Dict[str, Any]) -> Optional[Dict[str, str]]:
+    """Fold the first request's latency breakdown into the
+    ``X-Kfx-Timing`` response header (``k=v;...``), so a client — or a
+    curl on the incident bridge — reads where the time went without
+    parsing the body. None when the engine/recorder is off."""
+    timing = result.get("timing") if isinstance(result, dict) else None
+    if not timing:
+        return None
+    first = timing[0]
+    parts = []
+    for key in ("queue_wait_s", "prefill_s", "decode_s", "stalled_s",
+                "spec_accept"):
+        v = first.get(key)
+        if v is not None:
+            parts.append(f"{key}={v:g}")
+    return {TIMING_HEADER: ";".join(parts)} if parts else None
+
+
 class ModelServer:
     """HTTP server hosting one or more predictors (V1 protocol)."""
 
@@ -410,6 +431,9 @@ class ModelServer:
         # false, new predict/generate requests shed with 503 +
         # Retry-After, in-flight work finishes. One-way.
         self.draining = False
+        # Last flight-snapshot-file write (monotonic) — the /healthz
+        # piggyback throttle (_maybe_snapshot_flight).
+        self._flight_snap_ts = 0.0
         # Server-reported latency distribution (so serving_p50_ms is a
         # /metrics fact, not only a bench observation) + request/error
         # counters, all rendered by the registry on /metrics.
@@ -639,7 +663,32 @@ class ModelServer:
         path = h.path
         if path == "/healthz" or path == "/":
             live = self._liveness()
+            # Piggyback the flight-snapshot file on the liveness probe:
+            # the operator polls /healthz every reconcile, so the
+            # on-disk snapshot stays fresh enough to serve as the
+            # postmortem source when a crash leaves no process to ask.
+            self._maybe_snapshot_flight()
             h._send(503 if live["status"] == "wedged" else 200, live)
+        elif path == "/debug/flight":
+            snaps = {name: p.flight_snapshot()
+                     for name, p in self.predictors.items()
+                     if getattr(p, "flight_snapshot", None) is not None}
+            snaps = {k: v for k, v in snaps.items() if v is not None}
+            if not snaps:
+                h._send(404, {"error": "no flight recorder (engine off "
+                                       "or KFX_FLIGHT=0)"})
+            else:
+                h._send(200, {"models": snaps})
+        elif path == "/debug/requests":
+            snaps = {name: p.flight_requests()
+                     for name, p in self.predictors.items()
+                     if getattr(p, "flight_requests", None) is not None}
+            snaps = {k: v for k, v in snaps.items() if v is not None}
+            if not snaps:
+                h._send(404, {"error": "no flight recorder (engine off "
+                                       "or KFX_FLIGHT=0)"})
+            else:
+                h._send(200, {"models": snaps})
         elif path == "/metrics" or path.startswith("/metrics?"):
             # Prometheus exposition by default (the reference model
             # servers are Prometheus-scrapable); JSON via ?format=json.
@@ -809,7 +858,49 @@ class ModelServer:
         except Exception as e:
             h._send(500, {"error": str(e)})
             return
-        h._send(200, result)
+        h._send(200, result, extra_headers=_timing_header(result))
+
+    # -- flight recorder ----------------------------------------------------
+    def _maybe_snapshot_flight(self) -> None:
+        """Persist the newest flight snapshot to
+        ``$KFX_WORKDIR/flight/<KFX_COMPONENT>-<pid>.json`` (atomic
+        replace), throttled to once per KFX_FLIGHT_SNAP_S (default 1s;
+        "0" disables). The file is what the operator's crash-reap path
+        bundles when the replica died without answering HTTP — the
+        liveness probe hitting /healthz every reconcile keeps it
+        fresh."""
+        workdir = os.environ.get("KFX_WORKDIR", "")
+        if not workdir:
+            return
+        try:
+            period = float(os.environ.get("KFX_FLIGHT_SNAP_S", "1"))
+        except ValueError:
+            period = 1.0
+        if period <= 0:
+            return
+        now = time.monotonic()
+        if now - self._flight_snap_ts < period:
+            return
+        self._flight_snap_ts = now
+        snaps = {}
+        for name, p in self.predictors.items():
+            fn = getattr(p, "flight_snapshot", None)
+            snap = fn() if fn is not None else None
+            if snap is not None:
+                snaps[name] = snap
+        if not snaps:
+            return
+        comp = os.environ.get("KFX_COMPONENT", "server")
+        d = os.path.join(workdir, "flight")
+        path = os.path.join(d, f"{comp}-{os.getpid()}.json")
+        try:
+            os.makedirs(d, exist_ok=True)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"models": snaps, "pid": os.getpid()}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # snapshotting must never fail the probe
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ModelServer":

@@ -9,7 +9,7 @@ Prometheus scrape — or a `kfx trace` reconstruction — would drop it.
 
 Usage:
     python scripts/scrape_metrics.py [URL ...] [--spans PATH ...] \
-        [--require FAMILY ...] [--inventory]
+        [--require FAMILY ...] [--inventory] [--chaos-inventory]
 
 With no URLs and no --spans, the control plane advertised by the
 current kfx home's server marker (``kfx server``) is scraped. A URL
@@ -28,6 +28,11 @@ exists in code but not in the docs FAILS, so new instrumentation
 cannot land undocumented (a tier-1 test runs exactly this check). A
 documented family no longer found in code is only warned — prose may
 legitimately describe derived series.
+
+``--chaos-inventory`` applies the same gate to fault-injection sites:
+every point in ``chaos.KNOWN_POINTS`` must have a catalog row in
+docs/chaos.md (backticked ``component.site`` first column), so new
+chaos points cannot land undocumented either.
 """
 
 import os
@@ -262,6 +267,48 @@ def check_inventory(pkg_root: str = None, doc_path: str = None) -> int:
     return len(missing)
 
 
+def documented_chaos_points(doc_path: str) -> set:
+    """Chaos-point names documented in docs/chaos.md: backticked
+    ``component.site`` tokens in a table row's FIRST column (every real
+    point carries a dot, which keeps the spec-knob table's `p`/`count`
+    rows and prose mentions of functions out)."""
+    import re
+
+    with open(doc_path) as f:
+        text = f.read()
+    out = set()
+    for line in text.splitlines():
+        m = re.match(r"\|\s*`([a-z_]+\.[a-z_]+)`\s*\|", line)
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def check_chaos_inventory(points=None, doc_path: str = None) -> int:
+    """The --chaos-inventory verdict, mirroring check_inventory: a
+    point registered in chaos.KNOWN_POINTS but absent from the
+    docs/chaos.md catalog FAILS (new fault sites cannot land
+    undocumented); a documented point no longer in code only warns."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if points is None:
+        from kubeflow_tpu.chaos import KNOWN_POINTS
+        points = KNOWN_POINTS
+    doc_path = doc_path or os.path.join(repo, "docs", "chaos.md")
+    docs = documented_chaos_points(doc_path)
+    missing = sorted(p for p in points if p not in docs)
+    unknown = sorted(d for d in docs if d not in points)
+    for name in missing:
+        print(f"FAIL chaos-inventory: {name} is in chaos.KNOWN_POINTS "
+              f"but has no catalog row in {os.path.basename(doc_path)}")
+    for name in unknown:
+        print(f"warn chaos-inventory: {name} documented but not in "
+              f"chaos.KNOWN_POINTS")
+    if not missing:
+        print(f"ok   chaos-inventory: {len(points)} known points all "
+              f"documented ({len(docs)} documented total)")
+    return len(missing)
+
+
 def default_urls() -> list:
     """The apiserver advertised by this home's server marker, if any."""
     from kubeflow_tpu.apiserver import live_server_url
@@ -275,10 +322,14 @@ def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     urls, span_paths, required = [], [], []
     inventory = False
+    chaos_inventory = False
     i = 0
     while i < len(args):
         if args[i] == "--inventory":
             inventory = True
+            i += 1
+        elif args[i] == "--chaos-inventory":
+            chaos_inventory = True
             i += 1
         elif args[i] == "--spans":
             if i + 1 >= len(args):
@@ -300,7 +351,8 @@ def main(argv=None) -> int:
     # A pure --inventory run is a static source/docs check and needs no
     # endpoint — but --require always needs one, so the default server
     # discovery still applies when families are demanded.
-    if not urls and not span_paths and (required or not inventory):
+    if not urls and not span_paths and \
+            (required or not (inventory or chaos_inventory)):
         urls = default_urls()
         if not urls:
             print("no URLs given and no live `kfx server` marker found "
@@ -312,6 +364,8 @@ def main(argv=None) -> int:
     failures += sum(check_span_log(p) for p in span_paths)
     if inventory:
         failures += check_inventory()
+    if chaos_inventory:
+        failures += check_chaos_inventory()
     for family in required:
         if family in seen:
             print(f"ok   required family {family} present")

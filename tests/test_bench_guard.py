@@ -170,7 +170,8 @@ class TestBoxGuard:
         for key in ("obs_scrape_ms", "obs_rule_eval_ms",
                     "obs_tsdb_window_samples",
                     "obs_engine_tokens_per_s",
-                    "obs_engine_tokens_delta_frac"):
+                    "obs_engine_tokens_delta_frac",
+                    "obs_flightrec_tokens_delta_frac"):
             assert key in bench.CONTRACT_KEYS, key
 
     def test_own_descendants_are_not_strays(self):

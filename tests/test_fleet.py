@@ -748,7 +748,8 @@ def _replica_ports(home):
 
 
 class TestFleetSelfHealingE2E:
-    def test_kill_drain_wedge(self, lm_export, tmp_path, monkeypatch):
+    def test_kill_drain_wedge(self, lm_export, tmp_path, monkeypatch,
+                              capsys):
         """The acceptance e2e, three legs on one 2-replica LM isvc:
 
         1. replica.kill SIGKILLs the replica holding an in-flight
@@ -935,6 +936,40 @@ class TestFleetSelfHealingE2E:
             reasons = [e.reason for e in cp.store.events_for(
                 "InferenceService", "default/fleet")]
             assert "ReplicaWedged" in reasons
+
+            # ---- leg 3b: postmortem bundle for the wedged kill ------
+            # The liveness kill captured a bundle BEFORE the SIGKILL:
+            # the flight ring inside is frozen at the stalled
+            # iteration, with the wedged request's slot on the last
+            # record and the heartbeat that condemned the replica.
+            assert "ReplicaPostmortem" in reasons
+            bundles = sorted(glob.glob(os.path.join(
+                home, "serving", "*", "postmortem", "*")))
+            assert bundles, "no postmortem bundle on disk"
+            with open(os.path.join(bundles[-1], "meta.json")) as f:
+                meta = json.load(f)
+            assert meta["reason"] == "wedged"
+            assert meta["isvc"] == "fleet"
+            with open(os.path.join(bundles[-1], "flight.json")) as f:
+                flight_doc = json.load(f)
+            snap = next(iter(flight_doc["models"].values()))
+            recs = snap["records"]
+            hb = snap.get("heartbeat") or {}
+            assert recs, "bundled flight ring is empty"
+            assert hb.get("wedged") is True
+            assert recs[-1]["it"] == hb["iterations"]
+            assert recs[-1]["active"] or recs[-1]["prefilling"]
+            assert sum(int(v) for labels, v in cp.metrics.counter(
+                "kfx_postmortems_total").samples()
+                if labels.get("reason") == "wedged") >= 1
+            # `kfx postmortem fleet` lists the bundle and renders the
+            # ring with the stalled iteration marked.
+            from kubeflow_tpu.cli import KfxCLI
+            capsys.readouterr()
+            assert KfxCLI(cp).postmortem("fleet", "default") == 0
+            rendered = capsys.readouterr().out
+            assert "wedged" in rendered
+            assert "<== WEDGED after this iteration" in rendered
 
             # ---- observability: span + scrape -----------------------
             span_names = set()
