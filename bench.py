@@ -61,6 +61,9 @@ CONTRACT_KEYS = (
     "lm_adapters_n", "lm_adapters_tokens_per_s",
     "lm_adapters_base_tokens_per_s", "lm_adapters_hbm_mb",
     "lm_adapters_hbm_ratio", "lm_adapters_sep_engines_hbm_ratio",
+    "lm_qos_interactive_itl_p99_ms", "lm_qos_interactive_itl_p99_flood_ms",
+    "lm_qos_flood_ratio", "lm_qos_batch_served",
+    "lm_qos_deadline_shed", "lm_qos_deadline_timeouts",
     "serving_scale_p50_ms", "serving_scale_p99_ms",
     "serving_scale_success_rate", "serving_scale_max_replicas",
     "serving_scale_cold_start_ms", "serving_scale_rolled_back",
@@ -519,6 +522,15 @@ def main() -> int:
         # the measured-HBM ratio: one base + stacks vs ~8 bases.
         guard.section("lm_adapters")
         lm.update(_bench_lm_adapters())
+    if have_time(240, "lm_qos"):
+        # Request plane under class pressure (serving/engine.py QoS +
+        # deadline admission): interactive p99 ITL with a concurrent
+        # batch flood vs without (bar: <= 1.5x — FairQueue admits
+        # interactive first, batch is the preemption victim), plus the
+        # deadline burst — infeasible requests shed BEFORE prefill,
+        # zero post-prefill deadline timeouts.
+        guard.section("lm_qos")
+        lm.update(_bench_lm_qos())
     lm.update(guard.finish())
     if skipped:
         # A missing metric key must read as "budget cut this section",
@@ -1235,6 +1247,189 @@ def _mixed_itl_leg(prefix: str, short_new: int = 96,
         prefix + "itl_improvement":
             round(p99_off / p99_on, 2) if p99_on > 0 else 0.0,
     }
+
+
+def _bench_lm_qos(prefix: str = "lm_qos_") -> dict:
+    """Mixed-class request plane (serving/engine.py QoS classes +
+    deadline-aware admission), one engine, three phases.
+
+    Quiet: two interactive clients decode alone; inter-token gaps
+    stamped at the engine's on_token streaming sink -> the no-flood
+    p99 ITL. Flood: the same two interactive clients while feeders
+    keep a batch-class backlog saturating the remaining slots —
+    FairQueue admits interactive first and batch slots are the
+    preemption victims, so the acceptance bar is flood p99 <= 1.5x
+    quiet (phase p99s are medians over three interleaved reps). Deadline: with the
+    slots pinned by batch work and the queue-wait EWMA warm, a burst
+    of 5ms-deadline requests must shed BEFORE prefill
+    (DeadlineInfeasible at submit or while queued) — shed > 0 and
+    ZERO post-prefill deadline timeouts is the contract."""
+    import threading
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.generate import pow2_bucket
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from kubeflow_tpu.serving.engine import (DeadlineInfeasible,
+                                             DecodeEngine)
+
+    cfg = TransformerConfig(vocab_size=512, d_model=512, n_heads=4,
+                            head_dim=128, n_layers=4, d_ff=2048,
+                            max_seq_len=512, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(11)
+    inter = [list(rng.integers(0, cfg.vocab_size, 16))
+             for _ in range(2)]
+    # The flood is LONG-RUNNING batch requests (that is what the batch
+    # class is for): on a serial device every admission prefill runs
+    # at decode-step cost no matter how it is chunked, so the way to
+    # protect interactive p99 is to bound the RATE of head-of-line
+    # events below 1% of gap samples — long batch decodes mean ~2
+    # admissions per measurement window, and p99 (an order statistic
+    # over ~510 gaps) sits on ordinary decode cadence, not on the
+    # admission stalls. UNIQUE prompt per submission: repeated prompts
+    # would hit the prefix cache and turn every admission into a COW
+    # boundary-page clone whose compiled-copy cost lands in the
+    # interactive gap; a real batch flood is distinct requests.
+    batch_prompts = [list(rng.integers(0, cfg.vocab_size, 32))
+                     for _ in range(64)]
+    eng = DecodeEngine(cfg, params, n_slots=4, chunk_tokens=1,
+                       name="qos", kv_page_size=16,
+                       request_timeout_s=600.0)
+    try:
+        eng.warm([pow2_bucket(16, 512), pow2_bucket(32, 512)])
+        eng.generate([inter[0]], max_new_tokens=4)  # warm path
+
+        def itl_p99(flood: bool) -> float:
+            stop = threading.Event()
+            served = [0]
+
+            handles = []
+
+            def feeder(fid: int):
+                # Staggered decode lengths per feeder: three feeders
+                # finishing (and re-admitting) in the same iteration
+                # would stack admission work into one gap sample.
+                while not stop.is_set():
+                    try:
+                        r = eng.submit(
+                            batch_prompts[served[0] % len(batch_prompts)],
+                            max_new_tokens=256 + 16 * fid, qos="batch")
+                        handles.append(r)
+                        served[0] += 1
+                        while not r.done() and not stop.is_set():
+                            time.sleep(0.01)
+                    except Exception:
+                        time.sleep(0.05)
+
+            feeders = []
+            if flood:
+                feeders = [threading.Thread(target=feeder, args=(fid,),
+                                            daemon=True)
+                           for fid in range(3)]
+                for f in feeders:
+                    f.start()
+                time.sleep(0.5)  # backlog established
+            # ITL is stamped at the engine's on_token streaming sink —
+            # the same loop-thread callback the SSE path serializes
+            # from, so each gap is the wire cadence an end client
+            # would see. (A host-side polling sampler measured its OWN
+            # GIL-scheduling jitter under the flood's extra threads,
+            # not the engine's.) 2 x 256 tokens -> ~510 gap samples:
+            # p99 sits at the ~6th-largest gap, not the max.
+            stamps = [[] for _ in inter]
+
+            def sink(i):
+                def cb(tok):
+                    if tok is not None:
+                        stamps[i].append(time.perf_counter())
+                return cb
+
+            reqs = [eng.submit(p, max_new_tokens=256,
+                               qos="interactive", on_token=sink(i))
+                    for i, p in enumerate(inter)]
+            for r in reqs:
+                r.result(240)
+            stop.set()
+            for f in feeders:
+                f.join(30)
+            # Drain: in-flight batch decodes outlive the feeders (up
+            # to ~256 tokens) and would pollute the NEXT quiet phase.
+            for r in handles:
+                try:
+                    r.result(240)
+                except Exception:
+                    pass
+            gaps = [b - a for ts in stamps
+                    for a, b in zip(ts, ts[1:])]
+            p99 = float(np.percentile(gaps, 99)) if gaps else 0.0
+            return p99, served[0]
+
+        # Interleaved quiet/flood phase pairs, MEDIAN p99 per phase:
+        # both sides of the ratio carry +/-30% single-rep jitter on a
+        # shared-CPU host (one scheduler hiccup lands in the p99 of a
+        # ~510-gap sample), and the bar is a RATIO — medians over
+        # three interleaved reps keep one bad scheduling window on
+        # either side from deciding it.
+        quiets, floods = [], []
+        flood_served = 0
+        for _rep in range(3):
+            q, _ = itl_p99(flood=False)
+            f, s = itl_p99(flood=True)
+            quiets.append(q)
+            floods.append(f)
+            flood_served += s
+        p99_quiet = float(np.median(quiets))
+        p99_flood = float(np.median(floods))
+
+        # Deadline phase: pin every slot with long batch decodes so
+        # the queue is non-empty, then burst infeasible 5ms-deadline
+        # requests at the full queue.
+        pinned = [eng.submit(p, max_new_tokens=96, qos="batch")
+                  for p in batch_prompts[:4]]
+        shed = timeouts = 0
+        probes = []
+        for _ in range(8):
+            try:
+                probes.append(eng.submit(inter[0], max_new_tokens=8,
+                                         deadline_s=0.005))
+            except DeadlineInfeasible:
+                shed += 1
+        for r in probes:
+            try:
+                r.result(30)
+            except DeadlineInfeasible:
+                shed += 1  # expired while queued — still pre-prefill
+            except TimeoutError:
+                timeouts += 1  # burned a prefill, then died: the bug
+        for r in pinned:
+            r.result(120)
+        return {
+            prefix + "interactive_itl_p99_ms":
+                round(p99_quiet * 1000, 1),
+            prefix + "interactive_itl_p99_flood_ms":
+                round(p99_flood * 1000, 1),
+            # Acceptance bar: <= 1.5 (interactive stays flat under a
+            # batch flood).
+            prefix + "flood_ratio":
+                round(p99_flood / p99_quiet, 2) if p99_quiet > 0
+                else 0.0,
+            # Batch requests ADMITTED during the flood (class tiering
+            # degrades batch, never starves it) + the pinned deadline
+            # phase's four.
+            prefix + "batch_served": flood_served + len(pinned),
+            prefix + "deadline_shed": shed,
+            prefix + "deadline_timeouts": timeouts,
+        }
+    except Exception as e:  # secondary metric must not sink the bench
+        return {prefix + "error": str(e)[:200]}
+    finally:
+        eng.close()
 
 
 def _mixed_fleet_leg(prefix: str, n_prompts: int = 4,

@@ -332,6 +332,43 @@ class InferenceService(Resource):
                             raise ValidationError(
                                 f"spec.{rev}.quantization.{field}",
                                 "must be 'int8' or 'f32'")
+                # Request plane (docs/serving.md): per-revision QoS
+                # default, admission deadline default, and per-tenant
+                # token rate limits.
+                qd = spec.get("qosDefault")
+                if qd is not None and qd not in ("interactive",
+                                                 "batch"):
+                    raise ValidationError(
+                        f"spec.{rev}.qosDefault",
+                        "must be 'interactive' or 'batch'")
+                dm = spec.get("deadlineMs")
+                if dm is not None:
+                    try:
+                        ok = float(dm) > 0 and not isinstance(dm, bool)
+                    except (TypeError, ValueError):
+                        ok = False
+                    if not ok:
+                        raise ValidationError(
+                            f"spec.{rev}.deadlineMs",
+                            "must be a number > 0 (milliseconds)")
+                rl = spec.get("rateLimits")
+                if rl is not None:
+                    if not isinstance(rl, dict) or not rl:
+                        raise ValidationError(
+                            f"spec.{rev}.rateLimits",
+                            "must be a non-empty object "
+                            "{tenant: tokens per second}")
+                    for tenant, rate in rl.items():
+                        try:
+                            ok = (not isinstance(rate, bool)
+                                  and float(rate) > 0)
+                        except (TypeError, ValueError):
+                            ok = False
+                        if not str(tenant) or not ok:
+                            raise ValidationError(
+                                f"spec.{rev}.rateLimits[{tenant!r}]",
+                                "must be a number > 0 "
+                                "(tokens per second)")
         tr = self.spec.get("transformer")
         if tr is not None and not tr.get("module"):
             raise ValidationError(

@@ -32,6 +32,9 @@ tested property: sites across the stack declare *fault points* —
                         slots active (liveness)
     replica.kill        SIGKILL a serving replica   (operators/serving.py)
                         mid-request
+    router.stream_cut   sever an in-flight SSE      (serving/router.py)
+                        token stream after >=1
+                        relayed token
 
 — and a *plan* decides, deterministically, which evaluations inject.
 
@@ -97,7 +100,7 @@ KNOWN_POINTS = frozenset({
     "serving.request", "serving.predict", "engine.admit",
     "engine.kv_alloc", "engine.spec_verify", "engine.kv_quant",
     "engine.adapter_load", "engine.wedge", "replica.kill",
-    "router.affinity",
+    "router.affinity", "router.stream_cut",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
 })

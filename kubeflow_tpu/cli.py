@@ -603,7 +603,8 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
     "w8"/"kv8"/"w8+kv8"/"d8"/"f32"; paged LM revisions — "-" for
     classifiers and engines with the signal absent), the adapter-slot
     pool as "pinned/total" (ADPT column — multi-tenant LoRA revisions
-    only), cumulative
+    only), the in-flight QoS-class split as "interactive/batch" (I/B
+    column — request plane, LM revisions only), cumulative
     replica restarts (crashes + liveness wedge-kills, the
     kfx_replica_restarts_total number), window-rate TOK/S + RPS
     columns, plus the canary traffic split.
@@ -629,6 +630,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
             acc = a.get("specAcceptRate")
             skip = a.get("prefillSkip")
             adpt = a.get("adapters")  # "pinned/total" or absent
+            classes = a.get("classes")  # "interactive/batch" or absent
             tok_s = rps = None
             if rates_fn is not None:
                 tok_s, rps, window_skip = rates_fn(
@@ -645,6 +647,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
                 f"{acc * 100:.0f}%" if acc is not None else "-",
                 str(a.get("quant") or "-"),
                 str(adpt) if adpt else "-",
+                str(classes) if classes else "-",
                 str(a["restarts"]) if a.get("restarts") is not None
                 else "-",
                 f"{tok_s:.1f}" if tok_s is not None else "-",
@@ -659,7 +662,7 @@ def _print_serving_top(rows: List[List[str]]) -> None:
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
                         "DESIRED", "TARGET", "KV%", "SKIP%", "ACC%",
-                        "Q", "ADPT", "RESTARTS", "TOK/S", "RPS",
+                        "Q", "ADPT", "I/B", "RESTARTS", "TOK/S", "RPS",
                         "CANARY%"])
 
 

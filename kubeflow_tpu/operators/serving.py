@@ -92,7 +92,10 @@ class _Revision:
                  speculative: Optional[dict] = None,
                  quantization: Optional[dict] = None,
                  prefill_chunk: Optional[int] = None,
-                 adapters: Optional[dict] = None):
+                 adapters: Optional[dict] = None,
+                 qos_default: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 rate_limits: Optional[dict] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -118,6 +121,13 @@ class _Revision:
         # knobs the LMPredictor reads at load; classifier frameworks
         # ignore them.
         self.adapters = adapters
+        # Request plane (spec.<rev>.qosDefault / deadlineMs /
+        # rateLimits, api/serving.py) — exported as KFX_LM_QOS_DEFAULT
+        # / KFX_LM_DEADLINE_MS / KFX_LM_RATE_LIMITS; None leaves the
+        # predictor's defaults (interactive, no deadline, no limits).
+        self.qos_default = qos_default
+        self.deadline_ms = deadline_ms
+        self.rate_limits = rate_limits
         # KFServing custom-predictor parity: a user-provided container
         # command serves the port instead of a framework server. The
         # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
@@ -155,6 +165,11 @@ class _Revision:
         # cache under the router's prefix-affinity map).
         self.engine_prefix_reused = 0.0
         self.engine_prompt_tokens = 0.0
+        # Per-QoS-class in-flight slot split (request plane) — `kfx
+        # top`'s I/B column; None on classifier revisions (no
+        # kfx_lm_class_active series at all).
+        self.engine_active_interactive: Optional[float] = None
+        self.engine_active_batch: Optional[float] = None
 
     @property
     def engine_kv_util(self):
@@ -237,6 +252,7 @@ class _Revision:
         self._quant_env(env)
         self._prefill_env(env)
         self._adapter_env(env)
+        self._request_plane_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
@@ -285,6 +301,21 @@ class _Revision:
             env["KFX_LM_ADAPTER_RANK"] = str(int(ad["rank"]))
         if ad.get("fallback") is not None:
             env["KFX_LM_ADAPTER_FALLBACK"] = str(ad["fallback"])
+
+    def _request_plane_env(self, env: dict) -> None:
+        """spec.<rev>.qosDefault / deadlineMs / rateLimits -> the
+        LMPredictor's request-plane knobs (QoS class default, the
+        deadline-aware admission default, per-tenant token rate
+        limits). Only explicit fields export — the predictor owns the
+        defaults; classifier frameworks ignore them."""
+        if self.role != "predictor":
+            return
+        if self.qos_default is not None:
+            env["KFX_LM_QOS_DEFAULT"] = str(self.qos_default)
+        if self.deadline_ms is not None:
+            env["KFX_LM_DEADLINE_MS"] = str(float(self.deadline_ms))
+        if self.rate_limits is not None:
+            env["KFX_LM_RATE_LIMITS"] = json.dumps(self.rate_limits)
 
     def _quant_env(self, env: dict) -> None:
         """spec.<rev>.quantization -> the LMPredictor's quantization
@@ -572,13 +603,19 @@ class InferenceServiceController(Controller):
             quantization = spec.get("quantization")
             prefill_chunk = spec.get("prefillChunkTokens")
             adapters = spec.get("adapters")
+            qos_default = spec.get("qosDefault")
+            deadline_ms = spec.get("deadlineMs")
+            rate_limits = spec.get("rateLimits")
             if rev is None or rev.model_dir != model_dir \
                     or rev.device != device or rev.batcher != batcher \
                     or rev.container != container \
                     or rev.speculative != speculative \
                     or rev.quantization != quantization \
                     or rev.prefill_chunk != prefill_chunk \
-                    or rev.adapters != adapters:
+                    or rev.adapters != adapters \
+                    or rev.qos_default != qos_default \
+                    or rev.deadline_ms != deadline_ms \
+                    or rev.rate_limits != rate_limits:
                 if rev is not None:
                     # Revision respawn (model/device/batcher/spec-env
                     # change): drop the doomed replicas from the router
@@ -602,6 +639,9 @@ class InferenceServiceController(Controller):
                     quantization=quantization,
                     prefill_chunk=prefill_chunk,
                     adapters=adapters,
+                    qos_default=qos_default,
+                    deadline_ms=deadline_ms,
+                    rate_limits=rate_limits,
                 )
                 # The restart tally is cumulative per revision NAME
                 # (matching kfx_replica_restarts_total's label): a
@@ -949,6 +989,13 @@ class InferenceServiceController(Controller):
                               - rev.engine_adapter_free))
             status["adapters"] = \
                 f"{used}/{int(rev.engine_adapter_slots)}"
+        if rev.engine_active_interactive is not None:
+            # In-flight slot split "interactive/batch" (request-plane
+            # QoS classes) — `kfx top`'s I/B column; absent on
+            # classifier revisions.
+            status["classes"] = (
+                f"{int(rev.engine_active_interactive)}/"
+                f"{int(rev.engine_active_batch or 0)}")
         rt.autoscaling_status[rev_name] = status
         return decision.desired
 
@@ -1237,6 +1284,24 @@ class InferenceServiceController(Controller):
         rev.engine_prompt_tokens = total("kfx_lm_prompt_tokens_admitted")
         rev.engine_adapter_slots = total("kfx_lm_adapter_slots")
         rev.engine_adapter_free = total("kfx_lm_adapter_slots_free")
+        # Per-QoS-class in-flight split (`kfx top`'s I/B column): the
+        # qos label rides the one family, so split by label value.
+        # The engine exports both classes even at zero, so ANY sample
+        # means "this revision has a request plane" (classifier
+        # revisions have none and keep the None -> no I/B column).
+        class_samples = t.latest_samples("kfx_lm_class_active", sel,
+                                         max_age_s=fresh_s)
+        if class_samples:
+            by_class = {"interactive": 0.0, "batch": 0.0}
+            for lab, v in class_samples:
+                q = lab.get("qos", "")
+                if q in by_class:
+                    by_class[q] += v
+            rev.engine_active_interactive = by_class["interactive"]
+            rev.engine_active_batch = by_class["batch"]
+        else:
+            rev.engine_active_interactive = None
+            rev.engine_active_batch = None
         rates = [v for _, v in
                  t.latest_samples("kfx_lm_spec_accept_rate", sel,
                                   max_age_s=fresh_s)]

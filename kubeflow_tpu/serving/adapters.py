@@ -188,28 +188,19 @@ def random_lora_flat(cfg, rank: int, seed: int = 0,
     return out
 
 
-class FairQueue:
-    """Per-tenant FIFO queues with weighted round-robin pop. The
-    tenant key is the request's adapter name ("" = base traffic). A
-    burst from one tenant fills ITS queue; the rotation serves up to
-    ``weights[tenant]`` (default 1) requests per visit before moving
-    on, so a trickling tenant's next request is at most one rotation
-    away instead of behind the whole burst. ``push_front`` is the
-    recompute-continuation lane (preempt requeues): absolute priority,
-    preserving the engine's oldest-first progress guarantee. Not
-    thread-safe — the engine serializes access under its condition
-    lock, exactly as it did the plain deque."""
+class _WRRBand:
+    """One weighted-round-robin rotation over per-tenant FIFO queues
+    (the FairQueue building block; a FairQueue holds one band per QoS
+    class). The rotation serves up to ``weights[tenant]`` (default 1)
+    requests per visit before moving on, so a trickling tenant's next
+    request is at most one rotation away instead of behind another
+    tenant's whole burst."""
 
-    def __init__(self, weights: Optional[Dict[str, int]] = None):
+    def __init__(self, weights: Dict[str, int]):
         self._qs: "OrderedDict[str, deque]" = OrderedDict()
-        self._weights = dict(weights or {})
-        self._front: deque = deque()
+        self._weights = weights
         self._rr: deque = deque()   # tenant rotation
         self._credit = 0
-        self._len = 0
-
-    def __len__(self) -> int:
-        return self._len
 
     def push(self, req) -> None:
         tenant = getattr(req, "adapter", "") or ""
@@ -218,19 +209,8 @@ class FairQueue:
             q = self._qs[tenant] = deque()
             self._rr.append(tenant)
         q.append(req)
-        self._len += 1
-
-    def push_front(self, req) -> None:
-        self._front.appendleft(req)
-        self._len += 1
 
     def pop(self):
-        """Next request by WRR (None when empty). The front lane
-        (requeued preempts) always wins — recompute continuations are
-        in-flight work, not new admissions."""
-        if self._front:
-            self._len -= 1
-            return self._front.popleft()
         for _ in range(len(self._rr)):
             tenant = self._rr[0]
             q = self._qs.get(tenant)
@@ -241,7 +221,6 @@ class FairQueue:
             if self._credit <= 0:
                 self._credit = max(1, int(self._weights.get(tenant, 1)))
             self._credit -= 1
-            self._len -= 1
             req = q.popleft()
             if self._credit <= 0 or not q:
                 self._rr.rotate(-1)
@@ -249,16 +228,94 @@ class FairQueue:
             return req
         return None
 
+    def shed_newest(self):
+        """Remove and return the NEWEST queued request (None when
+        empty): sheds cost the least-progressed work, so the oldest
+        queued requests keep their place."""
+        victim, vq = None, None
+        for q in self._qs.values():
+            if q and (victim is None
+                      or q[-1].t_enqueue > victim.t_enqueue):
+                victim, vq = q[-1], q
+        if vq is not None:
+            vq.pop()
+        return victim
+
+    def drain(self) -> List[Any]:
+        out: List[Any] = []
+        for q in self._qs.values():
+            out.extend(q)
+            q.clear()
+        self._credit = 0
+        return out
+
+
+class FairQueue:
+    """Per-tenant FIFO queues with weighted round-robin pop, split
+    into QoS class bands. The tenant key is the request's adapter
+    name ("" = base traffic); the band is the request's ``qos`` class.
+    Pop order: the ``push_front`` recompute-continuation lane (preempt
+    requeues — absolute priority, preserving the engine's oldest-first
+    progress guarantee), then the ``interactive`` band's WRR rotation,
+    then ``batch`` — a batch flood queues strictly behind interactive
+    traffic, and ``shed_batch`` makes batch the first class shed under
+    pool pressure. Not thread-safe — the engine serializes access
+    under its condition lock, exactly as it did the plain deque."""
+
+    def __init__(self, weights: Optional[Dict[str, int]] = None):
+        self._weights = dict(weights or {})
+        self._front: deque = deque()
+        self._bands = {"interactive": _WRRBand(self._weights),
+                       "batch": _WRRBand(self._weights)}
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def push(self, req) -> None:
+        cls = getattr(req, "qos", "") or "interactive"
+        self._bands.get(cls, self._bands["interactive"]).push(req)
+        self._len += 1
+
+    def push_front(self, req) -> None:
+        self._front.appendleft(req)
+        self._len += 1
+
+    def pop(self):
+        """Next request (None when empty): front lane, then
+        interactive WRR, then batch WRR."""
+        if self._front:
+            self._len -= 1
+            return self._front.popleft()
+        for cls in ("interactive", "batch"):
+            req = self._bands[cls].pop()
+            if req is not None:
+                self._len -= 1
+                return req
+        return None
+
+    def shed_batch(self, n: int) -> List[Any]:
+        """Remove up to ``n`` queued BATCH-class requests (newest
+        first) to make room under queue pressure; the caller fails
+        them with the shed-load contract. Never touches interactive
+        requests or the recompute front lane."""
+        out = []
+        while len(out) < n:
+            victim = self._bands["batch"].shed_newest()
+            if victim is None:
+                break
+            self._len -= 1
+            out.append(victim)
+        return out
+
     def drain_all(self) -> List[Any]:
         """Every queued request (front lane first), clearing the
         queue — the drain()/close() bulk-fail path."""
         out = list(self._front)
         self._front.clear()
-        for q in self._qs.values():
-            out.extend(q)
-            q.clear()
+        out.extend(self._bands["interactive"].drain())
+        out.extend(self._bands["batch"].drain())
         self._len = 0
-        self._credit = 0
         return out
 
 

@@ -216,6 +216,12 @@ def quant_mode_string(weights: str, kv: str) -> str:
     return "+".join(parts) or "f32"
 
 
+# Request QoS classes (docs/serving.md "Request plane"): interactive
+# traffic is served first and preempted last; batch is the first
+# preemption victim and the first class shed under pool pressure.
+QOS_CLASSES = frozenset({"interactive", "batch"})
+
+
 class EngineOverloaded(RuntimeError):
     """Admission queue full — the bounded-queueing replacement for the
     old hard ``max_batch_size`` rejection. The server maps this to
@@ -257,6 +263,32 @@ class AdapterLoadError(EngineOverloaded):
     503 + Retry-After and the router re-dispatches."""
 
 
+class DeadlineInfeasible(EngineOverloaded):
+    """The request's deadline cannot be met — judged BEFORE prefill
+    (at enqueue against the trailing queue-wait estimate, or at the
+    slot boundary when the deadline has already expired), so an
+    infeasible request sheds immediately instead of burning a prefill
+    and timing out after. Subclasses EngineOverloaded: the 503 +
+    Retry-After shed contract applies, and a client with deadline
+    headroom left can retry another replica."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class RateLimited(EngineOverloaded):
+    """A tenant exhausted its token-weighted rate budget
+    (``rate_limits``, tokens/second of prompt+max_new weight with a
+    ``rate_burst_s`` burst allowance): the burst degrades to the
+    TENANT's budget, never the fleet's. Subclasses EngineOverloaded —
+    503 with a Retry-After derived from the budget deficit."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class Request:
     """One in-flight generation: token budget, sampling knobs, and a
     completion event the submitting thread waits on. ``tokens`` doubles
@@ -269,12 +301,14 @@ class Request:
                  "t_enqueue", "t_admitted", "t_done", "counted",
                  "trace_id", "span_id", "_event", "rid", "events",
                  "t_first", "stall_s", "preempts", "spec_prop",
-                 "spec_acc", "_flight")
+                 "spec_acc", "_flight", "qos", "deadline", "on_token")
 
     _rid_counter = itertools.count(1)
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
-                 top_k: int, seed: int, stop: int, adapter: str = ""):
+                 top_k: int, seed: int, stop: int, adapter: str = "",
+                 qos: str = "interactive",
+                 deadline: Optional[float] = None):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -282,6 +316,18 @@ class Request:
         self.seed = seed
         self.stop = stop              # -1 = no stop token
         self.adapter = adapter        # "" = base model (tenant key)
+        # QoS class ("interactive"/"batch"): batch slots are the first
+        # preemption victims and the first shed under pool pressure.
+        self.qos = qos
+        # Absolute monotonic deadline (None = no deadline): checked
+        # BEFORE prefill — infeasible requests shed, never time out.
+        self.deadline = deadline
+        # Streaming sink: called with each generated token id on the
+        # LOOP thread as it lands, then with None at retirement.
+        # Preemption-by-recompute never re-fires already-notified
+        # tokens — ``tokens`` only grows (recompute re-prefills, it
+        # does not re-emit), so a token streams exactly once.
+        self.on_token: Optional[Callable[[Optional[int]], None]] = None
         self.tokens: List[int] = []   # generated ids, filled by the loop
         # RNG stream stashed at preemption ([2] uint32); None until
         # then — a fresh admission derives the stream from ``seed``.
@@ -321,6 +367,18 @@ class Request:
     def done(self) -> bool:
         return self._event.is_set()
 
+    def _notify(self, token: Optional[int]) -> None:
+        """Fire the streaming sink (loop thread). A broken sink is
+        dropped, never propagated — one disconnected stream must not
+        kill the decode loop serving everyone else."""
+        cb = self.on_token
+        if cb is None:
+            return
+        try:
+            cb(token)
+        except Exception:
+            self.on_token = None
+
     def _finish(self, error: Optional[BaseException] = None) -> None:
         self.error = error
         self.t_done = time.monotonic()
@@ -328,6 +386,9 @@ class Request:
             self._flight.event(self, "retire",
                                err=type(error).__name__ if error else None)
             self._flight.retire(self)
+        # End-of-stream marker BEFORE the event: a streamer that woke
+        # on the sentinel can rely on result() returning immediately.
+        self._notify(None)
         self._event.set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -563,7 +624,11 @@ class DecodeEngine:
                  adapter_rank: int = 0,
                  adapter_default: str = "",
                  adapter_fallback: str = "base",
-                 tenant_weights: Optional[Dict[str, int]] = None):
+                 tenant_weights: Optional[Dict[str, int]] = None,
+                 qos_default: str = "interactive",
+                 deadline_default_s: float = 0.0,
+                 rate_limits: Optional[Dict[str, float]] = None,
+                 rate_burst_s: float = 2.0):
         import jax
 
         from ..models.generate import decode_config
@@ -642,6 +707,36 @@ class DecodeEngine:
         # Below the router's 60s backend timeout: a queue-starved
         # request fails with a clean engine error, never a router 502.
         self.request_timeout_s = request_timeout_s
+        # -- request-plane policy: QoS class default, deadline default
+        # and per-tenant token-weighted rate budgets (docs/serving.md
+        # "Request plane").
+        if qos_default not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown qos_default {qos_default!r} "
+                f"(expected one of {sorted(QOS_CLASSES)})")
+        self.qos_default = qos_default
+        if deadline_default_s < 0:
+            raise ValueError("deadline_default_s must be >= 0 "
+                             "(0 = no default deadline)")
+        self.deadline_default_s = float(deadline_default_s)
+        self.rate_limits = {str(k): float(v)
+                            for k, v in (rate_limits or {}).items()}
+        for tenant, rate in self.rate_limits.items():
+            if rate <= 0:
+                raise ValueError(
+                    f"rate_limits[{tenant!r}] must be > 0 tokens/s")
+        self.rate_burst_s = max(float(rate_burst_s), 0.1)
+        # Tenant -> [budget_tokens, last_refill] token buckets (guarded
+        # by _cond; overdraw model: a request is admitted while the
+        # budget is positive and debits its full prompt+max_new weight,
+        # so a burst runs the budget negative and the tenant waits
+        # deficit/rate seconds — which is exactly the Retry-After).
+        self._rate_buckets: Dict[str, List[float]] = {}
+        # Trailing queue-wait estimate (EWMA of first-admission waits):
+        # the deadline feasibility check's input — a request whose
+        # remaining deadline is under the current queue wait sheds at
+        # enqueue instead of burning a prefill.
+        self._qwait_ewma = 0.0
         self._registry = registry
         self.model = TransformerLM(self.cfg)
         self.params = jax.device_put(params)
@@ -987,6 +1082,22 @@ class DecodeEngine:
         reg.gauge("kfx_lm_queue_depth",
                   "Requests waiting for a decode-engine slot.").set(
                       len(self._queue), model=self.name)
+        # Per-QoS-class in-flight split (interactive vs batch slots) —
+        # the `kfx top` I/B column's source; set for both classes so
+        # the idle value is an explicit 0, not an absent series.
+        by_cls = {"interactive": 0, "batch": 0}
+        for r in self._slots:
+            if r is not None:
+                by_cls[r.qos] = by_cls.get(r.qos, 0) + 1
+        for cls, cnt in by_cls.items():
+            reg.gauge("kfx_lm_class_active",
+                      "In-flight engine slots by QoS class "
+                      "(interactive/batch).").set(
+                          cnt, model=self.name, qos=cls)
+        # Request-plane shed counters, seeded (inc 0) so a pre-traffic
+        # `scrape_metrics --require` already sees the families.
+        for family, help_text in self._SHED_HELP.items():
+            reg.counter(family, help_text).inc(0, model=self.name)
         reg.gauge("kfx_lm_kv_pages",
                   "KV cache pages in the engine's pool.").set(
                       self.n_pages, model=self.name)
@@ -1902,7 +2013,9 @@ class DecodeEngine:
     def _make_request(self, prompt: Sequence[int], max_new_tokens: int,
                       temperature: float, top_k: int, seed: int,
                       stop_token: Optional[int],
-                      adapter: Optional[str] = None) -> Request:
+                      adapter: Optional[str] = None,
+                      qos: Optional[str] = None,
+                      deadline_s: Optional[float] = None) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -1923,18 +2036,92 @@ class DecodeEngine:
             raise ValueError(
                 f"unknown adapter {name!r} (configured: "
                 f"{sorted(self._apool.sources) if self._apool else []})")
+        # QoS class: per-request override, else the engine default.
+        # Unknown classes are a client mistake (-> 400), never a 503.
+        cls = qos if qos is not None else self.qos_default
+        if cls not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown qos {cls!r} (expected one of "
+                f"{sorted(QOS_CLASSES)})")
+        # Deadline: per-request value, else the spec default (0 = no
+        # deadline). Stored absolute so queue time counts against it.
+        if deadline_s is None:
+            deadline_s = self.deadline_default_s or None
+        deadline = None
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                raise ValueError("deadline_s must be > 0")
+            deadline = time.monotonic() + deadline_s
         req = Request(prompt, int(max_new_tokens), float(temperature),
                       int(top_k), int(seed),
                       -1 if stop_token is None else int(stop_token),
-                      adapter=name)
+                      adapter=name, qos=cls, deadline=deadline)
         req._flight = self.flight
         return req
+
+    def _check_rate_locked(self, reqs: List[Request],
+                           now: float) -> Optional["RateLimited"]:
+        """Token-bucket admission for limited tenants (under _cond).
+        Cost = prompt + max_new tokens (the weight a request can put
+        on the engine). A tenant is admitted while its budget is
+        positive and debits the full cost — overdraw is allowed, so
+        the budget going negative is what paces the NEXT burst; the
+        deficit converts directly into Retry-After seconds. The batch
+        debits all-or-nothing, like every other admission check."""
+        if not self.rate_limits:
+            return None
+        costs: Dict[str, float] = {}
+        for r in reqs:
+            tenant = r.adapter or ""
+            if tenant in self.rate_limits:
+                costs[tenant] = costs.get(tenant, 0.0) \
+                    + len(r.prompt) + r.max_new
+        for tenant, cost in costs.items():
+            rate = self.rate_limits[tenant]
+            burst = rate * self.rate_burst_s
+            bucket = self._rate_buckets.get(tenant)
+            if bucket is None:
+                bucket = self._rate_buckets[tenant] = [burst, now]
+            bucket[0] = min(burst, bucket[0]
+                            + rate * (now - bucket[1]))
+            bucket[1] = now
+            if bucket[0] <= 0.0:
+                retry = min(30.0, (cost - bucket[0]) / rate)
+                return RateLimited(
+                    f"tenant {tenant or 'base'!r} is over its "
+                    f"{rate:g} tokens/s budget "
+                    f"(deficit {-bucket[0]:.0f} tokens)",
+                    retry_after_s=max(retry, 0.1))
+        for tenant, cost in costs.items():
+            self._rate_buckets[tenant][0] -= cost
+        return None
+
+    _SHED_HELP = {
+        "kfx_lm_deadline_shed_total":
+            "Requests shed before prefill as deadline-infeasible "
+            "(503 + Retry-After).",
+        "kfx_lm_rate_limited_total":
+            "Requests shed by a tenant's token-weighted rate budget "
+            "(503 + Retry-After).",
+    }
+
+    def _count_shed(self, family: str, n: int = 1) -> None:
+        self._reg().counter(family, self._SHED_HELP[family]).inc(
+            n, model=self.name)
 
     def _enqueue(self, reqs: List[Request]) -> None:
         """All-or-nothing enqueue: a batch that does not fit the
         bounded queue is rejected WHOLE — partial admission would
         orphan the admitted fraction (decoding with no waiter) exactly
-        when the engine is most loaded."""
+        when the engine is most loaded. Admission-time policy runs
+        here, before any prefill is burned: per-tenant token-rate
+        budgets, deadline feasibility against the trailing queue-wait
+        estimate, and batch-first load shedding (queued batch requests
+        are evicted to make room for arriving interactive ones)."""
+        shed_err: Optional[EngineOverloaded] = None
+        shed_family = ""
+        shed_victims: List[Request] = []
         with self._cond:
             if self._stopped:
                 raise RuntimeError("engine is closed")
@@ -1942,11 +2129,46 @@ class DecodeEngine:
                 raise EngineDraining(
                     f"engine {self.name} is draining; retry another "
                     "replica")
-            if len(self._queue) + len(reqs) > self.max_queue:
-                raise EngineOverloaded(
-                    f"admission queue full ({len(self._queue)} waiting, "
-                    f"{len(reqs)} arriving, cap {self.max_queue})")
-            if self._active_count() == 0 and not self._queue \
+            now = time.monotonic()
+            shed_err = self._check_rate_locked(reqs, now)
+            if shed_err is not None:
+                shed_family = "kfx_lm_rate_limited_total"
+            if shed_err is None:
+                # Deadline feasibility, judged with queue state in
+                # hand: remaining headroom under the trailing queue
+                # wait cannot make its deadline — shed NOW, before the
+                # engine spends a prefill on it. An empty queue skips
+                # the estimate (stale EWMA must not shed an idle
+                # engine's traffic).
+                est = self._qwait_ewma if len(self._queue) else 0.0
+                for r in reqs:
+                    if r.deadline is not None \
+                            and r.deadline - now <= est:
+                        shed_err = DeadlineInfeasible(
+                            f"deadline {max(r.deadline - now, 0):.2f}s "
+                            f"away but trailing queue wait is "
+                            f"{est:.2f}s", retry_after_s=1.0)
+                        shed_family = "kfx_lm_deadline_shed_total"
+                        break
+            if shed_err is None \
+                    and len(self._queue) + len(reqs) > self.max_queue:
+                overflow = len(self._queue) + len(reqs) - self.max_queue
+                if all(r.qos == "interactive" for r in reqs):
+                    # Batch is the first class shed under pressure:
+                    # evict queued batch work (newest first) to make
+                    # room for interactive arrivals.
+                    shed_victims = self._queue.shed_batch(overflow)
+                if len(self._queue) + len(reqs) > self.max_queue:
+                    shed_err = EngineOverloaded(
+                        f"admission queue full ({len(self._queue)} "
+                        f"waiting, {len(reqs)} arriving, cap "
+                        f"{self.max_queue})")
+                    shed_family = ""
+            if shed_err is not None:
+                # Fall through: counters and futures resolve outside
+                # the lock.
+                pass
+            elif self._active_count() == 0 and not self._queue \
                     and self._admitting is None:
                 # Waking an idle loop: the parked interval is not a
                 # stall — re-stamp progress so the liveness clock
@@ -1955,24 +2177,47 @@ class DecodeEngine:
                 # is stuck mid-admission must not reset the stall
                 # clock of a genuinely wedged loop.)
                 self._last_progress = time.monotonic()
-            for r in reqs:
-                self._queue.push(r)
+            if shed_err is None:
+                for r in reqs:
+                    self._queue.push(r)
             depth = len(self._queue)
             self._cond.notify()
+        if shed_victims:
+            evict = EngineOverloaded(
+                f"batch request shed for interactive admission "
+                f"(engine {self.name} under queue pressure)")
+            for v in shed_victims:
+                v._finish(evict)
         self._reg().gauge("kfx_lm_queue_depth",
                           "Requests waiting for a decode-engine slot."
                           ).set(depth, model=self.name)
+        if shed_err is not None:
+            if shed_family:
+                self._count_shed(shed_family)
+            raise shed_err
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                stop_token: Optional[int] = None,
-               adapter: Optional[str] = None) -> Request:
+               adapter: Optional[str] = None,
+               qos: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               on_token: Optional[Callable[[Optional[int]], None]]
+               = None) -> Request:
         """Enqueue one prompt; returns the request handle (wait with
         ``.result(timeout)``). ``adapter`` selects a configured LoRA
-        adapter by name (None = engine default, "" = base). Raises
-        EngineOverloaded when the bounded admission queue is full."""
+        adapter by name (None = engine default, "" = base); ``qos``
+        overrides the engine's class default; ``deadline_s`` is the
+        per-request deadline (None = spec default, which may be none);
+        ``on_token`` is the streaming sink — called on the loop thread
+        with each token id as it lands, then None at retirement.
+        Raises EngineOverloaded when the bounded admission queue is
+        full, DeadlineInfeasible/RateLimited when admission policy
+        sheds the request."""
         req = self._make_request(prompt, max_new_tokens, temperature,
-                                 top_k, seed, stop_token, adapter)
+                                 top_k, seed, stop_token, adapter,
+                                 qos=qos, deadline_s=deadline_s)
+        req.on_token = on_token
         self._enqueue([req])
         return req
 
@@ -1980,16 +2225,22 @@ class DecodeEngine:
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0,
                  stop_token: Optional[int] = None,
-                 adapter: Optional[str] = None) -> List[List[int]]:
+                 adapter: Optional[str] = None,
+                 qos: Optional[str] = None,
+                 deadline_s: Optional[float] = None
+                 ) -> List[List[int]]:
         """Blocking convenience mirroring LMGenerator.generate: one
         request per prompt (seeded seed+i), results in prompt order.
         The batch enqueues atomically, and one deadline covers the
-        whole batch (request_timeout_s sits under the router's 60s
-        backend timeout — per-request fresh clocks could stack past
-        it)."""
+        whole batch: the request's own ``deadline_s`` when given
+        (deadline-derived timeout), else request_timeout_s — both sit
+        under the router's 60s backend timeout, so per-request fresh
+        clocks can't stack past it."""
         reqs = self.submit_batch(prompts, max_new_tokens, temperature,
-                                 top_k, seed, stop_token, adapter)
-        deadline = time.monotonic() + self.request_timeout_s
+                                 top_k, seed, stop_token, adapter,
+                                 qos=qos, deadline_s=deadline_s)
+        wait_s = deadline_s if deadline_s else self.request_timeout_s
+        deadline = time.monotonic() + wait_s
         return [r.result(max(0.001, deadline - time.monotonic()))
                 for r in reqs]
 
@@ -1997,13 +2248,17 @@ class DecodeEngine:
                      max_new_tokens: int = 32, temperature: float = 0.0,
                      top_k: int = 0, seed: int = 0,
                      stop_token: Optional[int] = None,
-                     adapter: Optional[str] = None) -> List[Request]:
+                     adapter: Optional[str] = None,
+                     qos: Optional[str] = None,
+                     deadline_s: Optional[float] = None
+                     ) -> List[Request]:
         """`generate` minus the blocking wait: one request per prompt
         (seeded seed+i), enqueued atomically, handles returned — so a
         caller (the model server's timing block) can read per-request
         flight state after collecting results."""
         reqs = [self._make_request(p, max_new_tokens, temperature,
-                                   top_k, seed + i, stop_token, adapter)
+                                   top_k, seed + i, stop_token, adapter,
+                                   qos=qos, deadline_s=deadline_s)
                 for i, p in enumerate(prompts)]
         self._enqueue(reqs)
         return reqs
@@ -2171,6 +2426,19 @@ class DecodeEngine:
                 self._admitting = req
             requeued = False
             try:
+                # Deadline gate at the slot boundary, BEFORE prefill:
+                # a request whose deadline expired while queued sheds
+                # here — the engine never burns a prefill on work it
+                # cannot finish in time ("zero post-prefill deadline
+                # timeouts"). Requeued preempts carry sunk prefill
+                # cost, but an expired deadline still ends them.
+                if req.deadline is not None \
+                        and time.monotonic() >= req.deadline:
+                    self._count_shed("kfx_lm_deadline_shed_total")
+                    req._finish(DeadlineInfeasible(
+                        "deadline expired while queued "
+                        f"(waited {time.monotonic() - req.t_enqueue:.2f}s)"))
+                    continue
                 self._admit(req, free[0])
             except PageAllocError as e:
                 if self._active_count() == 0:
@@ -2466,6 +2734,12 @@ class DecodeEngine:
         if self.flight is not None:
             self.flight.event(req, "admit", matched=matched, prompt=n)
         wait = req.t_admitted - req.t_enqueue
+        # Trailing queue-wait EWMA: the deadline feasibility check's
+        # estimate of what a newly-enqueued request will wait. Biased
+        # toward recency (0.3) so a drained backlog stops shedding
+        # within a few admissions.
+        self._qwait_ewma = wait if self._qwait_ewma <= 0.0 \
+            else 0.7 * self._qwait_ewma + 0.3 * wait
         self._reg().histogram(
             "kfx_lm_queue_wait_seconds",
             "Decode-engine admission wait (enqueue to slot prefill).",
@@ -2634,11 +2908,10 @@ class DecodeEngine:
                     # (the 503 + Retry-After shed contract).
                     self._abort_prefill(slot, e)
                     return
-                victim = max(victims,
-                             key=lambda s: self._slots[s].t_enqueue)
+                victim = self._preempt_victim(victims)
                 self._preempt(victim)
                 if victim == slot:
-                    return  # this cursor was the youngest: re-queued
+                    return  # this cursor was the victim: re-queued
         tokens = np.zeros((1, P), np.int32)
         tokens[0, :length] = cur["full"][start:start + length]
         t_dispatch = time.monotonic()
@@ -2824,8 +3097,16 @@ class DecodeEngine:
                            if r is not None]
                 if len(victims) <= 1:
                     raise
-                self._preempt(max(
-                    victims, key=lambda s: self._slots[s].t_enqueue))
+                self._preempt(self._preempt_victim(victims))
+
+    def _preempt_victim(self, victims: List[int]) -> int:
+        """QoS-aware preemption ordering: a batch slot is always
+        sacrificed before any interactive slot (True > False in the
+        key), and within a class the YOUNGEST goes first — the oldest
+        requests of the better class always make progress."""
+        return max(victims,
+                   key=lambda s: (self._slots[s].qos == "batch",
+                                  self._slots[s].t_enqueue))
 
     def _preempt(self, slot: int) -> None:
         req = self._slots[slot]
@@ -2880,8 +3161,7 @@ class DecodeEngine:
                            if r is not None]
                 if len(victims) <= 1:
                     raise
-                self._preempt(max(
-                    victims, key=lambda s: self._slots[s].t_enqueue))
+                self._preempt(self._preempt_victim(victims))
         for slot, req in enumerate(self._slots):
             if req is None or not self._active[slot] \
                     or not self._spec_ok[slot]:
@@ -2937,6 +3217,7 @@ class DecodeEngine:
                 done = True
                 break
             req.tokens.append(int(t))
+            req._notify(int(t))
             landed += 1
             if len(req.tokens) >= req.max_new:
                 done = True
@@ -3114,7 +3395,11 @@ class DecodeEngine:
                 # would return an empty completion.
                 continue
             hits = np.flatnonzero(emits[:, slot])
-            req.tokens.extend(int(t) for t in toks[hits, slot])
+            fresh = [int(t) for t in toks[hits, slot]]
+            req.tokens.extend(fresh)
+            if req.on_token is not None:
+                for t in fresh:
+                    req._notify(t)
             emitted += len(hits)
             if len(hits) and req.t_first == 0.0:
                 req.t_first = time.monotonic()

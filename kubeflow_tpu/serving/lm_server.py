@@ -36,10 +36,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import queue as _queue
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, Iterator, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -50,6 +51,11 @@ from .server import Predictor
 
 CONFIG_FILE = "lm_config.json"
 PARAMS_FILE = "params.msgpack"
+
+# The router holds each backend attempt open for 60s
+# (router._attempt); result waits here stay under it so a starved
+# request surfaces as a clean engine error, never a router 502.
+_BACKEND_TIMEOUT_S = 60.0
 
 def export_lm(directory: str, cfg, params, quantize: str = "") -> str:
     """Write a servable LM export from train-time config + params.
@@ -237,6 +243,25 @@ class LMPredictor(Predictor):
         # a big model is seconds); tests shrink it via the env knob.
         self.stall_threshold_s = float(
             os.environ.get("KFX_LM_STALL_S", "10.0"))
+        # Request-plane policy (docs/serving.md "Request plane"):
+        # QoS class default (per-request "qos" overrides), default
+        # deadline in ms (0 = none; per-request "deadline_ms" or the
+        # X-KFX-Deadline-Ms header overrides), and per-tenant
+        # token-weighted rate budgets {adapter: tokens/s} with a burst
+        # window — spec.<rev>.{qosDefault,deadlineMs,rateLimits} via
+        # the operator.
+        self.qos_default = os.environ.get(
+            "KFX_LM_QOS_DEFAULT", "interactive")
+        self.deadline_default_ms = float(
+            os.environ.get("KFX_LM_DEADLINE_MS", "0"))
+        try:
+            self.rate_limits = json.loads(
+                os.environ.get("KFX_LM_RATE_LIMITS", "") or "{}")
+        except ValueError as e:
+            raise ValueError(
+                f"KFX_LM_RATE_LIMITS is not valid JSON: {e}") from e
+        self.rate_burst_s = float(
+            os.environ.get("KFX_LM_RATE_BURST_S", "2.0"))
         self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
@@ -295,7 +320,11 @@ class LMPredictor(Predictor):
                 adapter_slots=self.adapter_slots,
                 adapter_rank=self.adapter_rank,
                 adapter_default=self.adapter_default,
-                adapter_fallback=self.adapter_fallback)
+                adapter_fallback=self.adapter_fallback,
+                qos_default=self.qos_default,
+                deadline_default_s=self.deadline_default_ms / 1000.0,
+                rate_limits=self.rate_limits or None,
+                rate_burst_s=self.rate_burst_s)
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".
@@ -398,7 +427,10 @@ class LMPredictor(Predictor):
         raise NotImplementedError(
             "LM models serve :generate, not :predict")
 
-    def generate(self, body: Dict[str, Any]) -> Dict[str, Any]:
+    def _parse_generate(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Shared request-plane validation for the buffered and
+        streaming :generate paths. Every defect here is a client
+        mistake (ValueError -> 400), never a 503."""
         prompts = body.get("prompt_tokens")
         if not prompts or not isinstance(prompts, list):
             raise ValueError("prompt_tokens (list of token-id lists) "
@@ -435,28 +467,48 @@ class LMPredictor(Predictor):
             raise ValueError(
                 "adapter selection requires the engine path "
                 "(KFX_LM_ENGINE=1)")
-        prompts = [list(map(int, p)) for p in prompts]
-        kw = dict(max_new_tokens=int(body.get("max_new_tokens", 32)),
-                  temperature=float(body.get("temperature", 0.0)),
-                  top_k=int(body.get("top_k", 0)),
-                  seed=int(body.get("seed", 0)))
-        t0 = time.perf_counter()
-        reqs = None
-        if self._engine is not None:
-            # submit_batch + result instead of generate(): identical
-            # semantics (same atomic enqueue, same batch deadline), but
-            # the Request handles survive for the per-request timing
-            # block the flight recorder computes.
-            reqs = self._engine.submit_batch(prompts, stop_token=stop,
-                                             adapter=adapter, **kw)
-            deadline = time.monotonic() + self._engine.request_timeout_s
-            out = [r.result(max(0.001, deadline - time.monotonic()))
-                   for r in reqs]
-        else:
-            out = self._gen.generate(prompts, **kw)
-        elapsed = time.perf_counter() - t0
-        n_tokens = sum(len(ids) for ids in out)
-        tps = n_tokens / elapsed if elapsed > 0 else 0.0
+        # QoS class ("interactive"/"batch"): per-request override of
+        # the revision default; validated by the engine.
+        qos = body.get("qos")
+        if qos is not None and not isinstance(qos, str):
+            raise ValueError("qos must be a string class name")
+        # Per-request deadline in milliseconds (the X-KFX-Deadline-Ms
+        # header lands here too — the server merges it into the body).
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is not None:
+            if isinstance(deadline_ms, bool) \
+                    or not isinstance(deadline_ms, (int, float)):
+                raise ValueError("deadline_ms must be a number")
+            if deadline_ms <= 0:
+                raise ValueError("deadline_ms must be > 0")
+        return {
+            "prompts": [list(map(int, p)) for p in prompts],
+            "stop": stop,
+            "adapter": adapter,
+            "qos": qos,
+            "deadline_s": (float(deadline_ms) / 1000.0
+                           if deadline_ms is not None else None),
+            "kw": dict(
+                max_new_tokens=int(body.get("max_new_tokens", 32)),
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                seed=int(body.get("seed", 0))),
+        }
+
+    def _wait_budget_s(self, deadline_s: Optional[float]) -> float:
+        """The result-wait clock: the request's own deadline when it
+        has one (deadline-derived timeout — the engine and the client
+        agree on ONE clock), else the engine's request_timeout_s
+        default (50s). Either way capped under the router's 60s
+        backend timeout so a queue-starved request fails with a clean
+        engine error, never a router 502."""
+        cap = _BACKEND_TIMEOUT_S - 2.0
+        if deadline_s is not None:
+            return min(deadline_s, cap)
+        return min(self._engine.request_timeout_s, cap) \
+            if self._engine is not None else cap
+
+    def _record_generate(self, n_tokens: int, elapsed: float) -> None:
         # Decode throughput is the LM serving headline (BENCH lm rows);
         # exporting it makes `kfx top` and /metrics agree with bench.
         self._rate.record(n_tokens)
@@ -474,6 +526,30 @@ class LMPredictor(Predictor):
             "kfx_lm_generate_seconds",
             "Wall time of generate calls.").observe(elapsed,
                                                     model=self.name)
+
+    def generate(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        p = self._parse_generate(body)
+        t0 = time.perf_counter()
+        reqs = None
+        if self._engine is not None:
+            # submit_batch + result instead of generate(): identical
+            # semantics (same atomic enqueue, same batch deadline), but
+            # the Request handles survive for the per-request timing
+            # block the flight recorder computes.
+            reqs = self._engine.submit_batch(
+                p["prompts"], stop_token=p["stop"],
+                adapter=p["adapter"], qos=p["qos"],
+                deadline_s=p["deadline_s"], **p["kw"])
+            deadline = time.monotonic() \
+                + self._wait_budget_s(p["deadline_s"])
+            out = [r.result(max(0.001, deadline - time.monotonic()))
+                   for r in reqs]
+        else:
+            out = self._gen.generate(p["prompts"], **p["kw"])
+        elapsed = time.perf_counter() - t0
+        n_tokens = sum(len(ids) for ids in out)
+        tps = n_tokens / elapsed if elapsed > 0 else 0.0
+        self._record_generate(n_tokens, elapsed)
         result = {"generated_tokens": out,
                   "tokens_per_second": round(tps, 2)}
         if reqs is not None and self._engine.flight is not None:
@@ -483,3 +559,104 @@ class LMPredictor(Predictor):
             flight = self._engine.flight
             result["timing"] = [flight.timing(r) for r in reqs]
         return result
+
+    def generate_stream(self, body: Dict[str, Any]
+                        ) -> Iterator[bytes]:
+        """SSE token streaming (docs/serving.md "Request plane").
+        Validates and SUBMITS synchronously — ValueError /
+        EngineOverloaded raise here, before any bytes stream, so the
+        server still answers a clean 400/503 — then returns an
+        iterator of SSE events:
+
+            data: {"index": i, "token": t}\\n\\n      per token
+            data: {"done": true, "n_tokens": N, ...}\\n\\n
+
+        ``stream_skip`` (the router's mid-stream recovery knob)
+        suppresses the first N deterministically-regenerated tokens
+        and starts the client-visible ``index`` at N, so a resumed
+        stream concatenates byte-identical with the events the dead
+        replica already delivered. A mid-stream engine failure emits
+        an ``event: error`` frame and ends the stream."""
+        p = self._parse_generate(body)
+        if len(p["prompts"]) != 1:
+            raise ValueError("streaming serves exactly one prompt "
+                             "per request")
+        skip = body.get("stream_skip", 0)
+        if isinstance(skip, bool) or not isinstance(skip, int) \
+                or skip < 0:
+            raise ValueError("stream_skip must be an int >= 0")
+        budget_s = self._wait_budget_s(p["deadline_s"])
+        if self._engine is None:
+            # One-shot oracle: generate fully, then replay as events —
+            # same wire contract, no incremental delivery.
+            t0 = time.perf_counter()
+            out = self._gen.generate(p["prompts"], **p["kw"])[0]
+            elapsed = time.perf_counter() - t0
+            self._record_generate(len(out), elapsed)
+            return iter(self._replay_events(out, skip, elapsed))
+        q: "_queue.Queue[Optional[int]]" = _queue.Queue()
+        req = self._engine.submit(
+            p["prompts"][0], stop_token=p["stop"],
+            adapter=p["adapter"], qos=p["qos"],
+            deadline_s=p["deadline_s"], on_token=q.put, **p["kw"])
+        return self._stream_events(req, q, skip, budget_s)
+
+    @staticmethod
+    def _sse(obj: Dict[str, Any], event: str = "") -> bytes:
+        head = f"event: {event}\n" if event else ""
+        return (head + "data: " + json.dumps(obj)
+                + "\n\n").encode("utf-8")
+
+    def _replay_events(self, tokens, skip: int, elapsed: float):
+        for i, t in enumerate(tokens):
+            if i >= skip:
+                yield self._sse({"index": i, "token": int(t)})
+        tps = len(tokens) / elapsed if elapsed > 0 else 0.0
+        yield self._sse({"done": True, "n_tokens": len(tokens),
+                         "tokens_per_second": round(tps, 2)})
+
+    def _stream_events(self, req, q, skip: int,
+                       budget_s: float) -> Iterator[bytes]:
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + budget_s
+        seen = 0
+        while True:
+            try:
+                tok = q.get(timeout=min(
+                    0.25, max(0.001, deadline - time.monotonic())))
+            except _queue.Empty:
+                if time.monotonic() >= deadline:
+                    yield self._sse(
+                        {"error": "engine did not complete the "
+                                  f"request within {budget_s}s",
+                         "code": 503}, event="error")
+                    return
+                continue
+            if tok is None:
+                break
+            if seen >= skip:
+                yield self._sse({"index": seen, "token": tok})
+            seen += 1
+        if req.error is not None:
+            from .engine import EngineOverloaded
+            code = 503 if isinstance(req.error, EngineOverloaded) \
+                else 500
+            yield self._sse({"error": str(req.error), "code": code},
+                            event="error")
+            return
+        # Drain the race: tokens notified between the last get and
+        # the sentinel are already in req.tokens — emit any the loop
+        # has not streamed yet (exact once: seen tracks engine order).
+        for i in range(seen, len(req.tokens)):
+            if i >= skip:
+                yield self._sse({"index": i, "token": req.tokens[i]})
+            seen = i + 1
+        elapsed = time.perf_counter() - t0
+        n = len(req.tokens)
+        self._record_generate(n, elapsed)
+        tps = n / elapsed if elapsed > 0 else 0.0
+        done = {"done": True, "n_tokens": n,
+                "tokens_per_second": round(tps, 2)}
+        if self._engine.flight is not None:
+            done["timing"] = self._engine.flight.timing(req)
+        yield self._sse(done)

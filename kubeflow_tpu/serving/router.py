@@ -57,6 +57,22 @@ _HOP_BY_HOP = frozenset({
     "upgrade", "host", "content-length"})
 
 
+class _StreamState:
+    """Per-request SSE relay progress, shared across retry attempts:
+    what the CLIENT has seen is the one truth recovery must honor."""
+
+    __slots__ = ("started", "relayed", "done")
+
+    def __init__(self):
+        self.started = False  # SSE headers sent downstream
+        self.relayed = 0      # token events the client received
+        self.done = False     # terminal done frame relayed
+
+
+class _ClientGone(Exception):
+    """The downstream client closed mid-relay — abort, don't recover."""
+
+
 class BackendSet:
     """Round-robin over the live replica endpoints of one revision,
     with passive health: an endpoint that fails ``EJECT_AFTER``
@@ -326,11 +342,17 @@ class Router:
                 "Passive-health ejections/readmissions by endpoint.",
             ).inc(0, namespace=namespace, isvc=name, revision="default",
                   endpoint="", event="eject")
-            metrics.counter(
-                "kfx_router_recoveries_total",
-                "In-flight generate requests re-dispatched to a healthy "
-                "replica after their backend died mid-request.",
-            ).inc(0, namespace=namespace, isvc=name, revision="default")
+            # Both recovery modes seeded: buffered (the whole request
+            # re-dispatched, client saw nothing) and mid_stream (SSE
+            # resume — peer regenerates, skips what the client has).
+            for mode in ("buffered", "mid_stream"):
+                metrics.counter(
+                    "kfx_router_recoveries_total",
+                    "In-flight generate requests re-dispatched to a "
+                    "healthy replica after their backend died "
+                    "mid-request.",
+                ).inc(0, namespace=namespace, isvc=name,
+                      revision="default", mode=mode)
             metrics.counter(
                 "kfx_router_prefix_affinity_hits_total",
                 "Generate requests routed to their prefix-affinity "
@@ -484,9 +506,16 @@ class Router:
         internal = h.headers.get("X-KFX-Component", "").lower() == \
             "predictor"
         aff_key = ""
-        if path.endswith(":generate") and self.affinity_capacity > 0:
-            aff_key = h.headers.get(PREFIX_HEADER, "") or \
-                self._affinity_from_body(data)
+        stream = False
+        if path.endswith(":generate"):
+            if self.affinity_capacity > 0:
+                aff_key = h.headers.get(PREFIX_HEADER, "") or \
+                    self._affinity_from_body(data)
+            if data:
+                try:
+                    stream = bool(json.loads(data).get("stream"))
+                except (ValueError, AttributeError):
+                    stream = False
         if not internal and self.explainer_configured and \
                 path.endswith(":explain"):
             backend = self.explainer.pick()
@@ -518,7 +547,10 @@ class Router:
         chosen.enter()
         self._set_inflight(chosen)
         try:
-            self._forward(h, backend, chosen, data, aff_key)
+            if stream:
+                self._forward_stream(h, backend, chosen, data, aff_key)
+            else:
+                self._forward(h, backend, chosen, data, aff_key)
         finally:
             chosen.exit()
             self._set_inflight(chosen)
@@ -532,11 +564,14 @@ class Router:
                   revision=bs.revision, endpoint=endpoint, event=event)
         return record
 
-    def _record_recovery(self, chosen: BackendSet) -> None:
+    def _record_recovery(self, chosen: BackendSet,
+                         mode: str = "buffered") -> None:
         """One in-flight request survived its backend's death by
         re-dispatch — the cross-replica recovery the self-healing
         tentpole promises (bounded to one per request by the retry
-        loop)."""
+        loop). ``mode="mid_stream"`` marks the SSE resume flavor:
+        tokens had already reached the client, so the peer
+        deterministically regenerated and skipped them."""
         if self.metrics is None:
             return
         self.metrics.counter(
@@ -544,7 +579,29 @@ class Router:
             "In-flight generate requests re-dispatched to a healthy "
             "replica after their backend died mid-request.",
         ).inc(1, namespace=self.namespace, isvc=self.name,
-              revision=chosen.revision)
+              revision=chosen.revision, mode=mode)
+
+    def _retry_backoff(
+            self, last: Optional[Tuple[int, List[Tuple[str, str]],
+                                       bytes]]) -> None:
+        """Honor a server-sent Retry-After before the bounded retry,
+        with decorrelated jitter (0.5x..1.5x the advertised wait,
+        capped) — an immediate re-dispatch after a shed lands in the
+        exact overload that shed it, so every router retrying at once
+        just moves the thundering herd one replica over."""
+        if last is None or last[0] != 503:
+            return
+        retry_after = 0.0
+        for k, v in last[1]:
+            if k.lower() == "retry-after":
+                try:
+                    retry_after = float(v)
+                except ValueError:
+                    retry_after = 0.0
+        if retry_after <= 0:
+            return
+        time.sleep(min(2.0, self._rng.uniform(0.5 * retry_after,
+                                              1.5 * retry_after)))
 
     def _set_inflight(self, chosen: BackendSet) -> None:
         if self.metrics is not None:
@@ -634,6 +691,7 @@ class Router:
                     if alt is not None and alt != attempt_backend:
                         recovering = last_err is not None and \
                             h.path.partition("?")[0].endswith(":generate")
+                        self._retry_backoff(last)
                         attempt_backend = alt
                         sp.attrs["retried_on"] = alt
                         continue
@@ -689,6 +747,241 @@ class Router:
             conn.request(h.command, h.path, body=data or None, headers=fwd)
             resp = conn.getresponse()
             return resp.status, list(resp.getheaders()), resp.read()
+        finally:
+            conn.close()
+
+    # -- SSE streaming relay ------------------------------------------------
+    def _forward_stream(self, h, backend: str, chosen: BackendSet,
+                        data: bytes, aff_key: str = "") -> None:
+        """Relay a streaming ``:generate`` (body ``"stream": true``)
+        as pass-through SSE, with MID-STREAM recovery: if the backend
+        dies after N token events already reached the client, the
+        bounded retry re-dispatches the original body to a peer with
+        ``stream_skip`` raised by N — the peer deterministically
+        regenerates the same tokens (same seed + knobs), the server
+        suppresses the first N, and the client's concatenated stream
+        is byte-identical to an uninterrupted run: zero duplicates,
+        zero gaps (kfx_router_recoveries_total{mode="mid_stream"}).
+        A failure before any token streamed is the buffered special
+        case (mode="buffered"). Pre-stream admission responses
+        (400/503 sheds) arrive as plain JSON and relay like any
+        buffered response, Retry-After jitter included."""
+        t0 = time.perf_counter()
+        attempt_backend = backend
+        st = _StreamState()
+        last: Optional[Tuple[int, List[Tuple[str, str]], bytes]] = None
+        last_err: Optional[OSError] = None
+        recovering = False
+        rec_mode = "buffered"
+        sp = obs_trace.start_span(
+            "router.dispatch", trace_id=h.headers.get(TRACE_HEADER, ""),
+            parent_id=h.headers.get(SPAN_HEADER, ""), backend=backend)
+        try:
+            for attempt in range(2):
+                body = data
+                if st.relayed:
+                    # Recovery re-dispatch: the peer regenerates from
+                    # the same seed; skip what the client already has
+                    # (on top of any skip the client itself asked for).
+                    b = json.loads(data)
+                    b["stream_skip"] = (int(b.get("stream_skip") or 0)
+                                        + st.relayed)
+                    body = json.dumps(b).encode()
+                chosen.ep_enter(attempt_backend)
+                try:
+                    last = self._attempt_stream(h, attempt_backend,
+                                                body, sp.span_id, st)
+                    last_err = None
+                except _ClientGone:
+                    # The CLIENT hung up mid-relay; nothing to recover
+                    # (the backend finishes or reaps on its own).
+                    self._record_request(chosen, 499,
+                                         time.perf_counter() - t0)
+                    return
+                except OSError as e:
+                    last, last_err = None, e
+                finally:
+                    chosen.ep_exit(attempt_backend)
+                if st.done:
+                    chosen.report_success(attempt_backend)
+                    if aff_key:
+                        self._remember_affinity(aff_key, chosen,
+                                                attempt_backend)
+                    if recovering:
+                        self._record_recovery(chosen, mode=rec_mode)
+                        sp.attrs["recovered"] = rec_mode
+                    self._record_request(chosen, 200,
+                                         time.perf_counter() - t0)
+                    # Only now release the client: the terminal chunk
+                    # is the client's end-of-stream signal, and every
+                    # counter it might scrape next must already be
+                    # settled (the recovery above in particular).
+                    try:
+                        h.wfile.write(b"0\r\n\r\n")
+                        h.wfile.flush()
+                    except OSError:
+                        pass
+                    h.close_connection = True
+                    return
+                if last is not None and last[0] < 500:
+                    # Non-SSE answer (400 validation, 503 shed, ...):
+                    # the backend never started streaming, so the
+                    # buffered relay contract applies unchanged.
+                    chosen.report_success(attempt_backend)
+                    break
+                chosen.report_failure(attempt_backend)
+                if attempt == 0:
+                    alt = chosen.pick(exclude=(attempt_backend,))
+                    if alt is not None and alt != attempt_backend:
+                        recovering = last_err is not None
+                        rec_mode = ("mid_stream" if st.relayed
+                                    else "buffered")
+                        self._retry_backoff(last)
+                        attempt_backend = alt
+                        sp.attrs["retried_on"] = alt
+                        continue
+                break
+        finally:
+            obs_trace.finish_span(
+                sp, status="ok" if st.done or
+                (last is not None and last[0] < 500) else "error")
+        if st.started:
+            # Headers are out: the only honest failure channel left is
+            # an in-band error frame (then close without recycling the
+            # connection — the stream is dead).
+            self._record_request(chosen, 502, time.perf_counter() - t0)
+            frame = (b"event: error\ndata: "
+                     + json.dumps({"error": "backend lost mid-stream "
+                                            "and recovery failed",
+                                   "code": 502}).encode()
+                     + b"\n\n")
+            try:
+                h.wfile.write(b"%x\r\n%s\r\n0\r\n\r\n"
+                              % (len(frame), frame))
+                h.wfile.flush()
+            except OSError:
+                pass
+            h.close_connection = True
+            return
+        if last is not None:
+            status, headers, payload = last
+            self._record_request(chosen, status,
+                                 time.perf_counter() - t0)
+            h.send_response(status)
+            skip = _HOP_BY_HOP | {"content-length", "server", "date"}
+            for k, v in headers:
+                if k.lower() not in skip:
+                    h.send_header(k, v)
+            h.send_header("Content-Length", str(len(payload)))
+            h.end_headers()
+            h.wfile.write(payload)
+            return
+        self._record_request(chosen, 502, time.perf_counter() - t0)
+        payload = json.dumps(
+            {"error": f"backend {attempt_backend}: {last_err}"}).encode()
+        h.send_response(502)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(payload)))
+        h.end_headers()
+        h.wfile.write(payload)
+
+    def _attempt_stream(self, h, backend: str, data: bytes,
+                        span_id: str, st: "_StreamState"
+                        ) -> Optional[Tuple[int, List[Tuple[str, str]],
+                                            bytes]]:
+        """One streaming backend round trip. Relays SSE events to the
+        client as they arrive, counting token events into ``st``;
+        returns None with ``st.done`` set on a complete stream, or the
+        buffered (status, headers, payload) if the backend answered
+        with a non-SSE response (pre-stream shed/validation). Raises
+        OSError when the backend connection fails OR the event stream
+        truncates before its terminal frame — the caller's recovery
+        trigger — and _ClientGone when the downstream client is the
+        one that went away."""
+        chaos.fail_or_delay("serving.request", ConnectionRefusedError,
+                            f"injected backend failure {backend}",
+                            target=backend)
+        # Fault point: sever the relay after the first token event
+        # reached the client — the deterministic stand-in for a
+        # replica dying mid-stream (mode=delay stalls instead).
+        cut = chaos.draw("router.stream_cut", target=backend)
+        host, _, port = backend.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        try:
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            fwd: Dict[str, str] = {}
+            for k, v in h.headers.items():
+                if k.lower() in _HOP_BY_HOP:
+                    continue
+                fwd[k] = f"{fwd[k]}, {v}" if k in fwd else v
+            if span_id:
+                fwd[SPAN_HEADER] = span_id
+            fwd["Content-Length"] = str(len(data))
+            conn.request(h.command, h.path, body=data, headers=fwd)
+            resp = conn.getresponse()
+            ctype = resp.getheader("Content-Type", "")
+            if resp.status != 200 or "text/event-stream" not in ctype:
+                return resp.status, list(resp.getheaders()), resp.read()
+            if not st.started:
+                h.send_response(200)
+                h.send_header("Content-Type", "text/event-stream")
+                h.send_header("Cache-Control", "no-store")
+                h.send_header("Transfer-Encoding", "chunked")
+                h.end_headers()
+                h._last_code = 200
+                st.started = True
+            lines: List[bytes] = []
+            while True:
+                try:
+                    line = resp.readline()
+                except (OSError, http.client.HTTPException) as e:
+                    raise ConnectionResetError(
+                        f"stream truncated: {e}") from e
+                if not line:
+                    break  # EOF; clean only if the done frame arrived
+                lines.append(line)
+                if line not in (b"\n", b"\r\n"):
+                    continue
+                event = b"".join(lines)
+                lines = []
+                is_token = False
+                for ln in event.splitlines():
+                    if ln.startswith(b"data: "):
+                        try:
+                            obj = json.loads(ln[6:])
+                        except ValueError:
+                            continue
+                        if obj.get("done"):
+                            st.done = True
+                        elif "token" in obj:
+                            is_token = True
+                try:
+                    h.wfile.write(b"%x\r\n%s\r\n" % (len(event), event))
+                    h.wfile.flush()
+                except OSError as e:
+                    raise _ClientGone(str(e)) from e
+                if is_token:
+                    st.relayed += 1
+                    if cut is not None:
+                        if cut.mode == "delay":
+                            time.sleep(cut.delay)
+                            cut = None
+                        else:
+                            raise ConnectionResetError(
+                                "chaos[router.stream_cut] after "
+                                f"{st.relayed} events")
+                if st.done:
+                    break
+            if not st.done:
+                raise ConnectionResetError(
+                    "stream ended without terminal frame")
+            # The terminal chunk is written by _forward_stream AFTER
+            # the recovery/affinity bookkeeping: a client that reads
+            # end-of-stream and immediately scrapes metrics must see
+            # the recovery already counted.
+            return None
         finally:
             conn.close()
 

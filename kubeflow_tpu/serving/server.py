@@ -36,6 +36,7 @@ import json
 import logging
 import os
 import queue
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -577,6 +578,16 @@ class ModelServer:
             model = labels.get("model", "")
             out.setdefault(model, {})["quant"] = quant_mode_string(
                 labels.get("weights", "f32"), labels.get("kv", "f32"))
+        # Per-QoS-class in-flight split (request plane): the qos label
+        # rides the gauge; the JSON block flattens it into the
+        # active_interactive / active_batch fields `kfx top` renders
+        # as its I/B column.
+        for labels, value in self.metrics.gauge(
+                "kfx_lm_class_active").samples():
+            model = labels.get("model", "")
+            qos = labels.get("qos", "")
+            if qos in ("interactive", "batch"):
+                out.setdefault(model, {})[f"active_{qos}"] = value
         return out
 
     def _finish_request(self, h, name: str, verb: str, t0: float) -> None:
@@ -843,7 +854,25 @@ class ModelServer:
         except ValueError as e:
             h._send(400, {"error": f"bad request: {e}"})
             return
+        # Deadline header alias: proxies and CLIs that can't touch the
+        # body set X-KFX-Deadline-Ms instead; the body field wins.
+        hdr_deadline = h.headers.get("X-KFX-Deadline-Ms")
+        if hdr_deadline is not None and "deadline_ms" not in body:
+            try:
+                body["deadline_ms"] = float(hdr_deadline)
+            except ValueError:
+                h._send(400, {"error": "X-KFX-Deadline-Ms must be "
+                                       "a number"})
+                return
         try:
+            if body.get("stream"):
+                if not getattr(p, "generate_stream", None):
+                    h._send(400, {"error": f"model {name!r} does not "
+                                           f"support streaming"})
+                    return
+                events = p.generate_stream(body)
+                self._send_sse(h, events)
+                return
             result = p.generate(body)
         except ValueError as e:
             h._send(400, {"error": str(e)})
@@ -852,13 +881,53 @@ class ModelServer:
             # Bounded-queueing overflow is load shedding, not a client
             # mistake and not a server fault: 503 + Retry-After, the
             # same contract the router uses while scaling from zero.
+            # Deadline/rate sheds carry their own feasibility-derived
+            # Retry-After so the router's jittered retry can wait out
+            # the actual deficit instead of hammering the same wall.
+            retry = getattr(e, "retry_after_s", None)
             h._send(503, {"error": str(e)},
-                    extra_headers={"Retry-After": "1"})
+                    extra_headers={"Retry-After":
+                                   f"{retry:.1f}" if retry else "1"})
             return
         except Exception as e:
             h._send(500, {"error": str(e)})
             return
         h._send(200, result, extra_headers=_timing_header(result))
+
+    def _send_sse(self, h, events) -> None:
+        """Stream SSE events over a chunked HTTP/1.1 response. The
+        predictor already validated and submitted before handing us
+        the iterator, so admission failures never reach this path —
+        once headers go out, mid-stream failures ride the in-band
+        ``event: error`` frame. A client hangup just ends the relay
+        (the engine request completes on its own)."""
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-store")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+        h._last_code = 200
+
+        def chunk(data: bytes) -> bytes:
+            return b"%x\r\n%s\r\n" % (len(data), data)
+
+        try:
+            for ev in events:
+                h.wfile.write(chunk(ev))
+                h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+            h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # Leave the connection unterminated (no final chunk): the
+            # router sees a truncated stream, which is the trigger for
+            # mid-stream recovery. shutdown(), not just close() — the
+            # handler's rfile/wfile still hold the socket's io
+            # refcount, so a bare close() would never send FIN.
+            try:
+                h.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        h.close_connection = True
 
     # -- flight recorder ----------------------------------------------------
     def _maybe_snapshot_flight(self) -> None:

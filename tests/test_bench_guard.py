@@ -150,6 +150,18 @@ class TestBoxGuard:
                     "lm_adapters_sep_engines_hbm_ratio"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_qos_keys_in_contract(self):
+        """The request-plane acceptance numbers (ISSUE 17: interactive
+        p99 ITL with a batch flood <= 1.5x no-flood, deadline sheds >
+        0 with ZERO post-prefill deadline timeouts) ride the compact
+        BENCH_CONTRACT line; pinned like the paged-KV keys."""
+        for key in ("lm_qos_interactive_itl_p99_ms",
+                    "lm_qos_interactive_itl_p99_flood_ms",
+                    "lm_qos_flood_ratio", "lm_qos_batch_served",
+                    "lm_qos_deadline_shed",
+                    "lm_qos_deadline_timeouts"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_lm_mfu_keys_in_contract(self):
         """The training-MFU acceptance numbers (ISSUE 8: lm_best_mfu >=
         0.60, lm_long_mfu >= 0.45, no step-time-variance regression)

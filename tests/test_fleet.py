@@ -376,7 +376,8 @@ class TestRouterRecovery:
             assert body["generated_tokens"] == [[7, 8, 9]]
             assert dead.hits == 1  # it really held the request first
             assert reg.counter("kfx_router_recoveries_total").value(
-                namespace="ns", isvc="svc", revision="default") == 1
+                namespace="ns", isvc="svc", revision="default",
+                mode="buffered") == 1
         finally:
             router.stop()
             dead.stop()
@@ -432,6 +433,284 @@ class TestRouterRecovery:
                            event="readmit") == 0
         finally:
             router.stop()
+
+
+class _StubStreamLM(threading.Thread):
+    """Scripted SSE backend: :generate streams one token frame per
+    entry of ``tokens`` (honoring ``stream_skip`` in the body) and a
+    terminal done frame. ``die_after=N`` severs the socket after N
+    token frames — what a SIGKILL'd replica looks like to the router
+    mid-stream (shutdown() first: rfile/wfile hold the socket's io
+    refcount, so a bare close() would never send FIN). ``status``
+    short-circuits with a buffered JSON answer (pre-stream shed)."""
+
+    def __init__(self, tokens, die_after=None, status=None,
+                 retry_after=None):
+        super().__init__(daemon=True)
+        stub = self
+        self.bodies = []
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                stub.bodies.append(body)
+                if status is not None:
+                    payload = json.dumps(
+                        {"error": "scripted shed"}).encode()
+                    self.send_response(status)
+                    if retry_after is not None:
+                        self.send_header("Retry-After", retry_after)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()  # HTTP/1.0: close-delimited body
+                skip = int(body.get("stream_skip") or 0)
+                sent = 0
+                for i, t in enumerate(tokens):
+                    if i < skip:
+                        continue
+                    frame = ("data: " + json.dumps(
+                        {"index": i, "token": t}) + "\n\n").encode()
+                    self.wfile.write(frame)
+                    self.wfile.flush()
+                    sent += 1
+                    if die_after is not None and sent >= die_after:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                        self.connection.close()
+                        return
+                done = ("data: " + json.dumps(
+                    {"done": True, "n_tokens": len(tokens)})
+                    + "\n\n").encode()
+                self.wfile.write(done)
+                self.wfile.flush()
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        self.start()
+
+    def run(self):
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _post_sse(port, path, payload, timeout=30.0):
+    """POST and read the full SSE response; returns (status, events)
+    where each event is (is_error_frame, parsed_json)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        data = json.dumps(payload).encode()
+        conn.request("POST", path, body=data,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        if "text/event-stream" not in resp.getheader(
+                "Content-Type", ""):
+            return resp.status, json.loads(raw)
+        events = []
+        for seg in raw.split(b"\n\n"):
+            if b"data: " in seg:
+                events.append((b"event: error" in seg, json.loads(
+                    seg.split(b"data: ", 1)[1])))
+        return resp.status, events
+    finally:
+        conn.close()
+
+
+class TestRouterStreaming:
+    def _router(self):
+        from kubeflow_tpu.obs.metrics import MetricsRegistry
+        from kubeflow_tpu.serving.router import Router
+
+        reg = MetricsRegistry()
+        router = Router(metrics=reg, name="svc",
+                        namespace="ns").start()
+        return router, reg
+
+    def _recoveries(self, reg, mode):
+        return reg.counter("kfx_router_recoveries_total").value(
+            namespace="ns", isvc="svc", revision="default", mode=mode)
+
+    GEN = "/v1/models/m:generate"
+
+    def test_stream_passthrough(self):
+        """Healthy backend: the router relays the SSE stream as-is —
+        every token frame in order, the done frame, zero recoveries
+        (both mode samples stay at their seeded zero)."""
+        stub = _StubStreamLM([7, 8, 9, 10])
+        router, reg = self._router()
+        try:
+            router.default.set_endpoints([f"127.0.0.1:{stub.port}"])
+            status, events = _post_sse(
+                router.port, self.GEN,
+                {"prompt_tokens": [[1, 2]], "max_new_tokens": 4,
+                 "stream": True})
+            assert status == 200
+            toks = [e for err, e in events if "token" in e]
+            assert [e["token"] for e in toks] == [7, 8, 9, 10]
+            assert [e["index"] for e in toks] == [0, 1, 2, 3]
+            assert events[-1][1]["done"] is True
+            assert self._recoveries(reg, "buffered") == 0
+            assert self._recoveries(reg, "mid_stream") == 0
+        finally:
+            router.stop()
+            stub.stop()
+
+    def test_mid_stream_recovery_byte_identical(self):
+        """The backend dies after 2 streamed tokens: the router
+        re-dispatches with stream_skip raised by the 2 frames the
+        client already holds, the peer resumes at index 2, and the
+        client's concatenated stream is byte-identical to an
+        uninterrupted run — counted once as mode="mid_stream"."""
+        dying = _StubStreamLM([7, 8, 9, 10], die_after=2)
+        healthy = _StubStreamLM([7, 8, 9, 10])
+        router, reg = self._router()
+        try:
+            # Round-robin index 0: the dying backend streams first.
+            router.default.set_endpoints(
+                [f"127.0.0.1:{dying.port}",
+                 f"127.0.0.1:{healthy.port}"])
+            status, events = _post_sse(
+                router.port, self.GEN,
+                {"prompt_tokens": [[1, 2]], "max_new_tokens": 4,
+                 "stream": True})
+            assert status == 200
+            assert not any(err for err, _ in events)
+            toks = [e for _, e in events if "token" in e]
+            # Exactly once each, in order: no duplicates, no gap at
+            # the failover seam.
+            assert [e["index"] for e in toks] == [0, 1, 2, 3]
+            assert [e["token"] for e in toks] == [7, 8, 9, 10]
+            assert events[-1][1]["done"] is True
+            assert self._recoveries(reg, "mid_stream") == 1
+            assert self._recoveries(reg, "buffered") == 0
+            # The resume really was a skip re-dispatch, not a replay.
+            assert healthy.bodies[-1]["stream_skip"] == 2
+        finally:
+            router.stop()
+            dying.stop()
+            healthy.stop()
+
+    def test_stream_cut_chaos_is_deterministic_mid_stream(self):
+        """chaos router.stream_cut severs the relay after the first
+        token reached the client — the deterministic stand-in for the
+        e2e's replica.kill — and recovery must resume with skip >= 1
+        and count as mid_stream."""
+        a = _StubStreamLM([3, 4, 5])
+        b = _StubStreamLM([3, 4, 5])
+        router, reg = self._router()
+        chaos.install(chaos.parse_spec(
+            "seed=3;router.stream_cut:count=1"))
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"])
+            status, events = _post_sse(
+                router.port, self.GEN,
+                {"prompt_tokens": [[1]], "max_new_tokens": 3,
+                 "stream": True})
+            assert status == 200
+            toks = [e for _, e in events if "token" in e]
+            assert [e["token"] for e in toks] == [3, 4, 5]
+            assert [e["index"] for e in toks] == [0, 1, 2]
+            assert self._recoveries(reg, "mid_stream") == 1
+            retried = (a.bodies + b.bodies)[-1]
+            assert retried["stream_skip"] >= 1
+        finally:
+            chaos.install(None)
+            router.stop()
+            a.stop()
+            b.stop()
+
+    def test_pre_token_death_is_buffered_mode(self):
+        """A backend that dies BEFORE any token frame reached the
+        client is the buffered special case: same recovery, counted
+        as mode="buffered", and the peer serves from token 0 with no
+        skip."""
+        dead = _DeadOnRequest()
+        healthy = _StubStreamLM([6, 7])
+        router, reg = self._router()
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{dead.port}",
+                 f"127.0.0.1:{healthy.port}"])
+            status, events = _post_sse(
+                router.port, self.GEN,
+                {"prompt_tokens": [[1]], "max_new_tokens": 2,
+                 "stream": True})
+            assert status == 200
+            toks = [e for _, e in events if "token" in e]
+            assert [e["token"] for e in toks] == [6, 7]
+            assert self._recoveries(reg, "buffered") == 1
+            assert self._recoveries(reg, "mid_stream") == 0
+            assert not healthy.bodies[-1].get("stream_skip")
+        finally:
+            router.stop()
+            dead.stop()
+            healthy.stop()
+
+    def test_pre_stream_shed_relays_buffered(self):
+        """A 400 from the backend (validation, before any SSE bytes)
+        relays to the client as a plain buffered response — no retry,
+        no recovery."""
+        shedding = _StubStreamLM([], status=400)
+        router, reg = self._router()
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{shedding.port}"])
+            status, body = _post_sse(
+                router.port, self.GEN,
+                {"prompt_tokens": [[1]], "stream": True})
+            assert status == 400
+            assert body["error"] == "scripted shed"
+            assert len(shedding.bodies) == 1  # no blind retry on 4xx
+            assert self._recoveries(reg, "buffered") == 0
+            assert self._recoveries(reg, "mid_stream") == 0
+        finally:
+            router.stop()
+            shedding.stop()
+
+    def test_retry_after_honored_with_jitter(self):
+        """A 503 + Retry-After: 0.3 shed: the bounded retry waits the
+        decorrelated jitter (>= 0.5 x advertised) before the peer
+        dispatch instead of re-slamming the overloaded fleet — and a
+        response-level shed is NOT an in-flight recovery."""
+        shedding = _StubStreamLM([], status=503, retry_after="0.3")
+        healthy = _StubLM([4, 5, 6])
+        router, reg = self._router()
+        try:
+            router.default.set_endpoints(
+                [f"127.0.0.1:{shedding.port}",
+                 f"127.0.0.1:{healthy.port}"])
+            t0 = time.perf_counter()
+            status, body = _post_json(
+                f"http://127.0.0.1:{router.port}{self.GEN}",
+                {"prompt_tokens": [[1, 2]], "max_new_tokens": 3})
+            elapsed = time.perf_counter() - t0
+            assert status == 200
+            assert body["generated_tokens"] == [[4, 5, 6]]
+            assert elapsed >= 0.14  # 0.5 x 0.3, minus clock slack
+            samples = dict(
+                (tuple(sorted(lab.items())), v) for lab, v in
+                reg.counter("kfx_router_recoveries_total").samples())
+            assert all(v == 0 for v in samples.values())
+        finally:
+            router.stop()
+            shedding.stop()
+            healthy.stop()
 
 
 # -- router: prefix-affinity routing ------------------------------------------
@@ -747,6 +1026,28 @@ def _replica_ports(home):
     return sorted(set(ports))
 
 
+def _busy_replica_port(home, timeout=30):
+    """Which replica holds the in-flight request right now? Polls each
+    replica's /metrics JSON for queue depth or slot occupancy — works
+    even while the engine loop is wedged (the HTTP threads live on)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for p in _replica_ports(home):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{p}/metrics?format=json",
+                        timeout=2) as r:
+                    eng = json.load(r).get("engine") or {}
+            except (OSError, ValueError):
+                continue
+            if any(row.get("queue_depth", 0) > 0
+                   or row.get("slot_occupancy", 0) > 0
+                   for row in eng.values()):
+                return p
+        time.sleep(0.1)
+    raise AssertionError("never saw the in-flight request on a replica")
+
+
 class TestFleetSelfHealingE2E:
     def test_kill_drain_wedge(self, lm_export, tmp_path, monkeypatch,
                               capsys):
@@ -826,27 +1127,8 @@ class TestFleetSelfHealingE2E:
             t = threading.Thread(
                 target=lambda: result.update(tokens=post()))
             t.start()
-            ports = _replica_ports(home)
-            assert len(ports) >= 2
-            busy = None
-            deadline = time.monotonic() + 30
-            while busy is None and time.monotonic() < deadline:
-                for p in ports:
-                    try:
-                        with urllib.request.urlopen(
-                                f"http://127.0.0.1:{p}/metrics"
-                                "?format=json", timeout=2) as r:
-                            eng = json.load(r).get("engine") or {}
-                    except (OSError, ValueError):
-                        continue
-                    if any(row.get("queue_depth", 0) > 0
-                           or row.get("slot_occupancy", 0) > 0
-                           for row in eng.values()):
-                        busy = p
-                        break
-                time.sleep(0.1)
-            assert busy is not None, \
-                "never saw the in-flight request on a replica"
+            assert len(_replica_ports(home)) >= 2
+            busy = _busy_replica_port(home)
             # SIGKILL exactly the replica holding the request.
             chaos.install(chaos.parse_spec(
                 f"replica.kill:count=1,match=/{busy}"))
@@ -986,3 +1268,97 @@ class TestFleetSelfHealingE2E:
                      "--require", "kfx_router_ejections_total",
                      "--require", "kfx_router_recoveries_total",
                      "--require", "kfx_serving_drain_seconds"]) == 0
+
+    def test_stream_mid_stream_recovery_e2e(self, lm_export, tmp_path,
+                                            monkeypatch):
+        """ISSUE 17 acceptance: SIGKILL the replica AFTER >= 1 token
+        event already reached the SSE client — the router re-dispatches
+        to the peer with ``stream_skip`` raised by the relayed count,
+        the peer regenerates from the same seed and suppresses the
+        prefix, and the client's concatenated stream is byte-identical
+        to the uninterrupted greedy reference, counted under
+        kfx_router_recoveries_total{mode="mid_stream"}.
+
+        Determinism: the replicas inherit an engine.wedge budget over a
+        shared state file (count=1, after=3) — with 4-token engine
+        chunks the streaming request's replica freezes mid-decode with
+        8-12 of its 32 tokens already relayed, holding the stream open
+        for 20s while the client finds the busy port and installs the
+        seeded replica.kill. The wedge count is consumed, so neither
+        the peer nor the respawn ever stalls."""
+        import http.client
+
+        from kubeflow_tpu.controlplane import ControlPlane
+
+        home = str(tmp_path / "kfx")
+        state = str(tmp_path / "chaos-stream.json")
+        monkeypatch.setenv("KFX_LM_ENGINE_CHUNK", "4")
+        monkeypatch.setenv(
+            "KFX_CHAOS",
+            f"state={state};engine.wedge:count=1,delay=20,after=3")
+
+        with ControlPlane(home=home) as cp:
+            cp.apply_text(MANIFEST.format(n=2, quant="",
+                                          export=lm_export))
+            cp.wait_for_condition("InferenceService", "fleet", "Ready",
+                                  timeout=240)
+            url = cp.store.get("InferenceService", "fleet").status["url"]
+            host, port = url.split("//", 1)[1].rsplit(":", 1)
+            body = json.dumps({"prompt_tokens": [[5, 9, 11, 3, 7]],
+                               "max_new_tokens": 32, "seed": 0,
+                               "stream": True}).encode()
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=120)
+            events, killed, lines = [], False, []
+            try:
+                conn.request("POST", "/v1/models/fleet:generate",
+                             body=body,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert "text/event-stream" in resp.getheader(
+                    "Content-Type", "")
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    lines.append(line)
+                    if line not in (b"\n", b"\r\n"):
+                        continue
+                    for ln in b"".join(lines).splitlines():
+                        if ln.startswith(b"data: "):
+                            events.append(json.loads(ln[6:]))
+                    lines = []
+                    if events and events[-1].get("done"):
+                        break
+                    if not killed and any("token" in e
+                                          for e in events):
+                        # >= 1 token is client-visible and the holder
+                        # is wedged: SIGKILL exactly that replica.
+                        busy = _busy_replica_port(home)
+                        chaos.install(chaos.parse_spec(
+                            f"replica.kill:count=1,match=/{busy}"))
+                        killed = True
+            finally:
+                chaos.install(None)
+                conn.close()
+            assert killed, "no token event ever reached the client"
+            tokens = [e["token"] for e in events if "token" in e]
+            indices = [e["index"] for e in events if "token" in e]
+            # Zero duplicates, zero gaps across the splice point.
+            assert indices == list(range(32)), events
+            assert events[-1].get("done")
+            assert events[-1]["n_tokens"] == 32
+            # Byte-identical to an uninterrupted greedy run (same
+            # seed, buffered, served by the surviving replica).
+            ref = _post_json(
+                f"{url}/v1/models/fleet:generate",
+                {"prompt_tokens": [[5, 9, 11, 3, 7]],
+                 "max_new_tokens": 32, "seed": 0},
+                timeout=60)[1]["generated_tokens"][0]
+            assert tokens == ref
+            assert sum(
+                int(v) for labels, v in cp.metrics.counter(
+                    "kfx_router_recoveries_total").samples()
+                if labels.get("mode") == "mid_stream") >= 1

@@ -151,7 +151,7 @@ class TestKfxVerbs:
                 "kvUtil": 0.42, "prefillSkip": 0.63,
                 "specAcceptRate": 0.87,
                 "quant": "w8+kv8", "adapters": "3/8",
-                "restarts": 3}},
+                "classes": "2/1", "restarts": 3}},
         }
         clf = InferenceService.from_dict({
             "metadata": {"name": "clf", "namespace": "default"},
@@ -173,13 +173,17 @@ class TestKfxVerbs:
         # (multi-tenant LoRA revisions; "-" when the engine has no
         # adapter pool).
         assert rows[0][10] == "3/8"
+        # I/B column: the in-flight QoS-class split (request plane) as
+        # interactive/batch; "-" on classifier revisions.
+        assert rows[0][11] == "2/1"
         # RESTARTS column, fed from the operator's restart accounting
         # (same number kfx_replica_restarts_total counts).
-        assert rows[0][11] == "3"
+        assert rows[0][12] == "3"
         assert rows[1][6] == "-" and rows[1][7] == "-"
         assert rows[1][8] == "-" and rows[1][9] == "-"
         assert rows[1][10] == "-"  # no adapter pool sampled
-        assert rows[1][11] == "-"  # operator never reported restarts
+        assert rows[1][11] == "-"  # no request-plane classes sampled
+        assert rows[1][12] == "-"  # operator never reported restarts
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main

@@ -503,6 +503,10 @@ class TestAttentionImplParity:
 
         return float(loss), _jax.tree.map(np.asarray, grads)
 
+    # Heaviest parity soak in tier-1 (~15s): the same loss+grad oracle
+    # runs per-impl in the faster sharded-training legs; the full
+    # three-impl cross-check rides tier-2.
+    @pytest.mark.slow
     def test_flash_and_ring_match_naive(self):
         import dataclasses
 

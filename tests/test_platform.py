@@ -118,6 +118,10 @@ class TestNotebook:
               .has_condition("Culled", "False"), timeout=30,
               what="restart after spec change")
 
+    # ~8s wall-clock idle soak: the cull/survive decision logic is
+    # already covered by the faster culling legs above — the real-time
+    # idle-window ride-through moves to tier-2.
+    @pytest.mark.slow
     def test_busy_silent_notebook_survives_idle_window(self, cp):
         """A kernel computing flat-out but writing NOTHING must not be
         culled (the old log-mtime proxy would have killed it): the

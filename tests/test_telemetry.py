@@ -463,13 +463,13 @@ class TestTopWatchRates:
         # Window rates fill TOK/S + RPS, and the WINDOW skip replaces
         # the cumulative status snapshot.
         assert rows[0][7] == "25%"
-        # TOK/S + RPS sit after the ADPT column (index 10).
-        assert rows[0][12] == "12.3" and rows[0][13] == "4.5"
+        # TOK/S + RPS sit after the ADPT and I/B columns (10, 11).
+        assert rows[0][13] == "12.3" and rows[0][14] == "4.5"
         # Without history the snapshot and "-" cells remain.
         rows = _serving_top_rows(
             [isvc], rates_fn=lambda ns, name, rev: (None, None, None))
         assert rows[0][7] == "90%"
-        assert rows[0][12] == "-" and rows[0][13] == "-"
+        assert rows[0][13] == "-" and rows[0][14] == "-"
 
     def test_top_watch_single_shot(self, tmp_path, capsys):
         from kubeflow_tpu.cli import KfxCLI
