@@ -71,6 +71,7 @@ CONTRACT_KEYS = (
     "obs_scrape_ms", "obs_rule_eval_ms", "obs_tsdb_window_samples",
     "obs_engine_tokens_per_s", "obs_engine_tokens_delta_frac",
     "obs_flightrec_tokens_delta_frac",
+    "obs_slo_eval_ms", "obs_slo_tokens_delta_frac",
     "cpu_count", "host_speed_score", "load_avg_max",
     "contaminated_sections", "sections_skipped_for_budget",
     "bench_wall_s")
@@ -856,6 +857,26 @@ def _bench_obs_overhead() -> dict:
         for i in range(reps):
             rules.evaluate(now=now + i * 0.06)
         rule_ms = (time.perf_counter() - t0) * 1000.0 / reps
+        # (b2) a 16-SLO pack (burn rates + budgets, ISSUE 18) over the
+        # same 10k-deep store — the error-budget cost a plane pays per
+        # scrape cycle once SLOs are declared fleet-wide.
+        from kubeflow_tpu.api.base import from_manifest
+        from kubeflow_tpu.obs.slo import SLOEngine
+
+        slo_eng = SLOEngine(tsdb)
+        for i in range(16):
+            slo_eng.ensure(from_manifest({
+                "apiVersion": "obs.kubeflow.org/v1alpha1",
+                "kind": "SLO",
+                "metadata": {"name": f"bench-{i}",
+                             "namespace": "default"},
+                "spec": {"objective": "error-rate", "target": 0.99,
+                         "windowSeconds": 300,
+                         "selector": {"isvc": "fleet"}}}))
+        t0 = time.perf_counter()
+        for i in range(reps):
+            slo_eng.evaluate(now=now + i * 0.06)
+        slo_ms = (time.perf_counter() - t0) * 1000.0 / reps
         # (c) engine tokens/s, unscraped vs under a live scrape loop.
         cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=2,
                                 head_dim=32, n_layers=2, d_ff=128,
@@ -895,7 +916,20 @@ def _bench_obs_overhead() -> dict:
             flight_on = max(flight_on, leg())
         flight_delta = max(0.0, (flight_off - flight_on) / flight_off) \
             if flight_off > 0 else 0.0
-        base = max(flight_off, flight_on)
+        # (e) tenant-ledger tax (ISSUE 18 acceptance <= 2%): the same
+        # engine with the usage ledger detached vs attached — the
+        # billing hooks are one dict update at admission and one at
+        # finish, so this bounds the metering vertical's hot-path cost.
+        ledger = eng.usage
+        meter_off = meter_on = 0.0
+        for _ in range(8):
+            eng.usage = None
+            meter_off = max(meter_off, leg())
+            eng.usage = ledger
+            meter_on = max(meter_on, leg())
+        meter_delta = max(0.0, (meter_off - meter_on) / meter_off) \
+            if meter_off > 0 else 0.0
+        base = max(flight_off, flight_on, meter_off, meter_on)
         live_tsdb = TSDB()
         scraper = CentralScraper(
             live_tsdb, reg, interval_s=0.25,
@@ -914,6 +948,9 @@ def _bench_obs_overhead() -> dict:
             prefix + "flightrec_tokens_per_s": round(flight_on, 1),
             prefix + "flightrec_tokens_delta_frac":
                 round(flight_delta, 4),
+            prefix + "slo_eval_ms": round(slo_ms, 3),
+            prefix + "slo_tokens_per_s": round(meter_on, 1),
+            prefix + "slo_tokens_delta_frac": round(meter_delta, 4),
         }
     except Exception as e:  # secondary metric must not sink the bench
         return {prefix + "error": str(e)[:200]}

@@ -25,6 +25,7 @@ from .manifest import dump_manifest, load_manifest_file, load_manifests  # noqa:
 from .pipelines import Pipeline  # noqa: F401
 from .platform import Notebook, PodDefault, Profile  # noqa: F401
 from .serving import InferenceService  # noqa: F401
+from .slo import SLO  # noqa: F401
 from .training import (  # noqa: F401
     JAXJob,
     MPIJob,

@@ -1,0 +1,124 @@
+"""SLO resource: a service-level objective as a first-class platform
+object (docs/observability.md §"SLOs and usage metering").
+
+An SLO names an objective over a metric selector and a compliance
+window; the SLO controller compiles it into multi-window multi-burn-rate
+alert rules (the SRE-workbook policy) and the SLO engine writes
+``status.{budgetRemaining, burnRateFast, burnRateSlow}`` back every
+scrape cycle. Example:
+
+    apiVersion: obs.kubeflow.org/v1alpha1
+    kind: SLO
+    metadata: {name: chat-availability, namespace: team-a}
+    spec:
+      objective: error-rate          # error-rate|latency|availability
+      target: 0.99                   # good fraction over the window
+      windowSeconds: 3600
+      selector: {isvc: chat, tenant: acme}   # optional narrowing
+      # latency objectives additionally take:
+      # latency: {percentile: 99, thresholdMs: 500}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .base import Resource, ValidationError, register
+
+SLO_READY = "Ready"
+SLO_BUDGET_HEALTHY = "BudgetHealthy"
+
+OBJECTIVES = ["error-rate", "latency", "availability"]
+SELECTOR_KEYS = ["namespace", "isvc", "revision", "tenant"]
+
+# windowSeconds bounds: at least one coarse TSDB bucket past the fine
+# horizon makes sense; the ceiling is the coarse ring's retention.
+WINDOW_MIN_S = 60
+WINDOW_MAX_S = 86400
+
+
+@register
+class SLO(Resource):
+    KIND = "SLO"
+    API_VERSION = "obs.kubeflow.org/v1alpha1"
+    PLURAL = "slos"
+
+    # -- spec accessors ----------------------------------------------------
+    def objective(self) -> str:
+        return str(self.spec.get("objective", ""))
+
+    def target(self) -> float:
+        return float(self.spec.get("target", 0.0))
+
+    def window_seconds(self) -> float:
+        return float(self.spec.get("windowSeconds", 3600))
+
+    def selector(self) -> Dict[str, str]:
+        sel = self.spec.get("selector") or {}
+        return {k: str(v) for k, v in sel.items()}
+
+    def latency(self) -> Dict[str, Any]:
+        return self.spec.get("latency") or {}
+
+    def latency_percentile(self) -> int:
+        return int(self.latency().get("percentile", 99))
+
+    def latency_threshold_s(self) -> float:
+        return float(self.latency().get("thresholdMs", 0.0)) / 1000.0
+
+    # -- validation --------------------------------------------------------
+    def validate(self) -> None:
+        super().validate()
+        if self.objective() not in OBJECTIVES:
+            raise ValidationError("spec.objective",
+                                  f"one of {OBJECTIVES} required")
+        target = self.spec.get("target")
+        if isinstance(target, bool) or not isinstance(target, (int, float)):
+            raise ValidationError("spec.target", "a number is required")
+        if not 0.0 < float(target) < 1.0:
+            raise ValidationError("spec.target",
+                                  "must be in (0, 1) — the good fraction")
+        win = self.spec.get("windowSeconds", 3600)
+        if isinstance(win, bool) or not isinstance(win, (int, float)) \
+                or not WINDOW_MIN_S <= float(win) <= WINDOW_MAX_S:
+            raise ValidationError(
+                "spec.windowSeconds",
+                f"must be in [{WINDOW_MIN_S}, {WINDOW_MAX_S}]")
+        sel = self.spec.get("selector")
+        if sel is not None:
+            if not isinstance(sel, dict):
+                raise ValidationError("spec.selector", "must be a mapping")
+            for k, v in sel.items():
+                if k not in SELECTOR_KEYS:
+                    raise ValidationError(
+                        f"spec.selector.{k}",
+                        f"unknown key (one of {SELECTOR_KEYS})")
+                if not isinstance(v, str) or not v:
+                    raise ValidationError(f"spec.selector.{k}",
+                                          "a non-empty string is required")
+        if self.objective() == "latency":
+            lat = self.spec.get("latency")
+            if not isinstance(lat, dict):
+                raise ValidationError(
+                    "spec.latency",
+                    "required for a latency objective "
+                    "({percentile, thresholdMs})")
+            pct = lat.get("percentile", 99)
+            if isinstance(pct, bool) or not isinstance(pct, int) \
+                    or pct not in (50, 90, 99):
+                raise ValidationError("spec.latency.percentile",
+                                      "one of 50, 90, 99")
+            thr = lat.get("thresholdMs")
+            if isinstance(thr, bool) or not isinstance(thr, (int, float)) \
+                    or float(thr) <= 0:
+                raise ValidationError("spec.latency.thresholdMs",
+                                      "a positive number is required")
+        elif self.spec.get("latency") is not None:
+            raise ValidationError(
+                "spec.latency",
+                f"only valid for a latency objective "
+                f"(got {self.objective()!r})")
+
+    # -- (de)serialisation helpers ----------------------------------------
+    def spec_to_dict(self) -> Dict[str, Any]:
+        return dict(self.spec)

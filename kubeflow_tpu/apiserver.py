@@ -38,6 +38,8 @@ Routes:
   GET    /metrics[?format=json]                   registry render/snapshot
   GET    /query?family=&fn=&labels=&since=        telemetry window query
   GET    /alerts                                  alert-rule states
+  GET    /slos                                    SLOs + generated rule states
+  GET    /usage?tenant=&window=                   per-tenant usage summary
   GET    /apis                                    registered kinds
   GET    /apis/{kind}[?namespace=ns]              list (JSON)
   GET    /apis/{kind}/{ns}/{name}                 object (JSON)
@@ -354,6 +356,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._query(q)
             if url.path == "/alerts":
                 return self._json(200, {"alerts": self.cp.alerts.states()})
+            if url.path == "/slos":
+                return self._json(200, {"slos": self._slos()})
+            if url.path == "/usage":
+                return self._usage(q)
             if not parts:  # dashboard root
                 return self._html(200, self._dashboard())
             if parts == ["ui", "notebooks"]:
@@ -393,6 +399,30 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._error(400, str(e))
         return self._json(200, res.to_dict())
+
+    def _slos(self) -> List[dict]:
+        """GET /slos — every SLO object's spec + evaluated status,
+        joined with the live states of its generated burn rules (one
+        payload so `kfx slo` renders budget AND alert state from a
+        single snapshot, no torn read between two endpoints)."""
+        from .obs.slo import slo_snapshot
+
+        return slo_snapshot(self.cp.store, self.cp.alerts)
+
+    def _usage(self, q) -> None:
+        """GET /usage?tenant=&window=3600 — the fleet-aggregated
+        per-tenant token/request summary (obs/slo.usage_summary)."""
+        from .obs.slo import usage_summary
+
+        tenant = (q.get("tenant") or [""])[0] or None
+        try:
+            window = float((q.get("window") or ["3600"])[0])
+        except ValueError:
+            return self._error(400, "window must be a number (seconds)")
+        rows = usage_summary(self.cp.telemetry, window_s=window,
+                             tenant=tenant)
+        return self._json(200, {"usage": rows,
+                                "windowSeconds": window})
 
     def _get_apis(self, parts: List[str], q) -> None:
         if not parts:
@@ -1168,6 +1198,19 @@ class Client:
     def alerts(self) -> List[dict]:
         """Live alert-rule states (GET /alerts)."""
         return self._json("/alerts")["alerts"]
+
+    def slos(self) -> List[dict]:
+        """SLO objects + their generated rule states (GET /slos)."""
+        return self._json("/slos")["slos"]
+
+    def usage(self, tenant: Optional[str] = None,
+              window_s: float = 3600.0) -> List[dict]:
+        """Per-tenant usage summary (GET /usage) — `kfx usage` remote."""
+        from urllib.parse import quote
+
+        return self._json(
+            f"/usage?window={window_s:g}"
+            f"&tenant={quote(tenant or '')}")["usage"]
 
 
 SERVER_MARKER = "server.json"

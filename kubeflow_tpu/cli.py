@@ -225,7 +225,8 @@ class KfxCLI:
 
     def trace(self, kind: str, name: str, namespace: str,
               fmt: str = "ascii", output: str = "",
-              since_s: float = 0.0, min_ms: float = 0.0) -> int:
+              since_s: float = 0.0, min_ms: float = 0.0,
+              tenant: str = "") -> int:
         """Cross-process timeline reconstruction (`kfx trace <job>`):
         merge the span logs of the control plane and every gang replica
         for this job's trace ID into one tree; render an ASCII
@@ -259,12 +260,14 @@ class KfxCLI:
             self.cp.home, "serving", "*", SPANS_DIRNAME)))
         spans = timeline.load_spans(timeline.span_files(dirs), trace_id)
         spans = timeline.filter_spans(spans, since_s=since_s,
-                                      min_duration_s=min_ms / 1000.0)
+                                      min_duration_s=min_ms / 1000.0,
+                                      tenant=tenant)
         if not spans:
             print(f"error: no spans recorded for trace {trace_id} "
                   f"(searched {', '.join(dirs)}"
-                  + (f"; --since/--min-ms filtered everything out"
-                     if since_s or min_ms else "") + ")",
+                  + (f"; --since/--min-ms/--tenant filtered "
+                     f"everything out"
+                     if since_s or min_ms or tenant else "") + ")",
                   file=sys.stderr)
             return 1
         if fmt == "chrome":
@@ -345,6 +348,17 @@ class KfxCLI:
             return 2
         return _print_query(res.to_dict(), as_json=as_json)
 
+    def _passive_rule_note(self) -> None:
+        # A passive (read-only) plane never scrapes or evaluates:
+        # rendering every rule as "inactive" would read as a green
+        # fleet during an incident the OWNING server sees. Applies
+        # equally to SLO-generated burn rules (same engine).
+        if self.cp.alerts.last_eval == 0:
+            print("note: rules have never been evaluated in this "
+                  "process (passive plane) — run inside `kfx server` "
+                  "or set KFX_SERVER to query the live plane",
+                  file=sys.stderr)
+
     def alerts(self, as_json: bool = False) -> int:
         """Alert-rule states (`kfx alerts`): the rule pack with each
         rule's live state/value — transitions land as kind=Alert store
@@ -352,15 +366,32 @@ class KfxCLI:
         right now" view. ``--json`` emits the raw state list (rc still
         1 while anything fires — same scriptable health-check
         contract)."""
-        if self.cp.alerts.last_eval == 0:
-            # A passive (read-only) plane never scrapes or evaluates:
-            # rendering every rule as "inactive" would read as a green
-            # fleet during an incident the OWNING server sees.
-            print("note: rules have never been evaluated in this "
-                  "process (passive plane) — run inside `kfx server` "
-                  "or set KFX_SERVER to query the live plane",
-                  file=sys.stderr)
+        self._passive_rule_note()
         return _print_alerts(self.cp.alerts.states(), as_json=as_json)
+
+    def slo(self, as_json: bool = False) -> int:
+        """Error-budget dashboard (`kfx slo`): every SLO's remaining
+        budget, fast/slow burn rates, and its generated burn rules'
+        live states (same renderer as `kfx alerts`). rc 1 while any
+        SLO's fast-burn rule fires — the page-now signal, scriptable
+        like a health check (same rc with ``--json``)."""
+        from .obs.slo import slo_snapshot
+
+        self._passive_rule_note()
+        return _print_slos(slo_snapshot(self.cp.store, self.cp.alerts),
+                           as_json=as_json)
+
+    def usage(self, tenant: str = "", window: float = 3600.0,
+              as_json: bool = False) -> int:
+        """Per-tenant usage (`kfx usage [--tenant T] [--window N]`):
+        the fleet-aggregated token ledger — window deltas (stitching
+        onto the downsampled tier for long windows) plus exact
+        cumulative totals, top consumers first."""
+        from .obs.slo import usage_summary
+
+        rows = usage_summary(self.cp.telemetry, window_s=window,
+                             tenant=tenant or None)
+        return _print_usage(rows, window, as_json=as_json)
 
     def postmortem(self, name: str, namespace: str,
                    bundle: str = "") -> int:
@@ -793,6 +824,20 @@ def _print_query(res: dict, as_json: bool = False) -> int:
     return 0
 
 
+def _alert_rows(states: List[dict]) -> List[List[str]]:
+    """Rule states -> table rows: the ONE rule-state renderer, shared
+    by `kfx alerts` and the rules section of `kfx slo`."""
+    rows = []
+    for st in states:
+        val = st.get("value")
+        rows.append([st.get("name", ""), st.get("severity", ""),
+                     str(st.get("state", "")),
+                     f"{val:.4g}" if isinstance(val, (int, float))
+                     else "-",
+                     st.get("expr", "")])
+    return rows
+
+
 def _print_alerts(states: List[dict], as_json: bool = False) -> int:
     """Render the rule states (shared by local and remote `kfx
     alerts`). rc 1 while anything is firing — scriptable like a
@@ -802,19 +847,92 @@ def _print_alerts(states: List[dict], as_json: bool = False) -> int:
         print(json.dumps({"alerts": states, "firing": firing},
                          indent=1))
         return 1 if firing else 0
-    rows = []
-    for st in states:
-        val = st.get("value")
-        rows.append([st.get("name", ""), st.get("severity", ""),
-                     str(st.get("state", "")),
-                     f"{val:.4g}" if isinstance(val, (int, float))
-                     else "-",
-                     st.get("expr", "")])
+    rows = _alert_rows(states)
     if not rows:
         print("no alert rules loaded")
         return 0
     _print_table(rows, ["RULE", "SEVERITY", "STATE", "VALUE", "EXPR"])
     return 1 if firing else 0
+
+
+def _print_slos(slos: List[dict], as_json: bool = False) -> int:
+    """Render the /slos payload (shared by local and remote `kfx
+    slo`): budget table with burn arrows, then the generated rules
+    through the same renderer `kfx alerts` uses. rc 1 while any
+    fast-burn rule fires."""
+    from .obs.slo import FAST_BURN_THRESHOLD, SLOW_BURN_THRESHOLD
+
+    paging = sum(1 for s in slos for st in s.get("rules", [])
+                 if st.get("state") == "firing"
+                 and st.get("name", "").endswith("-fast-burn"))
+    if as_json:
+        print(json.dumps({"slos": slos, "firingFast": paging},
+                         indent=1))
+        return 1 if paging else 0
+    if not slos:
+        print("no SLOs applied (kind: SLO)")
+        return 0
+
+    def _burn(v, threshold) -> str:
+        if not isinstance(v, (int, float)):
+            return "-"
+        return f"{v:.2f}" + ("▲" if v > threshold else "")
+
+    rows = []
+    for s in slos:
+        meta = s.get("metadata") or {}
+        spec = s.get("spec") or {}
+        st = s.get("status") or {}
+        budget = st.get("budgetRemaining")
+        rows.append([
+            f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
+            str(spec.get("objective", "")),
+            f"{spec.get('target', 0):g}",
+            f"{int(spec.get('windowSeconds', 3600))}s",
+            f"{budget:.4f}" if isinstance(budget, (int, float)) else "-",
+            _burn(st.get("burnRateFast"), FAST_BURN_THRESHOLD),
+            _burn(st.get("burnRateSlow"), SLOW_BURN_THRESHOLD),
+        ])
+    _print_table(rows, ["SLO", "OBJECTIVE", "TARGET", "WINDOW",
+                        "BUDGET", "BURN-FAST", "BURN-SLOW"])
+    rule_rows = _alert_rows([st for s in slos
+                             for st in s.get("rules", [])])
+    if rule_rows:
+        print()
+        _print_table(rule_rows, ["RULE", "SEVERITY", "STATE", "VALUE",
+                                 "EXPR"])
+    return 1 if paging else 0
+
+
+def _print_usage(rows: List[dict], window: float,
+                 as_json: bool = False) -> int:
+    """Render the /usage payload (shared by local and remote `kfx
+    usage`): top consumers over the window with a per-row sparkline
+    of token increases, plus the exact cumulative ledger totals."""
+    if as_json:
+        print(json.dumps({"usage": rows, "windowSeconds": window},
+                         indent=1))
+        return 0
+    if not rows:
+        print("no tenant usage recorded (kfx_tenant_tokens_total is "
+              "empty — is a model serving traffic?)")
+        return 1
+    table = []
+    for r in rows:
+        pts = [v for _, v in (r.get("points") or [])]
+        table.append([
+            r["tenant"], r["qos"], r["adapter"],
+            f"{r['windowTokens']:.0f}", f"{r['windowRequests']:.0f}",
+            f"{r['promptTokens']:.0f}", f"{r['generatedTokens']:.0f}",
+            f"{r['totalTokens']:.0f}",
+            _sparkline(pts, width=16) if pts else "",
+        ])
+    print(f"tenant usage over the last {window:g}s "
+          f"(totals are exact cumulative ledger counts):")
+    _print_table(table, ["TENANT", "QOS", "ADAPTER", f"TOK/{window:g}s",
+                         "REQS", "PROMPT", "GENERATED", "TOTAL",
+                         "TREND"])
+    return 0
 
 
 def _print_rollouts(isvcs) -> int:
@@ -978,6 +1096,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 = no time filter)")
     sp.add_argument("--min-ms", type=float, default=0.0,
                     help="drop spans shorter than this many ms")
+    sp.add_argument("--tenant", default="",
+                    help="only spans whose tenant attribute matches "
+                         "(router.dispatch / serving.generate stamp "
+                         "the billable tenant)")
 
     sp = sub.add_parser("top", help="live training telemetry (latest "
                                     "step/loss/throughput per job)")
@@ -1008,6 +1130,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the raw state list (rc still 1 while "
                          "anything fires)")
+
+    sp = sub.add_parser(
+        "slo", help="error-budget dashboard: every SLO's remaining "
+                    "budget, burn rates, and generated rule states")
+    sp.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw payload (rc still 1 while any "
+                         "fast-burn rule fires)")
+
+    sp = sub.add_parser(
+        "usage", help="per-tenant usage: fleet-aggregated token/"
+                      "request ledger, top consumers first")
+    sp.add_argument("--tenant", default="",
+                    help="only this tenant's rows")
+    sp.add_argument("--window", type=float, default=3600.0,
+                    help="trailing window in seconds (default 3600; "
+                         "long windows read the downsampled tier)")
+    sp.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw row list")
 
     sp = sub.add_parser(
         "postmortem", help="list an InferenceService's postmortem "
@@ -1114,7 +1254,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
         return 0
     _REMOTE_VERBS = ("apply", "run", "get", "describe", "delete", "logs",
                      "events", "top", "queue", "rollout", "query",
-                     "alerts")
+                     "alerts", "slo", "usage")
     if os.environ.get("KFX_SERVER") and args.cmd in _REMOTE_VERBS:
         return _remote_main(args)
     if os.environ.get("KFX_SERVER") and args.cmd in ("trace",
@@ -1170,7 +1310,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
     passive = args.cmd in ("get", "describe", "logs", "events", "profile",
                            "delete", "kill-replica", "top", "trace",
                            "queue", "rollout", "query", "alerts",
-                           "postmortem", "flight")
+                           "slo", "usage", "postmortem", "flight")
     try:
         plane = ControlPlane(home=args.home, journal=True, passive=passive)
     except HomeBusy:
@@ -1227,7 +1367,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
         if args.cmd == "trace":
             return cli.trace(args.kind, args.name, args.namespace,
                              args.format, args.output,
-                             since_s=args.since, min_ms=args.min_ms)
+                             since_s=args.since, min_ms=args.min_ms,
+                             tenant=args.tenant)
         if args.cmd == "top":
             return cli.top(watch=args.watch, window_s=args.window)
         if args.cmd == "query":
@@ -1235,6 +1376,11 @@ def _main(argv: Optional[List[str]] = None) -> int:
                              args.since, as_json=args.as_json)
         if args.cmd == "alerts":
             return cli.alerts(as_json=args.as_json)
+        if args.cmd == "slo":
+            return cli.slo(as_json=args.as_json)
+        if args.cmd == "usage":
+            return cli.usage(tenant=args.tenant, window=args.window,
+                             as_json=args.as_json)
         if args.cmd == "postmortem":
             return cli.postmortem(args.name, args.namespace,
                                   bundle=args.bundle)
@@ -1496,6 +1642,12 @@ def _remote_dispatch(client, args) -> int:
             return 1
     if args.cmd == "alerts":
         return _print_alerts(client.alerts(), as_json=args.as_json)
+    if args.cmd == "slo":
+        return _print_slos(client.slos(), as_json=args.as_json)
+    if args.cmd == "usage":
+        return _print_usage(client.usage(args.tenant or None,
+                                         args.window),
+                            args.window, as_json=args.as_json)
     if args.cmd == "queue":
         print(_remote_capacity_summary(client))
         running, queued = _slice_state(_remote_jobs(client))

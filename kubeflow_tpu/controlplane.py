@@ -128,16 +128,23 @@ class ControlPlane:
         # /metrics on KFX_OBS_INTERVAL seconds. Alert transitions land
         # as kind=Alert store events.
         from .obs.rules import RuleEngine, load_rules
+        from .obs.slo import SLOEngine
         from .obs.tsdb import TSDB, CentralScraper
 
         self.telemetry = TSDB()
         self.alerts = RuleEngine(self.telemetry, load_rules(),
                                  metrics=self.metrics,
                                  on_transition=self._record_alert_event)
+        # SLO plane (obs/slo.py): per-cycle budget/burn evaluation runs
+        # INSIDE the scrape cycle, after ingest and before the rule
+        # pass, so the generated burn alerts judge this cycle's numbers.
+        self.slos = SLOEngine(self.telemetry, self.metrics, self.store,
+                              self.alerts)
         self.scraper = CentralScraper(
             self.telemetry, self.metrics,
             interval_s=float(os.environ.get("KFX_OBS_INTERVAL", "1.0")),
-            targets=self._scrape_targets, rules=self.alerts)
+            targets=self._scrape_targets, rules=self.alerts,
+            slo=self.slos)
         self._register_controllers(worker_platform)
         for ctrl in self.manager.controllers.values():
             ctrl.metrics = self.metrics
@@ -182,6 +189,9 @@ class ControlPlane:
 
         for ctrl in platform_controllers(self.store, self.gangs):
             self.manager.register(ctrl)
+        from .operators.slo import SLOController
+
+        self.manager.register(SLOController(self.store, self.slos))
         # Wire quota + PodDefault admission into every workload controller.
         admission = PlatformAdmission(self.store, self.gangs)
         for ctrl in self.manager.controllers.values():

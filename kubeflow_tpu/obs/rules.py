@@ -159,6 +159,36 @@ class RuleEngine:
         with self._lock:
             return [st.rule for st in self._states.values()]
 
+    # -- dynamic pack membership (SLO-generated rules) -----------------------
+    def upsert_rule(self, rule: Rule) -> None:
+        """Add or replace a rule by name. A replaced rule KEEPS its
+        live alert state when the condition is unchanged (an SLO
+        resync must not silently resolve a firing burn alert); a
+        changed condition resets to inactive — the old judgement was
+        about a different predicate. New rules get their gauges seeded
+        like the constructor pack."""
+        with self._lock:
+            st = self._states.get(rule.name)
+            if st is not None and st.rule.expr() == rule.expr():
+                st.rule = rule  # refresh severity/summary in place
+                return
+            self._states[rule.name] = AlertState(rule)
+        if self.metrics is not None:
+            self.metrics.gauge("kfx_alerts_firing").set(0, rule=rule.name)
+            self.metrics.counter("kfx_alert_transitions_total").inc(
+                0, rule=rule.name, to=FIRING)
+
+    def remove_rule(self, name: str) -> bool:
+        """Drop a rule (deleted SLO). Zeroes the firing gauge so a
+        deleted SLO's alert cannot read as firing forever."""
+        with self._lock:
+            st = self._states.pop(name, None)
+        if st is None:
+            return False
+        if self.metrics is not None:
+            self.metrics.gauge("kfx_alerts_firing").set(0, rule=name)
+        return True
+
     def states(self) -> List[Dict]:
         with self._lock:
             return [st.to_dict() for st in self._states.values()]

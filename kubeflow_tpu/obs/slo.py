@@ -1,0 +1,360 @@
+"""SLO engine: error-budget accounting and multi-window multi-burn-rate
+alerting over the telemetry store (docs/observability.md §"SLOs and
+usage metering").
+
+Each applied ``kind: SLO`` (api/slo.py) compiles into two generated
+alert rules — the SRE-workbook pairs, scaled to the objective window W:
+
+    slo-<name>-fast-burn   burn > 14.4 over min(5m, W/12) AND min(1h, W)
+    slo-<name>-slow-burn   burn > 6    over min(30m, W/2) AND min(6h, W)
+
+where burn = bad-fraction / (1 - target). The AND-of-two-windows is
+evaluated as ``min(burn_short, burn_long) > threshold`` — one gauge
+sample per pair (``kfx_slo_burn_rate{slo,window=fast|slow}``), so the
+existing RuleEngine's ``latest >`` predicate implements the policy
+exactly, and its pending→firing→resolved machinery plus the control
+plane's kind=Alert events triple-record every transition unchanged.
+
+Determinism: ``SLOEngine.evaluate`` runs inside the central scraper's
+cycle AFTER ingest and BEFORE rule evaluation, and ingests its gauges
+directly at the cycle's timestamp (last-write-wins per ts) — the
+generated rules judge the values the causing scrape produced, never a
+cycle-stale copy. Budget math reads the downsampled tier transparently:
+a 6 h window works long after the fine ring evicted its left edge.
+
+``usage_summary`` is the ``kfx usage`` aggregation: fleet-summed
+per-tenant token deltas over a window from the scraped
+``kfx_tenant_tokens_total`` families (serving/metering.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .rules import Rule
+
+BUDGET_FAMILY = "kfx_slo_budget_remaining"
+BURN_FAMILY = "kfx_slo_burn_rate"
+
+BUDGET_HELP = ("Error-budget fraction remaining over each SLO's "
+               "objective window (1 = untouched, <= 0 = spent).")
+BURN_HELP = ("Error-budget burn rate by SLO and alert window pair "
+             "(min of the pair's short/long windows; 1 = spending "
+             "exactly the budget).")
+
+# The SRE-workbook thresholds: fast pages (2% of a window's budget in
+# its short window), slow tickets.
+FAST_BURN_THRESHOLD = 14.4
+SLOW_BURN_THRESHOLD = 6.0
+
+# Rendered with {name}; scripts/scrape_metrics.py's rule-inventory gate
+# checks the docs table against these templates.
+GENERATED_RULE_TEMPLATES = ("slo-{name}-fast-burn",
+                            "slo-{name}-slow-burn")
+
+REQUESTS_FAMILY = "kfx_router_requests_total"
+LATENCY_FAMILY = "kfx_serving_request_seconds"
+
+from ..serving.metering import REQUESTS_FAMILY as TENANT_REQUESTS_FAMILY
+from ..serving.metering import TOKENS_FAMILY as TENANT_TOKENS_FAMILY
+
+
+def burn_windows(window_s: float) -> Tuple[Tuple[float, float],
+                                           Tuple[float, float]]:
+    """((fast_short, fast_long), (slow_short, slow_long)) scaled from
+    the workbook's 30d pairs to an objective window W — capped at the
+    canonical 5m/1h and 30m/6h so a 24h SLO alerts on the standard
+    windows, while a 1h SLO tightens proportionally."""
+    w = float(window_s)
+    fast = (min(300.0, w / 12.0), min(3600.0, w))
+    slow = (min(1800.0, w / 2.0), min(21600.0, w))
+    return fast, slow
+
+
+def generated_rules(name: str) -> List[Rule]:
+    """The two burn-rate rules for one SLO. for_s=0: the burn gauges
+    already encode their window AND, so a breach fires on the scrape
+    cycle that produced it (pending and firing land in event order in
+    the same pass)."""
+    labels_fast = {"slo": name, "window": "fast"}
+    labels_slow = {"slo": name, "window": "slow"}
+    return [
+        Rule(name=f"slo-{name}-fast-burn", family=BURN_FAMILY,
+             fn="latest", labels=labels_fast, op=">",
+             threshold=FAST_BURN_THRESHOLD, window_s=120.0, for_s=0.0,
+             severity="critical",
+             summary=f"SLO {name} is burning its error budget fast"),
+        Rule(name=f"slo-{name}-slow-burn", family=BURN_FAMILY,
+             fn="latest", labels=labels_slow, op=">",
+             threshold=SLOW_BURN_THRESHOLD, window_s=120.0, for_s=0.0,
+             severity="warning",
+             summary=f"SLO {name} is burning its error budget "
+                     f"steadily"),
+    ]
+
+
+class SLOEngine:
+    """Evaluates every registered SLO against the TSDB once per scrape
+    cycle; pure in (tsdb, now) like the RuleEngine it feeds."""
+
+    def __init__(self, tsdb, registry=None, store=None, rules=None):
+        self.tsdb = tsdb
+        self.registry = registry
+        self.store = store
+        self.rules = rules
+        self._lock = threading.Lock()
+        # name -> compiled objective (spec snapshot + store key).
+        self._active: Dict[str, Dict] = {}
+        self.last_eval = 0.0
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._active)
+
+    # -- registration (the SLO controller's surface) -------------------------
+    def ensure(self, slo) -> List[str]:
+        """Register/refresh one SLO and its generated rules; returns
+        the rule names (the controller's status.rules)."""
+        sel = slo.selector()
+        # An unqualified selector scopes to the SLO's own namespace —
+        # a team's objective judges the team's service.
+        sel.setdefault("namespace", slo.namespace)
+        info = {
+            "key": slo.key, "name": slo.name,
+            "objective": slo.objective(), "target": slo.target(),
+            "window_s": slo.window_seconds(), "selector": sel,
+            "threshold_s": slo.latency_threshold_s(),
+            "percentile": slo.latency_percentile(),
+        }
+        with self._lock:
+            self._active[slo.name] = info
+        rules = generated_rules(slo.name)
+        if self.rules is not None:
+            for r in rules:
+                self.rules.upsert_rule(r)
+        if self.registry is not None:
+            # Seed so a pre-incident scrape already carries the SLO's
+            # families (budget starts whole, burn at zero).
+            g = self.registry.gauge(BUDGET_FAMILY, BUDGET_HELP)
+            g.set(1.0, slo=slo.name)
+            b = self.registry.gauge(BURN_FAMILY, BURN_HELP)
+            b.set(0.0, slo=slo.name, window="fast")
+            b.set(0.0, slo=slo.name, window="slow")
+        return [r.name for r in rules]
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._active.pop(name, None)
+        if self.rules is not None:
+            for tpl in GENERATED_RULE_TEMPLATES:
+                self.rules.remove_rule(tpl.format(name=name))
+
+    # -- objective math ------------------------------------------------------
+    def _delta(self, family: str, labels: Dict[str, str],
+               window_s: float, now: float) -> Optional[float]:
+        res = self.tsdb.query(family, "delta", labels or None,
+                              window_s, now=now)
+        return res.value
+
+    def _bad_fraction(self, info: Dict, window_s: float,
+                      now: float) -> Optional[float]:
+        """Fraction of bad events in the window; None = no evidence
+        (no traffic reads as a whole budget, not a breach)."""
+        sel = info["selector"]
+        if info["objective"] in ("error-rate", "availability"):
+            total = self._delta(REQUESTS_FAMILY, sel, window_s, now)
+            if not total or total <= 0:
+                return None
+            if info["objective"] == "error-rate":
+                bad = self._delta(REQUESTS_FAMILY,
+                                  {**sel, "code": "5xx"},
+                                  window_s, now) or 0.0
+            else:
+                good = self._delta(REQUESTS_FAMILY,
+                                   {**sel, "code": "2xx"},
+                                   window_s, now) or 0.0
+                bad = total - good
+            return min(max(bad / total, 0.0), 1.0)
+        # latency: good = requests under the threshold, counted from
+        # the histogram bucket at the smallest bound >= threshold (the
+        # discovered ``le`` values, so the bound string matches the
+        # exposition exactly).
+        total = self._delta(f"{LATENCY_FAMILY}_count", sel, window_s,
+                            now)
+        if not total or total <= 0:
+            return None
+        le_label = None
+        le_bound = float("inf")
+        for labels, _v in self.tsdb.latest_samples(
+                f"{LATENCY_FAMILY}_bucket", sel):
+            le_s = labels.get("le")
+            if le_s is None:
+                continue
+            le = float("inf") if le_s == "+Inf" else float(le_s)
+            if le >= info["threshold_s"] and le <= le_bound:
+                le_bound, le_label = le, le_s
+        if le_label is None:
+            return None
+        good = self._delta(f"{LATENCY_FAMILY}_bucket",
+                           {**sel, "le": le_label}, window_s, now) \
+            or 0.0
+        return min(max((total - good) / total, 0.0), 1.0)
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> List[Dict]:
+        """One pass over every SLO: burn rates + budget, gauges set,
+        same-cycle samples ingested, status written back. Returns the
+        per-SLO numbers (the apiserver's /slos payload source)."""
+        import time as _time
+
+        now = _time.time() if now is None else float(now)
+        self.last_eval = now
+        with self._lock:
+            active = list(self._active.values())
+        out: List[Dict] = []
+        for info in active:
+            (fs, fl), (ss, sl) = burn_windows(info["window_s"])
+            denom = max(1.0 - info["target"], 1e-9)
+            fracs: Dict[float, Optional[float]] = {}
+            for w in {fs, fl, ss, sl, info["window_s"]}:
+                fracs[w] = self._bad_fraction(info, w, now)
+
+            def burn(w: float) -> float:
+                f = fracs.get(w)
+                return (f / denom) if f else 0.0
+
+            burn_fast = min(burn(fs), burn(fl))
+            burn_slow = min(burn(ss), burn(sl))
+            frac_w = fracs.get(info["window_s"]) or 0.0
+            budget = 1.0 - frac_w / denom
+            row = {"name": info["name"], "key": info["key"],
+                   "objective": info["objective"],
+                   "target": info["target"],
+                   "window_s": info["window_s"],
+                   "budgetRemaining": round(budget, 6),
+                   "burnRateFast": round(burn_fast, 6),
+                   "burnRateSlow": round(burn_slow, 6)}
+            out.append(row)
+            if self.registry is not None:
+                g = self.registry.gauge(BUDGET_FAMILY, BUDGET_HELP)
+                g.set(row["budgetRemaining"], slo=info["name"])
+                b = self.registry.gauge(BURN_FAMILY, BURN_HELP)
+                b.set(row["burnRateFast"], slo=info["name"],
+                      window="fast")
+                b.set(row["burnRateSlow"], slo=info["name"],
+                      window="slow")
+            # Same-cycle determinism: the generated rules read these
+            # series THIS cycle (ingest is last-write-wins per ts, so
+            # next cycle's registry scrape does not double-count).
+            self.tsdb.ingest({
+                BUDGET_FAMILY: [({"slo": info["name"]},
+                                 row["budgetRemaining"])],
+                BURN_FAMILY: [
+                    ({"slo": info["name"], "window": "fast"},
+                     row["burnRateFast"]),
+                    ({"slo": info["name"], "window": "slow"},
+                     row["burnRateSlow"]),
+                ],
+            }, ts=now, extra_labels={"instance": "plane"})
+            self._write_status(info, row)
+        return out
+
+    def _write_status(self, info: Dict, row: Dict) -> None:
+        """Fold the evaluation into the SLO object's status (skipped
+        when nothing moved — a quiet fleet must not churn resource
+        versions every scrape second)."""
+        if self.store is None:
+            return
+        from ..core.store import Conflict, NotFound
+
+        ns, _, name = info["key"].partition("/")
+        try:
+            slo = self.store.get("SLO", name, ns)
+        except (NotFound, KeyError):
+            return
+        healthy = row["burnRateFast"] <= FAST_BURN_THRESHOLD \
+            and row["budgetRemaining"] > 0.0
+        status_now = (slo.status.get("budgetRemaining"),
+                      slo.status.get("burnRateFast"),
+                      slo.status.get("burnRateSlow"))
+        want = (row["budgetRemaining"], row["burnRateFast"],
+                row["burnRateSlow"])
+        flip = slo.has_condition("BudgetHealthy") != healthy or \
+            not slo.status.get("conditions")
+        if status_now == want and not flip:
+            return
+        slo.status["budgetRemaining"] = row["budgetRemaining"]
+        slo.status["burnRateFast"] = row["burnRateFast"]
+        slo.status["burnRateSlow"] = row["burnRateSlow"]
+        if flip:
+            reason = "BudgetHealthy" if healthy else "BudgetBurning"
+            msg = (f"budget {row['budgetRemaining']:.4f}, "
+                   f"burn fast {row['burnRateFast']:.2f} / slow "
+                   f"{row['burnRateSlow']:.2f}")
+            slo.set_condition("BudgetHealthy",
+                              "True" if healthy else "False",
+                              reason, msg)
+            self.store.record_raw_event(
+                "SLO", info["key"],
+                "Normal" if healthy else "Warning", reason, msg)
+        try:
+            self.store.update_status(slo)
+        except (Conflict, NotFound):
+            pass  # next cycle rewrites from fresh state
+
+
+def slo_snapshot(store, rules_engine) -> List[Dict]:
+    """Every SLO object + the live states of its generated burn rules,
+    one joined payload (GET /slos and local `kfx slo` both render this
+    — no torn read between the resource list and the alert list)."""
+    states = {st["name"]: st for st in rules_engine.states()}
+    out: List[Dict] = []
+    for obj in store.list("SLO"):
+        d = obj.to_dict()
+        d["rules"] = [states[r] for r in obj.status.get("rules", [])
+                      if r in states]
+        out.append(d)
+    return out
+
+
+# -- usage aggregation (kfx usage) --------------------------------------------
+
+def usage_summary(tsdb, window_s: float = 3600.0,
+                  tenant: Optional[str] = None,
+                  now: Optional[float] = None) -> List[Dict]:
+    """Fleet-aggregated per-tenant usage over the trailing window,
+    sorted by window tokens descending (the top-consumers table):
+    [{tenant, qos, adapter, windowTokens, promptTokens,
+      generatedTokens, requests, totalTokens, points}]. Totals come
+    from the newest scraped samples; window numbers are TSDB deltas,
+    so they stitch onto the downsampled tier for long windows."""
+    triples = {}
+    for labels, value in tsdb.latest_samples(TENANT_TOKENS_FAMILY):
+        t = labels.get("tenant", "")
+        if not t or (tenant is not None and t != tenant):
+            continue
+        key = (t, labels.get("qos", ""), labels.get("adapter", ""))
+        kind = labels.get("kind", "")
+        agg = triples.setdefault(key, {"prompt": 0.0, "generated": 0.0})
+        if kind in agg:
+            agg[kind] += value
+    rows: List[Dict] = []
+    for (t, q, a), totals in sorted(triples.items()):
+        sel = {"tenant": t, "qos": q, "adapter": a}
+        win = tsdb.query(TENANT_TOKENS_FAMILY, "delta", sel, window_s,
+                         now=now)
+        reqs = tsdb.query(TENANT_REQUESTS_FAMILY, "delta", sel,
+                          window_s, now=now)
+        rows.append({
+            "tenant": t, "qos": q, "adapter": a,
+            "windowTokens": win.value or 0.0,
+            "windowRequests": reqs.value or 0.0,
+            "promptTokens": totals["prompt"],
+            "generatedTokens": totals["generated"],
+            "totalTokens": totals["prompt"] + totals["generated"],
+            "points": win.points,
+        })
+    rows.sort(key=lambda r: (-r["windowTokens"], -r["totalTokens"],
+                             r["tenant"], r["qos"], r["adapter"]))
+    return rows

@@ -103,10 +103,15 @@ def load_spans(paths: Iterable[str],
 
 def filter_spans(spans: List[Dict], since_s: float = 0.0,
                  min_duration_s: float = 0.0,
-                 now: Optional[float] = None) -> List[Dict]:
-    """The `kfx trace --since/--min-ms` filters: keep spans whose
-    interval still overlaps the trailing ``since_s`` window (0 = no
-    time filter) and whose duration is at least ``min_duration_s``.
+                 now: Optional[float] = None,
+                 tenant: str = "") -> List[Dict]:
+    """The `kfx trace --since/--min-ms/--tenant` filters: keep spans
+    whose interval still overlaps the trailing ``since_s`` window (0 =
+    no time filter), whose duration is at least ``min_duration_s``,
+    and — when ``tenant`` is set — whose ``tenant`` attribute matches
+    exactly (router.dispatch and serving.generate spans stamp the
+    billable tenant; spans without the attribute are dropped by the
+    filter, so a tenant view shows only that tenant's request path).
     A long-lived serving revision's trace accretes request spans
     forever — the waterfall needs a recency/size cut to stay
     readable. Filtering is by span, not by subtree: the tree builder
@@ -114,13 +119,15 @@ def filter_spans(spans: List[Dict], since_s: float = 0.0,
     renders as a root."""
     import time as _time
 
-    if not since_s and not min_duration_s:
+    if not since_s and not min_duration_s and not tenant:
         return spans
     now = _time.time() if now is None else float(now)
     horizon = now - since_s if since_s else float("-inf")
     return [r for r in spans
             if r["ts"] + r["dur"] >= horizon
-            and r["dur"] >= min_duration_s]
+            and r["dur"] >= min_duration_s
+            and (not tenant
+                 or (r.get("attrs") or {}).get("tenant") == tenant)]
 
 
 # -- tree reconstruction ------------------------------------------------------

@@ -97,21 +97,68 @@ class QueryResult:
                 "seriesMatched": self.series_matched}
 
 
+class _Coarse:
+    """Per-series aligned downsampling accumulator (the Monarch-style
+    long-horizon tier): positive-step INCREASES folded into
+    ``coarse_res_s``-aligned buckets, so counters-as-increases and
+    histogram bucket deltas survive long past the fine ring's horizon
+    in one float per bucket. ``first_v`` keeps the birth cumulative
+    value so a born-in-window percentile keeps its base-0 semantics
+    after the fine ring has evicted the birth sample."""
+
+    __slots__ = ("buckets", "cur_start", "cur_inc", "last_v", "first_v")
+
+    def __init__(self, ts: float, v: float, res: float, maxlen: int):
+        # deque of (bucket_start_ts, increase), time-ordered.
+        self.buckets: Deque[Tuple[float, float]] = \
+            collections.deque(maxlen=maxlen)
+        self.cur_start = ts // res * res
+        self.cur_inc = 0.0
+        self.last_v = v
+        self.first_v = v
+
+    def add(self, ts: float, v: float, res: float) -> None:
+        # Only positive steps count (the `increase` rule): a counter
+        # reset — including one landing exactly on a coarse-bucket
+        # boundary — contributes 0, never a negative increase.
+        inc = max(v - self.last_v, 0.0)
+        self.last_v = v
+        bstart = ts // res * res
+        if bstart != self.cur_start:
+            self.buckets.append((self.cur_start, self.cur_inc))
+            self.cur_start = bstart
+            self.cur_inc = inc
+        else:
+            self.cur_inc += inc
+
+
 class TSDB:
     """Thread-safe bounded in-memory time-series store.
 
-    Retention math (docs/observability.md): memory is bounded by
-    ``max_series x max_samples`` (ts, value) float pairs, and the
-    usable query horizon is ``min(retention_s,
-    max_samples x scrape_interval)`` — at the defaults (720 samples,
-    1s interval, 600s retention) every window query up to 10 minutes
-    back is fully answerable and the store tops out at a few MB."""
+    Two tiers per series (docs/observability.md): the FINE ring keeps
+    raw ``(ts, value)`` samples — memory bounded by ``max_series x
+    max_samples`` pairs, usable horizon ``min(retention_s,
+    max_samples x scrape_interval)`` — and the COARSE ring keeps
+    aligned per-bucket increases at ``coarse_res_s`` resolution for
+    ``coarse_retention_s`` (defaults: 60s x 24h = 1440 floats/series),
+    so a 1h–6h `rate`/`delta`/`pNN` window is answerable from bounded
+    memory long after the fine ring evicted the early samples. Queries
+    stitch transparently: per series, the fine ring answers when it
+    still reaches the window start (within one coarse bucket), else
+    the coarse ring does — worst-case left-edge error is one coarse
+    bucket."""
 
     def __init__(self, retention_s: float = 600.0,
-                 max_samples: int = 720, max_series: int = 8192):
+                 max_samples: int = 720, max_series: int = 8192,
+                 coarse_res_s: float = 60.0,
+                 coarse_retention_s: float = 86400.0):
         self.retention_s = float(retention_s)
         self.max_samples = int(max_samples)
         self.max_series = int(max_series)
+        self.coarse_res_s = max(float(coarse_res_s), 1.0)
+        self.coarse_retention_s = float(coarse_retention_s)
+        self._coarse_maxlen = max(
+            int(self.coarse_retention_s // self.coarse_res_s), 1)
         self._lock = threading.Lock()
         # {family: {label_key: deque[(ts, value)]}}
         self._series: Dict[str, Dict[LabelKey, Deque[Tuple[float, float]]]] \
@@ -122,6 +169,9 @@ class TSDB:
         # to be inferred from buffer shape (retention/maxlen eviction
         # both make that inference lie for long-lived series).
         self._born: Dict[Tuple[str, LabelKey], float] = {}
+        # {(family, label_key): _Coarse} — same birth/GC discipline as
+        # the fine ring (created at first ingest, dropped together).
+        self._coarse: Dict[Tuple[str, LabelKey], _Coarse] = {}
         self.dropped_series = 0  # would-be series past max_series
         self.last_ingest_ts = 0.0
         self._ingests = 0
@@ -165,9 +215,28 @@ class TSDB:
                             maxlen=self.max_samples)
                         self._n_series += 1
                         self._born[(name, key)] = ts
-                    buf.append((ts, float(value)))
+                    if buf and buf[-1][0] == ts:
+                        # Last write wins per scrape timestamp: one
+                        # series holds ONE sample per cycle — the SLO
+                        # engine overwrites the registry-scraped burn
+                        # gauge with this cycle's fresh value, and
+                        # per-ts summing must not read both.
+                        buf[-1] = (ts, float(value))
+                    else:
+                        buf.append((ts, float(value)))
                     while buf and buf[0][0] < horizon:
                         buf.popleft()
+                    co = self._coarse.get((name, key))
+                    if co is None:
+                        self._coarse[(name, key)] = _Coarse(
+                            ts, float(value), self.coarse_res_s,
+                            self._coarse_maxlen)
+                    else:
+                        co.add(ts, float(value), self.coarse_res_s)
+                        coarse_horizon = ts - self.coarse_retention_s
+                        while co.buckets and \
+                                co.buckets[0][0] < coarse_horizon:
+                            co.buckets.popleft()
                     n += 1
             self.last_ingest_ts = ts
             self._ingests += 1
@@ -190,6 +259,7 @@ class TSDB:
                 if not buf or buf[-1][0] < horizon:
                     del fam[key]
                     self._born.pop((name, key), None)
+                    self._coarse.pop((name, key), None)
                     self._n_series -= 1
 
     # -- read side -----------------------------------------------------------
@@ -260,6 +330,60 @@ class TSDB:
                         merged[ts] = merged.get(ts, 0.0) + v
         return sorted(merged.items()), matched
 
+    def _fine_covers(self, family: str, key: LabelKey,
+                     buf: Deque[Tuple[float, float]],
+                     since_ts: float) -> bool:
+        """True when the fine ring still reaches the window start for
+        this series (caller holds the lock): the oldest retained raw
+        sample is no more than one coarse bucket past
+        ``max(since_ts, born)`` — the same left-edge tolerance the
+        coarse path itself has, so the tier choice never trades a
+        covered fine answer for a coarser one."""
+        born = self._born.get((family, key), float("-inf"))
+        need_from = max(since_ts, born)
+        if buf and buf[0][0] <= need_from + self.coarse_res_s:
+            return True
+        return (family, key) not in self._coarse
+
+    def _series_inc_points(self, family: str, key: LabelKey,
+                           buf: Deque[Tuple[float, float]],
+                           since_ts: float
+                           ) -> Tuple[List[Tuple[float, float]], float,
+                                      Optional[float], Optional[float]]:
+        """One series' (increase points, total increase, first ts,
+        last ts) over the window, choosing the fine or coarse tier
+        (caller holds the lock). Fine: per-consecutive-sample positive
+        steps. Coarse: per-bucket increases for buckets overlapping
+        the window (points stamped at bucket end), left-edge error at
+        most one coarse bucket."""
+        if self._fine_covers(family, key, buf, since_ts):
+            window = [(t, v) for t, v in buf if t >= since_ts]
+            if not window:
+                return [], 0.0, None, None
+            pairs: List[Tuple[float, float]] = []
+            total = 0.0
+            for (t0, v0), (t1, v1) in zip(window, window[1:]):
+                inc = max(v1 - v0, 0.0)
+                pairs.append((t1, inc))
+                total += inc
+            return pairs, total, window[0][0], window[-1][0]
+        co = self._coarse[(family, key)]
+        res = self.coarse_res_s
+        pairs = []
+        total = 0.0
+        for bstart, inc in co.buckets:
+            if bstart + res > since_ts:
+                pairs.append((bstart + res, inc))
+                total += inc
+        if co.cur_start + res > since_ts:
+            t_end = buf[-1][0] if buf else co.cur_start + res
+            pairs.append((max(t_end, co.cur_start), co.cur_inc))
+            total += co.cur_inc
+        if not pairs:
+            return [], 0.0, None, None
+        born = self._born.get((family, key), float("-inf"))
+        return pairs, total, max(since_ts, born), pairs[-1][0]
+
     def _series_increases(self, family: str,
                           labels: Optional[Dict[str, str]],
                           since_ts: float
@@ -271,7 +395,10 @@ class TSDB:
         only then summed — the Prometheus rate-then-sum rule. Summing
         cumulative values first would turn one missed replica scrape
         (normal fleet churn) into a dip-and-recover of that replica's
-        whole cumulative count, i.e. a spurious rate spike."""
+        whole cumulative count, i.e. a spurious rate spike. Each
+        series answers from its fine ring while that still covers the
+        window, else from its coarse ring — so a 1h window keeps
+        working after the fine ring evicted the early samples."""
         merged: Dict[float, float] = {}
         total = 0.0
         t_first: Optional[float] = None
@@ -282,17 +409,17 @@ class TSDB:
                 if not _matches(key, labels):
                     continue
                 matched += 1
-                window = [(t, v) for t, v in buf if t >= since_ts]
-                if not window:
+                pairs, inc, tf, tl = self._series_inc_points(
+                    family, key, buf, since_ts)
+                if tf is None:
                     continue
-                if t_first is None or window[0][0] < t_first:
-                    t_first = window[0][0]
-                if t_last is None or window[-1][0] > t_last:
-                    t_last = window[-1][0]
-                for (t0, v0), (t1, v1) in zip(window, window[1:]):
-                    inc = max(v1 - v0, 0.0)
-                    merged[t1] = merged.get(t1, 0.0) + inc
-                    total += inc
+                if t_first is None or tf < t_first:
+                    t_first = tf
+                if t_last is None or tl > t_last:
+                    t_last = tl
+                for t, v in pairs:
+                    merged[t] = merged.get(t, 0.0) + v
+                total += inc
         points = sorted(merged.items())
         span = (t_last - t_first) if t_first is not None and \
             t_last is not None and t_last > t_first else 0.0
@@ -368,21 +495,15 @@ class TSDB:
         """Percentile of the observations that LANDED inside the
         window: per-``le`` cumulative deltas between the window's first
         and last scrape, interpolated by the shared rule."""
-        per_le: Dict[float, Tuple[float, float]] = {}  # le -> (first, last)
+        fam = f"{family}_bucket"
+        per_le: Dict[float, float] = {}  # le -> summed window increase
         matched = 0
         with self._lock:
-            for key, buf in self._series.get(f"{family}_bucket",
-                                             {}).items():
+            for key, buf in self._series.get(fam, {}).items():
                 have = dict(key)
                 le_s = have.pop("le", None)
                 if le_s is None or not _matches(label_key(have), labels):
                     continue
-                window = [(t, v) for t, v in buf if t >= since_ts]
-                if not window:
-                    continue
-                matched += 1
-                le = float("inf") if le_s == "+Inf" else float(le_s)
-                first, last = per_le.get(le, (0.0, 0.0))
                 # Multiple series (several instances) fold together. A
                 # series genuinely BORN inside the window (exact birth
                 # ts tracked at first ingest — never inferred from
@@ -390,16 +511,34 @@ class TSDB:
                 # lie for long-lived series) counts all its
                 # observations, so its window base is 0; otherwise the
                 # base is its first in-window cumulative value.
-                born = self._born.get((f"{family}_bucket", key),
-                                      float("-inf"))
-                first_v = 0.0 if born >= since_ts else window[0][1]
-                per_le[le] = (first + first_v, last + window[-1][1])
+                born = self._born.get((fam, key), float("-inf"))
+                if self._fine_covers(fam, key, buf, since_ts):
+                    window = [v for t, v in buf if t >= since_ts]
+                    if not window:
+                        continue
+                    base = 0.0 if born >= since_ts else window[0]
+                    inc = window[-1] - base
+                else:
+                    # Fine ring no longer reaches the window start:
+                    # sum the coarse per-bucket deltas instead, plus
+                    # the birth cumulative value when the series was
+                    # born inside the window (base-0 semantics above).
+                    co = self._coarse[(fam, key)]
+                    res = self.coarse_res_s
+                    inc = sum(i for b, i in co.buckets
+                              if b + res > since_ts)
+                    if co.cur_start + res > since_ts:
+                        inc += co.cur_inc
+                    if born >= since_ts:
+                        inc += co.first_v
+                matched += 1
+                le = float("inf") if le_s == "+Inf" else float(le_s)
+                per_le[le] = per_le.get(le, 0.0) + inc
         if not per_le:
             return None, 0
         buckets = []
         for le in sorted(per_le):
-            first, last = per_le[le]
-            buckets.append((le, max(int(round(last - first)), 0)))
+            buckets.append((le, max(int(round(per_le[le])), 0)))
         # A single-scrape window has no delta; treat the cumulative
         # state as the window when the series began inside it.
         if buckets and buckets[-1][1] == 0:
@@ -422,12 +561,13 @@ class CentralScraper:
 
     def __init__(self, tsdb: TSDB, registry, interval_s: float = 1.0,
                  targets: Optional[Callable[[], List[ScrapeTarget]]] = None,
-                 rules=None, timeout_s: float = 0.75):
+                 rules=None, timeout_s: float = 0.75, slo=None):
         self.tsdb = tsdb
         self.registry = registry
         self.interval_s = max(float(interval_s), 0.05)
         self.targets = targets or (lambda: [])
         self.rules = rules
+        self.slo = slo
         self.timeout_s = timeout_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -502,6 +642,12 @@ class CentralScraper:
                 plane_n, source="plane")
             reg.counter("kfx_scrape_samples_total").inc(
                 replica_n, source="replica")
+        # SLO evaluation runs BEFORE the rule pass and ingests its
+        # burn-rate gauges at this cycle's timestamp, so the
+        # SLO-generated rules see the values the causing scrape
+        # produced — pending→firing is deterministic on scrape beats.
+        if self.slo is not None:
+            self.slo.evaluate(now=now)
         if self.rules is not None:
             self.rules.evaluate(now=now)
         if reg is not None:

@@ -1,0 +1,59 @@
+"""SLO controller: reconciles ``kind: SLO`` objects into the SLO
+engine's generated burn-rate rules.
+
+The controller owns the RESOURCE lifecycle (registration, generated
+rule names in status, the Ready condition, deregistration on delete);
+the per-cycle NUMBERS (budgetRemaining, burn rates, BudgetHealthy) are
+written by SLOEngine.evaluate from inside the scrape cycle, so they are
+deterministic on the causing scrape rather than on controller timing.
+The periodic resync re-asserts registration — upsert_rule keeps a live
+AlertState when the compiled expression is unchanged, so a resync never
+resolves a firing burn alert.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api.base import Resource
+from ..api.slo import SLO, SLO_READY
+from ..core.controller import Controller, Result
+from ..core.store import Conflict, NotFound, ResourceStore
+from ..obs.slo import SLOEngine
+
+
+class SLOController(Controller):
+    KIND = "SLO"
+    RESYNC_PERIOD = 30.0
+
+    def __init__(self, store: ResourceStore, engine: SLOEngine) -> None:
+        super().__init__(store)
+        self.engine = engine
+
+    def reconcile(self, key: str) -> Optional[Result]:
+        slo = self.get_resource(key)
+        if slo is None:
+            return None
+        assert isinstance(slo, SLO)
+        rules: List[str] = self.engine.ensure(slo)
+        changed = False
+        if slo.status.get("rules") != rules:
+            slo.status["rules"] = rules
+            changed = True
+        if not slo.has_condition(SLO_READY):
+            slo.set_condition(
+                SLO_READY, "True", "RulesGenerated",
+                f"{slo.objective()} objective compiled into "
+                f"{len(rules)} burn-rate rules")
+            self.record_event(slo, "Normal", "RulesGenerated",
+                              ", ".join(rules))
+            changed = True
+        if changed:
+            try:
+                self.store.update_status(slo)
+            except (Conflict, NotFound):
+                self.queue.add(slo.key)
+        return None
+
+    def on_delete(self, obj: Resource) -> None:
+        self.engine.remove(obj.name)

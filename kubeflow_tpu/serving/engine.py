@@ -301,7 +301,8 @@ class Request:
                  "t_enqueue", "t_admitted", "t_done", "counted",
                  "trace_id", "span_id", "_event", "rid", "events",
                  "t_first", "stall_s", "preempts", "spec_prop",
-                 "spec_acc", "_flight", "qos", "deadline", "on_token")
+                 "spec_acc", "_flight", "qos", "deadline", "on_token",
+                 "tenant", "meter_skip", "_usage")
 
     _rid_counter = itertools.count(1)
 
@@ -362,6 +363,15 @@ class Request:
         self.spec_prop = 0            # draft tokens proposed for us
         self.spec_acc = 0             # ...and accepted
         self._flight = None
+        # Usage metering (serving/metering.py): the billable tenant
+        # key (adapter tenant unless the client named one), the ledger
+        # to bill against (None = metering off), and how many leading
+        # generated tokens were a recovery re-dispatch's regeneration
+        # of already-billed output (``stream_skip``) — billed once
+        # fleet-wide, by the replica that actually streamed them.
+        self.tenant = adapter or "base"
+        self.meter_skip = 0
+        self._usage = None
         self._event = threading.Event()
 
     def done(self) -> bool:
@@ -382,6 +392,15 @@ class Request:
     def _finish(self, error: Optional[BaseException] = None) -> None:
         self.error = error
         self.t_done = time.monotonic()
+        # Retirement-side generated-token billing: every outcome path
+        # funnels through here exactly once, ``tokens`` only grows
+        # (recompute re-prefills, never re-emits), and only an ADMITTED
+        # request billed its prompt — a pre-admission shed retires
+        # without a ledger row.
+        if self._usage is not None and self.counted:
+            self._usage.retire(self.tenant, self.qos,
+                               self.adapter or "base",
+                               len(self.tokens) - self.meter_skip)
         if self._flight is not None:
             self._flight.event(self, "retire",
                                err=type(error).__name__ if error else None)
@@ -931,6 +950,13 @@ class DecodeEngine:
 
         self.flight = _flightrec.FlightRecorder() \
             if _flightrec.enabled_from_env() else None
+        # Per-tenant usage ledger (serving/metering.py): exact prompt/
+        # generated token counts by {tenant, qos, adapter}, billed on
+        # the admission/retirement funnel. None disables every hook
+        # (the bench's detached leg).
+        from .metering import TenantLedger
+
+        self.usage: Optional[TenantLedger] = TenantLedger()
         # Cumulative preemption count (loop thread) — mirrored into
         # every flight record so a postmortem can see preemption churn
         # without scraping metrics.
@@ -2015,7 +2041,8 @@ class DecodeEngine:
                       stop_token: Optional[int],
                       adapter: Optional[str] = None,
                       qos: Optional[str] = None,
-                      deadline_s: Optional[float] = None) -> Request:
+                      deadline_s: Optional[float] = None,
+                      tenant: Optional[str] = None) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -2058,6 +2085,12 @@ class DecodeEngine:
                       -1 if stop_token is None else int(stop_token),
                       adapter=name, qos=cls, deadline=deadline)
         req._flight = self.flight
+        # Billable tenant: the client's explicit key, else the adapter
+        # tenant ("" = the base tenant) — the same resolution the rate
+        # limiter and the fairness queue use.
+        if tenant is not None and str(tenant):
+            req.tenant = str(tenant)
+        req._usage = self.usage
         return req
 
     def _check_rate_locked(self, reqs: List[Request],
@@ -2202,6 +2235,7 @@ class DecodeEngine:
                adapter: Optional[str] = None,
                qos: Optional[str] = None,
                deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None, meter_skip: int = 0,
                on_token: Optional[Callable[[Optional[int]], None]]
                = None) -> Request:
         """Enqueue one prompt; returns the request handle (wait with
@@ -2216,7 +2250,13 @@ class DecodeEngine:
         sheds the request."""
         req = self._make_request(prompt, max_new_tokens, temperature,
                                  top_k, seed, stop_token, adapter,
-                                 qos=qos, deadline_s=deadline_s)
+                                 qos=qos, deadline_s=deadline_s,
+                                 tenant=tenant)
+        # Recovery re-dispatch (router stream_skip): the first N
+        # regenerated tokens were already billed and streamed by the
+        # replica that died — set BEFORE enqueue so even an instant
+        # retirement bills them exactly once fleet-wide.
+        req.meter_skip = max(int(meter_skip), 0)
         req.on_token = on_token
         self._enqueue([req])
         return req
@@ -2227,7 +2267,8 @@ class DecodeEngine:
                  stop_token: Optional[int] = None,
                  adapter: Optional[str] = None,
                  qos: Optional[str] = None,
-                 deadline_s: Optional[float] = None
+                 deadline_s: Optional[float] = None,
+                 tenant: Optional[str] = None
                  ) -> List[List[int]]:
         """Blocking convenience mirroring LMGenerator.generate: one
         request per prompt (seeded seed+i), results in prompt order.
@@ -2238,7 +2279,8 @@ class DecodeEngine:
         clocks can't stack past it."""
         reqs = self.submit_batch(prompts, max_new_tokens, temperature,
                                  top_k, seed, stop_token, adapter,
-                                 qos=qos, deadline_s=deadline_s)
+                                 qos=qos, deadline_s=deadline_s,
+                                 tenant=tenant)
         wait_s = deadline_s if deadline_s else self.request_timeout_s
         deadline = time.monotonic() + wait_s
         return [r.result(max(0.001, deadline - time.monotonic()))
@@ -2250,7 +2292,8 @@ class DecodeEngine:
                      stop_token: Optional[int] = None,
                      adapter: Optional[str] = None,
                      qos: Optional[str] = None,
-                     deadline_s: Optional[float] = None
+                     deadline_s: Optional[float] = None,
+                     tenant: Optional[str] = None
                      ) -> List[Request]:
         """`generate` minus the blocking wait: one request per prompt
         (seeded seed+i), enqueued atomically, handles returned — so a
@@ -2258,7 +2301,8 @@ class DecodeEngine:
         flight state after collecting results."""
         reqs = [self._make_request(p, max_new_tokens, temperature,
                                    top_k, seed + i, stop_token, adapter,
-                                   qos=qos, deadline_s=deadline_s)
+                                   qos=qos, deadline_s=deadline_s,
+                                   tenant=tenant)
                 for i, p in enumerate(prompts)]
         self._enqueue(reqs)
         return reqs
@@ -2752,6 +2796,12 @@ class DecodeEngine:
                 "Admitted client requests by adapter tenant.").inc(
                     1, model=self.name,
                     adapter=req.adapter or "base")
+        if req._usage is not None:
+            # Admission-side billing: request + prompt tokens, once —
+            # gated by the same ``req.counted`` latch as everything
+            # above, so preemption-by-recompute never double-bills.
+            req._usage.admit(req.tenant, req.qos,
+                             req.adapter or "base", len(req.prompt))
         if self._prefix is not None:
             if matched:
                 self._count_prefix_hit(matched)

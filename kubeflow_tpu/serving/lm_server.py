@@ -325,6 +325,7 @@ class LMPredictor(Predictor):
                 deadline_default_s=self.deadline_default_ms / 1000.0,
                 rate_limits=self.rate_limits or None,
                 rate_burst_s=self.rate_burst_s)
+            self._attach_usage()
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
             # ready means "can serve one request without a compile".
@@ -369,6 +370,19 @@ class LMPredictor(Predictor):
             self._set_warm(self._warm_count)
         if self._engine is not None:
             self._engine._touch_gauges()
+        self._attach_usage()
+
+    def _attach_usage(self) -> None:
+        """Project the engine's tenant ledger into the CURRENT
+        registry (a collector — the ledger owns the truth), seeding
+        the default tenant's zero row so a pre-traffic
+        ``scrape_metrics --require`` already sees both families."""
+        if self._engine is None or self._engine.usage is None:
+            return
+        ledger = self._engine.usage
+        tenant = self.adapter_default or "base"
+        ledger.seed(tenant, self.qos_default, tenant)
+        self.metrics.add_collector(ledger.collect)
 
     def _warm_rest(self, buckets) -> None:
         done = 1
@@ -472,6 +486,12 @@ class LMPredictor(Predictor):
         qos = body.get("qos")
         if qos is not None and not isinstance(qos, str):
             raise ValueError("qos must be a string class name")
+        # Billable tenant key (usage metering): an explicit non-empty
+        # string, else the engine derives it from the resolved adapter
+        # ("" and absent both mean "bill to the adapter tenant").
+        tenant = body.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            raise ValueError("tenant must be a string")
         # Per-request deadline in milliseconds (the X-KFX-Deadline-Ms
         # header lands here too — the server merges it into the body).
         deadline_ms = body.get("deadline_ms")
@@ -486,6 +506,7 @@ class LMPredictor(Predictor):
             "stop": stop,
             "adapter": adapter,
             "qos": qos,
+            "tenant": tenant or None,
             "deadline_s": (float(deadline_ms) / 1000.0
                            if deadline_ms is not None else None),
             "kw": dict(
@@ -539,7 +560,8 @@ class LMPredictor(Predictor):
             reqs = self._engine.submit_batch(
                 p["prompts"], stop_token=p["stop"],
                 adapter=p["adapter"], qos=p["qos"],
-                deadline_s=p["deadline_s"], **p["kw"])
+                deadline_s=p["deadline_s"], tenant=p["tenant"],
+                **p["kw"])
             deadline = time.monotonic() \
                 + self._wait_budget_s(p["deadline_s"])
             out = [r.result(max(0.001, deadline - time.monotonic()))
@@ -598,7 +620,8 @@ class LMPredictor(Predictor):
         req = self._engine.submit(
             p["prompts"][0], stop_token=p["stop"],
             adapter=p["adapter"], qos=p["qos"],
-            deadline_s=p["deadline_s"], on_token=q.put, **p["kw"])
+            deadline_s=p["deadline_s"], tenant=p["tenant"],
+            meter_skip=skip, on_token=q.put, **p["kw"])
         return self._stream_events(req, q, skip, budget_s)
 
     @staticmethod

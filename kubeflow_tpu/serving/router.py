@@ -359,6 +359,22 @@ class Router:
                 "endpoint (the replica already holding the prompt's "
                 "cached prefix pages).",
             ).inc(0, namespace=namespace, isvc=name)
+            # Seed every status class of the request counter too: the
+            # TSDB treats a series' birth value as a base, never an
+            # increase (a replica arriving with requests_total=500
+            # must not fabricate 500 requests) — so a burst faster
+            # than one scrape interval on an UNBORN 5xx series would
+            # be invisible to error-rate SLOs. Born-at-zero before
+            # traffic, every later increment counts. Base-tenant rows
+            # cover :generate, blank-tenant rows the rest.
+            req = metrics.counter(
+                "kfx_router_requests_total",
+                "Proxied requests by revision and status class.")
+            for code in ("2xx", "4xx", "5xx"):
+                for tenant in ("", "base"):
+                    req.inc(0, namespace=namespace, isvc=name,
+                            revision="default", code=code,
+                            tenant=tenant)
         self._rng = rng or random.Random(0xC0FFEE)
         # Called when a request arrives and no replica is live
         # (scale-from-zero activator hook).
@@ -494,6 +510,27 @@ class Router:
         except (ValueError, TypeError, AttributeError):
             return ""
 
+    def _tenant_from_body(self, data: bytes) -> str:
+        """The billable tenant key for a ``:generate`` body (the
+        router already buffers it): an explicit ``tenant`` string,
+        else the adapter tenant under the engine's resolution rule
+        (absent = the revision's default adapter, "" = base). Returns
+        "" for bodies that carry neither signal (non-generate traffic
+        keeps an empty tenant label)."""
+        if not data:
+            return ""
+        try:
+            body = json.loads(data)
+            tenant = body.get("tenant")
+            if isinstance(tenant, str) and tenant:
+                return tenant
+            adapter = body.get("adapter")
+            if adapter is None:
+                adapter = self.default_adapter
+            return str(adapter or "") or "base"
+        except (ValueError, TypeError, AttributeError):
+            return ""
+
     def _proxy(self, h, has_body: bool) -> None:
         self.last_request_time = time.monotonic()
         path = h.path.partition("?")[0]
@@ -507,7 +544,9 @@ class Router:
             "predictor"
         aff_key = ""
         stream = False
+        tenant = ""
         if path.endswith(":generate"):
+            tenant = self._tenant_from_body(data)
             if self.affinity_capacity > 0:
                 aff_key = h.headers.get(PREFIX_HEADER, "") or \
                     self._affinity_from_body(data)
@@ -548,9 +587,10 @@ class Router:
         self._set_inflight(chosen)
         try:
             if stream:
-                self._forward_stream(h, backend, chosen, data, aff_key)
+                self._forward_stream(h, backend, chosen, data, aff_key,
+                                     tenant)
             else:
-                self._forward(h, backend, chosen, data, aff_key)
+                self._forward(h, backend, chosen, data, aff_key, tenant)
         finally:
             chosen.exit()
             self._set_inflight(chosen)
@@ -612,24 +652,29 @@ class Router:
                   isvc=self.name, revision=chosen.revision)
 
     def _record_request(self, chosen: BackendSet, status: int,
-                        seconds: float) -> None:
+                        seconds: float, tenant: str = "") -> None:
         """Per-revision request accounting — the canary SLO watcher's
-        error-rate and p99 source (operators/serving.py)."""
+        error-rate and p99 source (operators/serving.py). The tenant
+        label ("" on non-generate traffic) narrows per-tenant SLOs and
+        `kfx usage`; subset matching keeps tenant-blind consumers
+        (autoscaler, default rule pack) summing across it."""
         if self.metrics is None:
             return
         self.metrics.counter(
             "kfx_router_requests_total",
             "Proxied requests by revision and status class.",
         ).inc(1, namespace=self.namespace, isvc=self.name,
-              revision=chosen.revision, code=f"{status // 100}xx")
+              revision=chosen.revision, code=f"{status // 100}xx",
+              tenant=tenant)
         self.metrics.histogram(
             "kfx_serving_request_seconds",
             "Router-observed request latency by revision.",
         ).observe(seconds, namespace=self.namespace, isvc=self.name,
-                  revision=chosen.revision)
+                  revision=chosen.revision, tenant=tenant)
 
     def _forward(self, h, backend: str, chosen: BackendSet,
-                 data: bytes, aff_key: str = "") -> None:
+                 data: bytes, aff_key: str = "",
+                 tenant: str = "") -> None:
         """Relay to ``backend``, reporting passive health to ``chosen``;
         a connection failure or 5xx retries EXACTLY ONCE on a different
         backend of the same set (predict traffic is idempotent — the
@@ -655,6 +700,8 @@ class Router:
         sp = obs_trace.start_span(
             "router.dispatch", trace_id=h.headers.get(TRACE_HEADER, ""),
             parent_id=h.headers.get(SPAN_HEADER, ""), backend=backend)
+        if tenant:
+            sp.attrs["tenant"] = tenant
         recovering = False
         try:
             for attempt in range(2):
@@ -701,7 +748,8 @@ class Router:
             obs_trace.finish_span(sp, status="ok" if ok else "error")
         if last is not None:
             status, headers, payload = last
-            self._record_request(chosen, status, time.perf_counter() - t0)
+            self._record_request(chosen, status,
+                                 time.perf_counter() - t0, tenant)
             h.send_response(status)
             # send_response() already emitted Server/Date; don't duplicate.
             skip = _HOP_BY_HOP | {"content-length", "server", "date"}
@@ -712,7 +760,8 @@ class Router:
             h.end_headers()
             h.wfile.write(payload)
             return
-        self._record_request(chosen, 502, time.perf_counter() - t0)
+        self._record_request(chosen, 502, time.perf_counter() - t0,
+                             tenant)
         body = json.dumps(
             {"error": f"backend {attempt_backend}: {last_err}"}).encode()
         h.send_response(502)
@@ -752,7 +801,8 @@ class Router:
 
     # -- SSE streaming relay ------------------------------------------------
     def _forward_stream(self, h, backend: str, chosen: BackendSet,
-                        data: bytes, aff_key: str = "") -> None:
+                        data: bytes, aff_key: str = "",
+                        tenant: str = "") -> None:
         """Relay a streaming ``:generate`` (body ``"stream": true``)
         as pass-through SSE, with MID-STREAM recovery: if the backend
         dies after N token events already reached the client, the
@@ -776,6 +826,8 @@ class Router:
         sp = obs_trace.start_span(
             "router.dispatch", trace_id=h.headers.get(TRACE_HEADER, ""),
             parent_id=h.headers.get(SPAN_HEADER, ""), backend=backend)
+        if tenant:
+            sp.attrs["tenant"] = tenant
         try:
             for attempt in range(2):
                 body = data
@@ -796,7 +848,8 @@ class Router:
                     # The CLIENT hung up mid-relay; nothing to recover
                     # (the backend finishes or reaps on its own).
                     self._record_request(chosen, 499,
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0,
+                                         tenant)
                     return
                 except OSError as e:
                     last, last_err = None, e
@@ -811,7 +864,8 @@ class Router:
                         self._record_recovery(chosen, mode=rec_mode)
                         sp.attrs["recovered"] = rec_mode
                     self._record_request(chosen, 200,
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0,
+                                         tenant)
                     # Only now release the client: the terminal chunk
                     # is the client's end-of-stream signal, and every
                     # counter it might scrape next must already be
@@ -849,7 +903,8 @@ class Router:
             # Headers are out: the only honest failure channel left is
             # an in-band error frame (then close without recycling the
             # connection — the stream is dead).
-            self._record_request(chosen, 502, time.perf_counter() - t0)
+            self._record_request(chosen, 502,
+                                 time.perf_counter() - t0, tenant)
             frame = (b"event: error\ndata: "
                      + json.dumps({"error": "backend lost mid-stream "
                                             "and recovery failed",
@@ -866,7 +921,7 @@ class Router:
         if last is not None:
             status, headers, payload = last
             self._record_request(chosen, status,
-                                 time.perf_counter() - t0)
+                                 time.perf_counter() - t0, tenant)
             h.send_response(status)
             skip = _HOP_BY_HOP | {"content-length", "server", "date"}
             for k, v in headers:
@@ -876,7 +931,8 @@ class Router:
             h.end_headers()
             h.wfile.write(payload)
             return
-        self._record_request(chosen, 502, time.perf_counter() - t0)
+        self._record_request(chosen, 502, time.perf_counter() - t0,
+                             tenant)
         payload = json.dumps(
             {"error": f"backend {attempt_backend}: {last_err}"}).encode()
         h.send_response(502)

@@ -782,6 +782,9 @@ class ModelServer:
             name, trace_id=h.headers.get(TRACE_HEADER, ""),
             parent_id=h.headers.get(SPAN_HEADER, ""), model=model)
         h._span_id = sp.span_id  # echoed back by _send_text
+        # Handlers that learn request attributes AFTER the span opened
+        # (the tenant key lives in the body) reach it here.
+        h._cur_span = sp
         return sp
 
     @staticmethod
@@ -864,6 +867,19 @@ class ModelServer:
                 h._send(400, {"error": "X-KFX-Deadline-Ms must be "
                                        "a number"})
                 return
+        # Tenant key onto the serving.generate span (`kfx trace
+        # --tenant`): the engine's resolution — explicit tenant, else
+        # the resolved adapter tenant ("" / absent -> revision
+        # default, base when none).
+        sp = getattr(h, "_cur_span", None)
+        if sp is not None and isinstance(body, dict):
+            tenant = body.get("tenant")
+            if not isinstance(tenant, str) or not tenant:
+                adapter = body.get("adapter")
+                if adapter is None:
+                    adapter = getattr(p, "adapter_default", "")
+                tenant = str(adapter or "") or "base"
+            sp.attrs["tenant"] = tenant
         try:
             if body.get("stream"):
                 if not getattr(p, "generate_stream", None):

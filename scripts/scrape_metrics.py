@@ -33,6 +33,12 @@ legitimately describe derived series.
 every point in ``chaos.KNOWN_POINTS`` must have a catalog row in
 docs/chaos.md (backticked ``component.site`` first column), so new
 chaos points cannot land undocumented either.
+
+``--inventory`` also gates ALERT-RULE names: every default-pack rule
+and every SLO-generated rule template (rendered with ``<name>``) must
+have a backticked kebab-case row in docs/observability.md's alert-rule
+table — an undocumented rule name fails the same way an undocumented
+family does.
 """
 
 import os
@@ -267,6 +273,57 @@ def check_inventory(pkg_root: str = None, doc_path: str = None) -> int:
     return len(missing)
 
 
+def documented_rule_names(doc_path: str) -> set:
+    """Alert-rule names documented in docs/observability.md: backticked
+    kebab-case tokens in a table row's FIRST column. Rule names are
+    hyphenated, metric families are snake_case — the mandatory hyphen
+    keeps the family-inventory rows out of this set."""
+    import re
+
+    with open(doc_path) as f:
+        text = f.read()
+    out = set()
+    for line in text.splitlines():
+        m = re.match(r"\|\s*`([a-z0-9<>]+(?:-[a-z0-9<>]+)+)`\s*\|",
+                     line)
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def check_rule_inventory(rules=None, doc_path: str = None) -> int:
+    """The alert-rule half of --inventory, mirroring check_inventory:
+    every default-pack rule name AND every SLO-generated rule template
+    (rendered with the ``<name>`` placeholder) needs a backticked row
+    in the docs alert-rule table — a rule or template that exists in
+    code but not in the docs FAILS, so new alerting behavior cannot
+    land undocumented. A documented name no longer in code only
+    warns."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if rules is None:
+        from kubeflow_tpu.obs.rules import default_rules
+        from kubeflow_tpu.obs.slo import GENERATED_RULE_TEMPLATES
+
+        rules = [r.name for r in default_rules()]
+        rules += [t.format(name="<name>")
+                  for t in GENERATED_RULE_TEMPLATES]
+    doc_path = doc_path or os.path.join(repo, "docs",
+                                        "observability.md")
+    docs = documented_rule_names(doc_path)
+    missing = sorted(r for r in rules if r not in docs)
+    unknown = sorted(d for d in docs if d not in rules)
+    for name in missing:
+        print(f"FAIL rule-inventory: {name} is a live alert rule but "
+              f"has no row in {os.path.basename(doc_path)}")
+    for name in unknown:
+        print(f"warn rule-inventory: {name} documented but not a "
+              f"default or generated rule")
+    if not missing:
+        print(f"ok   rule-inventory: {len(rules)} rule names all "
+              f"documented ({len(docs)} documented total)")
+    return len(missing)
+
+
 def documented_chaos_points(doc_path: str) -> set:
     """Chaos-point names documented in docs/chaos.md: backticked
     ``component.site`` tokens in a table row's FIRST column (every real
@@ -364,6 +421,7 @@ def main(argv=None) -> int:
     failures += sum(check_span_log(p) for p in span_paths)
     if inventory:
         failures += check_inventory()
+        failures += check_rule_inventory()
     if chaos_inventory:
         failures += check_chaos_inventory()
     for family in required:

@@ -186,6 +186,13 @@ class TestBoxGuard:
                     "obs_flightrec_tokens_delta_frac"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_slo_keys_in_contract(self):
+        """The SLO-plane overhead numbers (ISSUE 18: a 16-SLO pack's
+        per-cycle burn-rate evaluation cost, and the <= 2% tenant-
+        ledger tokens/s tax) ride the compact BENCH_CONTRACT line."""
+        for key in ("obs_slo_eval_ms", "obs_slo_tokens_delta_frac"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_own_descendants_are_not_strays(self):
         # A gang worker tree spawned by THIS process is measurement, not
         # contamination — at any depth (mpi ranks are grandchildren).
