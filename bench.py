@@ -64,6 +64,13 @@ CONTRACT_KEYS = (
     "lm_qos_interactive_itl_p99_ms", "lm_qos_interactive_itl_p99_flood_ms",
     "lm_qos_flood_ratio", "lm_qos_batch_served",
     "lm_qos_deadline_shed", "lm_qos_deadline_timeouts",
+    "lm_disagg_handoffs", "lm_disagg_tokens_per_s",
+    "lm_disagg_interleaved_tokens_per_s", "lm_disagg_itl_p99_ms",
+    "lm_disagg_interleaved_itl_p99_ms",
+    "lm_disagg_migrate_ms_c64", "lm_disagg_recompute_ms_c64",
+    "lm_disagg_migrate_ms_c128", "lm_disagg_recompute_ms_c128",
+    "lm_disagg_migrate_ms_c224", "lm_disagg_recompute_ms_c224",
+    "lm_disagg_migrate_speedup",
     "serving_scale_p50_ms", "serving_scale_p99_ms",
     "serving_scale_success_rate", "serving_scale_max_replicas",
     "serving_scale_cold_start_ms", "serving_scale_rolled_back",
@@ -532,6 +539,14 @@ def main() -> int:
         # zero post-prefill deadline timeouts.
         guard.section("lm_qos")
         lm.update(_bench_lm_qos())
+    if have_time(300, "lm_disagg"):
+        # KV transfer plane (serving/kvtransfer.py): asymmetric
+        # prefill->decode disaggregation vs one interleaved engine
+        # (tokens/s + decode-side p99 ITL), and live-migration cost vs
+        # the seeded-re-dispatch recompute at 3 context lengths — the
+        # crossover where moving pages beats re-prefilling them.
+        guard.section("lm_disagg")
+        lm.update(_bench_lm_disagg())
     lm.update(guard.finish())
     if skipped:
         # A missing metric key must read as "budget cut this section",
@@ -1467,6 +1482,186 @@ def _bench_lm_qos(prefix: str = "lm_qos_") -> dict:
         return {prefix + "error": str(e)[:200]}
     finally:
         eng.close()
+
+
+def _bench_lm_disagg(clients: int = 6, prompt_len: int = 64,
+                     max_new: int = 24,
+                     prefix: str = "lm_disagg_") -> dict:
+    """KV transfer plane (serving/kvtransfer.py), two legs.
+
+    Disaggregated vs interleaved: ``clients`` single-prompt requests
+    through an asymmetric prefill-engine -> decode-engine pair (the
+    prefill tier ships each finished prompt's pages over the page-
+    stream codec and the decode tier resumes from them) vs the same
+    requests through one mixed engine — aggregate tokens/s plus p99
+    inter-token latency stamped at the on_token sink on the DECODE
+    side of each topology.
+
+    Migration vs recompute at 3 context lengths: an in-flight decode
+    is migrated donor->receiver (export + verified transfer + import)
+    and the wall time is compared against the receiver recomputing
+    the same-length context from the prompt (the seeded re-dispatch
+    fallback) — the crossover is the economics of moving KV instead
+    of re-prefilling it. Acceptance: migration beats recompute at the
+    longest benched length."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.generate import pow2_bucket
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from kubeflow_tpu.serving.engine import DecodeEngine, RequestMigrated
+
+    cfg = TransformerConfig(vocab_size=512, d_model=512, n_heads=4,
+                            head_dim=128, n_layers=4, d_ff=2048,
+                            max_seq_len=512, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(23)
+    engines = []
+
+    def make(role, send=None, slots=clients, chunk=8):
+        e = DecodeEngine(cfg, params, n_slots=slots, chunk_tokens=chunk,
+                         request_timeout_s=600.0, kv_page_size=16,
+                         name=f"disagg-{role}-{len(engines)}",
+                         role=role, kv_peer_send=send)
+        engines.append(e)
+        return e
+
+    def sink(ts):
+        def cb(tok):
+            if tok is not None:
+                ts.append(time.perf_counter())
+        return cb
+
+    def p99_ms(stamp_lists):
+        gaps = [b - a for ts in stamp_lists for a, b in zip(ts, ts[1:])]
+        return round(float(np.percentile(gaps, 99)) * 1000, 1) \
+            if gaps else 0.0
+
+    try:
+        out = {}
+        prompts = [list(rng.integers(0, cfg.vocab_size, prompt_len))
+                   for _ in range(clients)]
+        bucket = pow2_bucket(prompt_len, cfg.max_seq_len)
+
+        # -- leg 1: asymmetric prefill->decode pair vs one mixed engine
+        decode_eng = make("decode")
+        adopted = []
+
+        def send(payload):
+            ts = []
+            req = decode_eng.kv_import(payload, on_token=sink(ts))
+            adopted.append((req, ts))
+            return "decode-local"
+
+        prefill_eng = make("prefill", send=send)
+        for e in (prefill_eng, decode_eng):
+            e.warm([bucket])
+            e._gather_fn()  # transfer compiles out of the timed legs
+            e._scatter_fn()
+        prefill_eng.generate([list(rng.integers(0, cfg.vocab_size,
+                                                prompt_len))],
+                             max_new_tokens=2)  # warm decode path
+        t0 = time.perf_counter()
+        reqs = prefill_eng.submit_batch(prompts, max_new_tokens=max_new)
+        moved = 0
+        for r in reqs:
+            try:
+                r.result(600)
+            except RequestMigrated:
+                moved += 1
+        for r, _ in adopted:
+            r.result(600)
+        asym_dt = time.perf_counter() - t0
+        asym_tokens = sum(len(r.tokens) for r, _ in adopted) \
+            + sum(len(r.tokens) for r in reqs if r.error is None)
+        out[prefix + "handoffs"] = moved
+        out[prefix + "tokens_per_s"] = round(asym_tokens / asym_dt, 1)
+        out[prefix + "itl_p99_ms"] = p99_ms([ts for _, ts in adopted])
+
+        mixed_eng = make("mixed")
+        mixed_eng.warm([bucket])
+        mixed_eng.generate([list(rng.integers(0, cfg.vocab_size,
+                                              prompt_len))],
+                           max_new_tokens=2)  # warm
+        stamps = [[] for _ in prompts]
+        t0 = time.perf_counter()
+        mreqs = [mixed_eng.submit(p, max_new_tokens=max_new,
+                                  on_token=sink(ts))
+                 for p, ts in zip(prompts, stamps)]
+        for r in mreqs:
+            r.result(600)
+        mixed_dt = time.perf_counter() - t0
+        out[prefix + "interleaved_tokens_per_s"] = \
+            round(sum(len(r.tokens) for r in mreqs) / mixed_dt, 1)
+        out[prefix + "interleaved_itl_p99_ms"] = p99_ms(stamps)
+
+        # -- leg 2: migration vs recompute at 3 context lengths.
+        # Short chunks: migrate_out quiesces at iteration boundaries,
+        # so the in-flight chunk dispatch is a fixed floor under the
+        # measured cost — chunk=4 keeps that floor about the transfer's
+        # own size instead of 2x it.
+        recv = make("mixed", slots=2, chunk=4)
+        moved_to = []
+        donor = make("mixed", slots=2, chunk=4, send=lambda p: (
+            moved_to.append(recv.kv_import(p)), "recv-local")[1])
+        for e in (donor, recv):
+            e._gather_fn()
+            e._scatter_fn()
+        speedup = 0.0
+        for ctx in (64, 128, 224):
+            b = pow2_bucket(ctx, cfg.max_seq_len)
+            donor.warm([b])
+            recv.warm([b])
+            # Recompute cost: the receiver prefills a fresh ctx-token
+            # prompt from scratch (time to first token — what the
+            # seeded re-dispatch fallback pays before streaming).
+            p1 = list(rng.integers(0, cfg.vocab_size, ctx))
+            t0 = time.perf_counter()
+            recv.submit(p1, max_new_tokens=1).result(600)
+            recompute_ms = (time.perf_counter() - t0) * 1000
+            # Migration cost: a throttled in-flight decode of the same
+            # context length moves donor->receiver; migrate_out blocks
+            # through export + verified transfer + import + detach.
+            # max_new must leave the donor several chunk boundaries of
+            # runway past the export snapshot — the fail-safe ordering
+            # lets it keep decoding during the transfer, and a request
+            # that retires before the peer ACK counts as moved=0.
+            p2 = list(rng.integers(0, cfg.vocab_size, ctx))
+            r = donor.submit(p2, max_new_tokens=64,
+                             on_token=lambda t: time.sleep(0.005))
+            dl = time.monotonic() + 60
+            while len(r.tokens) < 2 and not r.done() \
+                    and time.monotonic() < dl:
+                time.sleep(0.005)
+            t0 = time.perf_counter()
+            stats = donor.migrate_out(reason="rebalance")
+            migrate_ms = (time.perf_counter() - t0) * 1000
+            for m in moved_to:
+                m.result(600)
+            moved_to.clear()
+            try:
+                r.result(600)
+            except RequestMigrated:
+                pass
+            if not stats["moved"]:
+                continue  # donor finished first: no number this rung
+            out[prefix + f"migrate_ms_c{ctx}"] = round(migrate_ms, 1)
+            out[prefix + f"recompute_ms_c{ctx}"] = round(recompute_ms, 1)
+            speedup = recompute_ms / migrate_ms if migrate_ms else 0.0
+        # Speedup at the LONGEST length that actually migrated —
+        # the acceptance bar is > 1 there (moving pages beats
+        # re-prefilling them where context is big).
+        out[prefix + "migrate_speedup"] = round(speedup, 2)
+        return out
+    except Exception as e:  # secondary metric must not sink the bench
+        return {prefix + "error": str(e)[:200]}
+    finally:
+        for e in engines:
+            e.close()
 
 
 def _mixed_fleet_leg(prefix: str, n_prompts: int = 4,

@@ -199,6 +199,22 @@ class InferenceService(Resource):
                 raise ValidationError(
                     f"spec.{rev}.prefillChunkTokens",
                     "must be an integer >= 0 (0 = monolithic prefill)")
+            # KV transfer plane (docs/serving.md "KV as a fleet
+            # resource"): the replica's disaggregation tier and the
+            # host-RAM offload capacity in pages (0 = off).
+            role = rspec.get("role")
+            if role is not None and role not in ("prefill", "decode",
+                                                 "mixed"):
+                raise ValidationError(
+                    f"spec.{rev}.role",
+                    f"{role!r} not one of prefill/decode/mixed")
+            op = rspec.get("kvOffloadPages")
+            if op is not None and (isinstance(op, bool)
+                                   or not isinstance(op, int)
+                                   or op < 0):
+                raise ValidationError(
+                    f"spec.{rev}.kvOffloadPages",
+                    "must be an integer >= 0 (0 = no host offload)")
         sp = self.spec.get("schedulingPriority")
         if sp is not None and (isinstance(sp, bool)
                                or not isinstance(sp, int)):

@@ -103,6 +103,7 @@ KNOWN_POINTS = frozenset({
     "router.affinity", "router.stream_cut",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
+    "kv.transfer", "kv.offload",
 })
 
 

@@ -22,7 +22,7 @@ import json
 import os
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .api.base import Resource, resource_class
 from .api.training import TrainingJob
@@ -605,7 +605,10 @@ def _slice_state(jobs) -> "Tuple[List[_SliceRow], List[_SliceRow]]":
 def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
     """Elastic serving reservations as slice rows (`kfx queue` /
     `kfx top` header): an InferenceService's spawned predictor replicas
-    (default + canary) each hold one chip, like gang members."""
+    (default + canary) each hold one chip, like gang members. A
+    disaggregated service (KV transfer plane) shows its per-tier
+    replica split — ``prefill=N decode=M`` — since the tiers scale on
+    different signals and a capacity squeeze hits them separately."""
     rows = []
     for isvc in isvcs:
         repl = isvc.status.get("replicas") or {}
@@ -615,12 +618,22 @@ def _serving_slice_rows(isvcs) -> "List[_SliceRow]":
         auto = isvc.status.get("autoscaling") or {}
         wanted = sum(int((auto.get(r) or {}).get("desired") or 0)
                      for r in ("default", "canary"))
+        detail = (f"elastic; autoscaler wants {wanted}"
+                  if wanted and wanted != chips else "elastic")
+        tiers: Dict[str, int] = {}
+        for r in ("default", "canary"):
+            role = str((auto.get(r) or {}).get("role") or "mixed")
+            n = int(repl.get(r) or 0)
+            if n > 0 and role != "mixed":
+                tiers[role] = tiers.get(role, 0) + n
+        if tiers:
+            detail += "; " + " ".join(
+                f"{role}={n}" for role, n in sorted(tiers.items()))
         rows.append(_SliceRow(
             name=isvc.name, kind="InferenceService",
             namespace=isvc.namespace, priority=isvc.scheduling_priority(),
             chips=chips, state="Serving",
-            detail=(f"elastic; autoscaler wants {wanted}"
-                    if wanted and wanted != chips else "elastic"),
+            detail=detail,
             created=isvc.metadata.creation_timestamp))
     return rows
 
@@ -635,7 +648,9 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
     classifiers and engines with the signal absent), the adapter-slot
     pool as "pinned/total" (ADPT column — multi-tenant LoRA revisions
     only), the in-flight QoS-class split as "interactive/batch" (I/B
-    column — request plane, LM revisions only), cumulative
+    column — request plane, LM revisions only), the disaggregation
+    tier as P/D/M (ROLE column — KV transfer plane) with cumulative
+    KV migrations out of the revision (MIG column), cumulative
     replica restarts (crashes + liveness wedge-kills, the
     kfx_replica_restarts_total number), window-rate TOK/S + RPS
     columns, plus the canary traffic split.
@@ -657,6 +672,10 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
                 continue
             a = auto.get(rev) or {}
             panic = " (panic)" if a.get("panic") else ""
+            # Disaggregation tier (KV transfer plane): P/D/M for
+            # prefill/decode/mixed, "-" for pre-role status snapshots.
+            role = str(a.get("role") or "")[:1].upper() or "-"
+            mig = a.get("migrations")  # cumulative KV migrations out
             kv = a.get("kvUtil")
             acc = a.get("specAcceptRate")
             skip = a.get("prefillSkip")
@@ -669,7 +688,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
                 if window_skip is not None:
                     skip = window_skip
             rows.append([
-                isvc.name, isvc.namespace, rev,
+                isvc.name, isvc.namespace, rev, role,
                 f"{int(ready.get(rev) or 0)}/{int(repl.get(rev) or 0)}",
                 f"{a.get('desired', '-')}{panic}",
                 str(a.get("target", "-")),
@@ -679,6 +698,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
                 str(a.get("quant") or "-"),
                 str(adpt) if adpt else "-",
                 str(classes) if classes else "-",
+                str(int(mig)) if mig else "-",
                 str(a["restarts"]) if a.get("restarts") is not None
                 else "-",
                 f"{tok_s:.1f}" if tok_s is not None else "-",
@@ -691,10 +711,10 @@ def _print_serving_top(rows: List[List[str]]) -> None:
     if not rows:
         return
     print()
-    _print_table(rows, ["ISVC", "NAMESPACE", "REV", "READY/REPL",
-                        "DESIRED", "TARGET", "KV%", "SKIP%", "ACC%",
-                        "Q", "ADPT", "I/B", "RESTARTS", "TOK/S", "RPS",
-                        "CANARY%"])
+    _print_table(rows, ["ISVC", "NAMESPACE", "REV", "ROLE",
+                        "READY/REPL", "DESIRED", "TARGET", "KV%",
+                        "SKIP%", "ACC%", "Q", "ADPT", "I/B", "MIG",
+                        "RESTARTS", "TOK/S", "RPS", "CANARY%"])
 
 
 def _revision_window_rates(query, namespace: str, isvc: str,

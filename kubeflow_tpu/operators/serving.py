@@ -35,6 +35,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
@@ -95,7 +96,9 @@ class _Revision:
                  adapters: Optional[dict] = None,
                  qos_default: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
-                 rate_limits: Optional[dict] = None):
+                 rate_limits: Optional[dict] = None,
+                 lm_role: str = "mixed",
+                 kv_offload_pages: Optional[int] = None):
         self.name = name
         self.model_name = model_name
         self.model_dir = model_dir
@@ -128,6 +131,18 @@ class _Revision:
         self.qos_default = qos_default
         self.deadline_ms = deadline_ms
         self.rate_limits = rate_limits
+        # KV transfer plane (spec.<rev>.role / kvOffloadPages,
+        # api/serving.py): the disaggregation tier this revision's
+        # replicas serve ("prefill" ships finished prompts' pages to
+        # the decode tier, "decode" receives them, "mixed" does both
+        # phases locally) and the host-RAM offload capacity. Exported
+        # as KFX_LM_ROLE / KFX_LM_KV_OFFLOAD_PAGES; the decode-peer
+        # URL set is NOT env — ports change on respawn, so the
+        # controller pushes it to live replicas via :kvpeers instead.
+        self.lm_role = lm_role
+        self.kv_offload_pages = kv_offload_pages
+        # Last :kvpeers payload acked per replica port (push dedup).
+        self.kv_peers_pushed: Dict[int, bytes] = {}
         # KFServing custom-predictor parity: a user-provided container
         # command serves the port instead of a framework server. The
         # command sees KFX_PORT / KFX_MODEL_NAME (and $(KFX_PORT)-style
@@ -170,6 +185,11 @@ class _Revision:
         # kfx_lm_class_active series at all).
         self.engine_active_interactive: Optional[float] = None
         self.engine_active_batch: Optional[float] = None
+        # KV transfer plane: cumulative migrations (all reasons,
+        # summed across replicas) for `kfx top`'s MIG column, and
+        # host-RAM offload tier residency in pages.
+        self.engine_migrations = 0.0
+        self.engine_offload_pages = 0.0
 
     @property
     def engine_kv_util(self):
@@ -253,6 +273,7 @@ class _Revision:
         self._prefill_env(env)
         self._adapter_env(env)
         self._request_plane_env(env)
+        self._kv_env(env)
         logf = open(os.path.join(
             self.workdir, f"{self.name}-{len(self.replicas)}.log"), "ab")
         proc = subprocess.Popen(argv, env=env, stdout=logf,
@@ -316,6 +337,20 @@ class _Revision:
             env["KFX_LM_DEADLINE_MS"] = str(float(self.deadline_ms))
         if self.rate_limits is not None:
             env["KFX_LM_RATE_LIMITS"] = json.dumps(self.rate_limits)
+
+    def _kv_env(self, env: dict) -> None:
+        """spec.<rev>.role / kvOffloadPages -> the LMPredictor's
+        KV-transfer-plane knobs (disaggregation tier + host-RAM
+        offload capacity). Only explicit fields export — "mixed" is
+        the predictor's own default; classifier frameworks ignore
+        them."""
+        if self.role != "predictor":
+            return
+        if self.lm_role and self.lm_role != "mixed":
+            env["KFX_LM_ROLE"] = str(self.lm_role)
+        if self.kv_offload_pages is not None:
+            env["KFX_LM_KV_OFFLOAD_PAGES"] = \
+                str(int(self.kv_offload_pages))
 
     def _quant_env(self, env: dict) -> None:
         """spec.<rev>.quantization -> the LMPredictor's quantization
@@ -606,6 +641,8 @@ class InferenceServiceController(Controller):
             qos_default = spec.get("qosDefault")
             deadline_ms = spec.get("deadlineMs")
             rate_limits = spec.get("rateLimits")
+            lm_role = str(spec.get("role", "mixed"))
+            kv_offload_pages = spec.get("kvOffloadPages")
             if rev is None or rev.model_dir != model_dir \
                     or rev.device != device or rev.batcher != batcher \
                     or rev.container != container \
@@ -615,7 +652,9 @@ class InferenceServiceController(Controller):
                     or rev.adapters != adapters \
                     or rev.qos_default != qos_default \
                     or rev.deadline_ms != deadline_ms \
-                    or rev.rate_limits != rate_limits:
+                    or rev.rate_limits != rate_limits \
+                    or rev.lm_role != lm_role \
+                    or rev.kv_offload_pages != kv_offload_pages:
                 if rev is not None:
                     # Revision respawn (model/device/batcher/spec-env
                     # change): drop the doomed replicas from the router
@@ -642,6 +681,8 @@ class InferenceServiceController(Controller):
                     qos_default=qos_default,
                     deadline_ms=deadline_ms,
                     rate_limits=rate_limits,
+                    lm_role=lm_role,
+                    kv_offload_pages=kv_offload_pages,
                 )
                 # The restart tally is cumulative per revision NAME
                 # (matching kfx_replica_restarts_total's label): a
@@ -740,6 +781,18 @@ class InferenceServiceController(Controller):
                     [f"127.0.0.1:{r.port}"
                      for r in rev.replicas[:want] if r.ready])
                 doomed = rev.replicas[want:]
+                # Migrate-before-kill (KV transfer plane): each doomed
+                # replica pushes its in-flight generations' pages to a
+                # surviving peer FIRST, so scale-in moves decode work
+                # byte-identically instead of shedding it into the
+                # drain's retriable-503 recompute path. A failed
+                # transfer is a degrade, not a loss — the drain below
+                # still covers those requests.
+                self._migrate_replicas(
+                    isvc, rev_name, doomed,
+                    [f"http://127.0.0.1:{r.port}"
+                     for r in rev.replicas[:want] if r.ready],
+                    "scale_in", reg)
                 self._drain_replicas(
                     isvc, rev_name, doomed,
                     self._drain_window_s(isvc.revision_spec(rev_name)),
@@ -878,6 +931,11 @@ class InferenceServiceController(Controller):
             rt.rollout = None
             rt.rollout_status = None
 
+        # KV transfer plane: point every prefill-tier replica at the
+        # CURRENT decode-tier URL set (ports change on respawn, so
+        # this is per-reconcile state, not spawn-time env).
+        self._sync_kv_peers(isvc, rt)
+
         self._sync_status(isvc, rt, all_ready, graph_ready)
         return Result(requeue=True, requeue_after=0.25) if not all_ready \
             else None
@@ -918,6 +976,7 @@ class InferenceServiceController(Controller):
             return 0
         peak = backend_set.take_peak_concurrency()
         queue_depth = self._sample_engine(isvc, rev_name, rev)
+        queue_depth += self._tier_pressure(isvc, rev_name, rev, cfg)
         asc.observe(now_mono, peak, queue_depth)
         reg.gauge(
             "kfx_router_peak_concurrency",
@@ -996,8 +1055,61 @@ class InferenceServiceController(Controller):
             status["classes"] = (
                 f"{int(rev.engine_active_interactive)}/"
                 f"{int(rev.engine_active_batch or 0)}")
+        # Disaggregation tier — `kfx top`'s ROLE column (P/D/M).
+        status["role"] = rev.lm_role
+        if rev.engine_migrations > 0:
+            # Cumulative KV migrations out of this revision's replicas
+            # (disagg handoffs + drain/scale-in/rebalance moves) —
+            # `kfx top`'s MIG column.
+            status["migrations"] = int(rev.engine_migrations)
+        if rev.engine_offload_pages > 0:
+            # Host-RAM offload tier residency (pages currently parked
+            # off-HBM across replicas).
+            status["offloadPages"] = int(rev.engine_offload_pages)
         rt.autoscaling_status[rev_name] = status
         return decision.desired
+
+    def _tier_pressure(self, isvc: InferenceService, rev_name: str,
+                       rev: _Revision, cfg) -> float:
+        """Disaggregation-tier load shaping (DistServe-style): the two
+        tiers saturate on DIFFERENT resources, so each converts its own
+        signal into extra unmet-concurrency pressure on top of the
+        shared queue-depth sample. The prefill tier is arrival-bound —
+        a rising admission-to-first-prefill queue wait (the
+        kfx_lm_queue_wait_seconds histogram read as a trailing mean)
+        converts to pressure against the spec's per-replica target.
+        The decode tier is residency-bound — token-weighted KV
+        occupancy past the 85% headroom line converts likewise, so
+        the tier scales out BEFORE the pool starts evicting live
+        prefixes. Mixed revisions add nothing: peak concurrency +
+        queue depth already cover both phases there."""
+        if rev.lm_role == "decode":
+            util = rev.engine_kv_util
+            if util is None or util <= 0.85:
+                return 0.0
+            return ((util - 0.85) / 0.15) * cfg.target_concurrency \
+                * max(1, len(rev.replicas))
+        if rev.lm_role == "prefill" and self.telemetry is not None:
+            sel = {"namespace": isvc.namespace, "isvc": isvc.name,
+                   "revision": rev_name}
+            waited = self.telemetry.query(
+                "kfx_lm_queue_wait_seconds_sum", fn="delta",
+                labels=sel, since_s=30.0).value
+            n = self.telemetry.query(
+                "kfx_lm_queue_wait_seconds_count", fn="delta",
+                labels=sel, since_s=30.0).value
+            if not waited or not n:
+                return 0.0
+            mean_wait = waited / n
+            if mean_wait <= 0.1:
+                return 0.0
+            # One per-replica target of pressure per second of mean
+            # queue wait past the 100ms grace: admitted work sitting
+            # in the queue needs replicas regardless of how few
+            # requests are in flight at the sample instant.
+            return (mean_wait - 0.1) * cfg.target_concurrency \
+                * max(1, len(rev.replicas))
+        return 0.0
 
     # -- self-healing --------------------------------------------------------
     def _count_restarts(self, isvc: InferenceService, rev_name: str,
@@ -1239,6 +1351,77 @@ class InferenceServiceController(Controller):
         for t in threads:
             t.join(window_s + 5.0)
 
+    def _migrate_replicas(self, isvc: InferenceService, rev_name: str,
+                          doomed: List[_Replica], survivors: List[str],
+                          reason: str, reg) -> None:
+        """Migrate-before-kill: POST ``:migrate`` to each doomed
+        replica, pointing it at a surviving peer (round-robin), so a
+        planned kill moves in-flight KV pages instead of recomputing
+        them. Best-effort by design: an unreachable replica or a
+        refused transfer falls through to the drain + seeded
+        re-dispatch recovery that already guarantees zero lost
+        requests."""
+        if not survivors:
+            return
+        for i, r in enumerate(doomed):
+            if not r.ready:
+                continue
+            peer = survivors[i % len(survivors)]
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{r.port}/v1/models/{isvc.name}"
+                    f":migrate?peer={urllib.parse.quote(peer, safe='')}"
+                    f"&reason={reason}", data=b"", method="POST")
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    stats = json.load(resp)
+            except (OSError, ValueError):
+                continue
+            moved = int(stats.get("moved", 0) or 0)
+            if moved:
+                self.record_event(
+                    isvc, "Normal", "KVMigrated",
+                    f"{rev_name} replica :{r.port} moved {moved} "
+                    f"request(s) / {int(stats.get('pages', 0) or 0)} "
+                    f"page(s) to {peer} before {reason}")
+
+    def _sync_kv_peers(self, isvc: InferenceService,
+                       rt: _IsvcRuntime) -> None:
+        """Point every READY prefill-tier replica at the current
+        decode-tier URL set (all ready replicas of decode-role
+        predictor revisions of this InferenceService). Pushed only
+        when the set changed for that replica; a failed push retries
+        next reconcile — until then the replica's handoff degrades to
+        decoding locally."""
+        decode = sorted(
+            f"http://127.0.0.1:{r.port}"
+            for rev in rt.revisions.values()
+            if rev.role == "predictor" and rev.lm_role == "decode"
+            for r in rev.replicas if r.ready)
+        payload = json.dumps(decode).encode()
+        for rev in rt.revisions.values():
+            if rev.role != "predictor" or rev.lm_role != "prefill":
+                continue
+            live = set()
+            for r in rev.replicas:
+                live.add(r.port)
+                if not r.ready or \
+                        rev.kv_peers_pushed.get(r.port) == payload:
+                    continue
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{r.port}/v1/models/"
+                        f"{isvc.name}:kvpeers", data=payload,
+                        method="POST",
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=2.0):
+                        pass
+                except (OSError, ValueError):
+                    continue
+                rev.kv_peers_pushed[r.port] = payload
+            for port in [p for p in rev.kv_peers_pushed
+                         if p not in live]:
+                del rev.kv_peers_pushed[port]  # respawned replica
+
     def _drain_revision(self, isvc: InferenceService, rev_name: str,
                         rev: _Revision, spec: Optional[dict],
                         reg) -> None:
@@ -1284,6 +1467,11 @@ class InferenceServiceController(Controller):
         rev.engine_prompt_tokens = total("kfx_lm_prompt_tokens_admitted")
         rev.engine_adapter_slots = total("kfx_lm_adapter_slots")
         rev.engine_adapter_free = total("kfx_lm_adapter_slots_free")
+        # KV transfer plane: cumulative migrations (all reasons) for
+        # `kfx top`'s MIG column, host-RAM offload residency for the
+        # status block.
+        rev.engine_migrations = total("kfx_lm_kv_migrations_total")
+        rev.engine_offload_pages = total("kfx_lm_kv_offload_pages")
         # Per-QoS-class in-flight split (`kfx top`'s I/B column): the
         # qos label rides the one family, so split by label value.
         # The engine exports both classes even at zero, so ANY sample

@@ -190,6 +190,7 @@ import numpy as np
 from .. import chaos
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry, default_registry
+from . import kvtransfer
 from .prefix import chain_hash as _chain_hash
 
 # Admission wait buckets (seconds): a healthy engine admits within one
@@ -236,6 +237,26 @@ class EngineDraining(EngineOverloaded):
     503 + Retry-After is exactly right: the request is well-formed and
     another replica (or this one's successor) can serve it, which is
     what the router's re-dispatch does."""
+
+
+class RequestMigrated(EngineOverloaded):
+    """The request's KV pages were exported to a peer replica
+    (serving/kvtransfer.py) and the peer is already decoding it. The
+    server maps this to 503 + a near-zero Retry-After + an
+    ``X-Kfx-Migrated`` peer hint; the router's existing bounded
+    re-dispatch (seeded recovery) lands on the peer, which attaches
+    the re-dispatched body to the adopted in-flight generation by its
+    content-derived resume key — byte-identical resume, including
+    mid-SSE via the ``stream_skip`` plumbing. If the re-dispatch
+    misses the peer (or the adoption expired), the SAME body degrades
+    to the plain seeded recompute: migration failure is never a new
+    failure mode, only a lost optimization."""
+
+    def __init__(self, msg: str, peer: str = "",
+                 retry_after_s: float = 0.05):
+        super().__init__(msg)
+        self.peer = peer
+        self.retry_after_s = retry_after_s
 
 
 class PageAllocError(EngineOverloaded):
@@ -593,12 +614,18 @@ class PrefixCache:
             pe.nchildren -= 1
         return self.mgr.decref([e.page])
 
-    def evict_one(self) -> bool:
+    def evict_one(self, spill: Optional[Callable[["_PrefixEntry"],
+                                                 None]] = None) -> bool:
         """Reclaim the least-recently-used childless entry whose page
         no slot is still reading (pool ref == 1). Returns whether a
-        page went back to the free list."""
+        page went back to the free list. ``spill`` sees the entry
+        BEFORE it drops — the engine's host-RAM offload demotion
+        (DecodeEngine._spill_page) reads the page there; the selection
+        rule above is what makes that read refcount-safe."""
         for e in list(self._lru.values()):
             if e.nchildren == 0 and self.mgr.ref[e.page] == 1:
+                if spill is not None:
+                    spill(e)
                 self._drop(e)
                 return True
         return False
@@ -647,7 +674,10 @@ class DecodeEngine:
                  qos_default: str = "interactive",
                  deadline_default_s: float = 0.0,
                  rate_limits: Optional[Dict[str, float]] = None,
-                 rate_burst_s: float = 2.0):
+                 rate_burst_s: float = 2.0,
+                 role: str = "mixed",
+                 kv_peer_send: Optional[Callable[[bytes], str]] = None,
+                 kv_offload_pages: int = 0):
         import jax
 
         from ..models.generate import decode_config
@@ -775,6 +805,32 @@ class DecodeEngine:
         self._prefix: Optional[PrefixCache] = \
             PrefixCache(self._mgr) if prefix_cache else None
         self._prompt_tokens = 0  # prompt tokens admitted (for skip frac)
+
+        # -- KV transfer plane (serving/kvtransfer.py): the replica's
+        # disaggregation role, the peer sender exports ship through,
+        # and the host-RAM offload tier cold prefix pages demote into.
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"unknown role {role!r} (expected prefill, decode or "
+                "mixed)")
+        self.role = role
+        self._peer_send = kv_peer_send
+        if kv_offload_pages < 0:
+            raise ValueError("kv_offload_pages must be >= 0 "
+                             "(0 = no host-RAM offload tier)")
+        self._offload: Optional[kvtransfer.HostOffloadTier] = \
+            kvtransfer.HostOffloadTier(kv_offload_pages) \
+            if kv_offload_pages else None
+        # rids a prefill-role engine must not (re-)hand off: the
+        # transfer is already in flight, or it failed and the slot
+        # decodes locally (the mixed fallback). Bounded: cleared
+        # wholesale past 4096 entries — a stale rid only costs one
+        # redundant skip check, never correctness.
+        self._handoff_skip: set = set()
+        # Cross-thread control jobs for the loop thread (KV export
+        # snapshots, import installs): slot state is loop-thread-only,
+        # so other threads post a thunk and wait (_run_on_loop).
+        self._control: "deque[Callable[[], None]]" = deque()
 
         # -- speculative-decode state: a layer-truncated draft sharing
         # the target's tokenizer/vocab/page geometry, proposing from
@@ -907,6 +963,8 @@ class DecodeEngine:
         self._reset_exec: Any = None
         self._draft_reset_exec: Any = None
         self._copy_exec: Any = None
+        self._gather_exec: Any = None
+        self._scatter_exec: Any = None
         self._quant_chaos_exec: Any = None
         self._draft_quant_chaos_exec: Any = None
 
@@ -1130,6 +1188,27 @@ class DecodeEngine:
         reg.gauge("kfx_lm_kv_pages_free",
                   "KV cache pages on the free list.").set(
                       self._mgr.n_free, model=self.name)
+        # KV transfer-plane families (serving/kvtransfer.py), seeded
+        # so a pre-migration scrape already sees them: migrations by
+        # reason, pages shipped/adopted, the host offload tier's
+        # occupancy, and the end-to-end transfer timer.
+        reg.counter("kfx_lm_kv_migrations_total",
+                    "In-flight requests migrated to a peer replica, "
+                    "by reason.").inc(0, model=self.name,
+                                      reason="drain")
+        reg.counter("kfx_lm_kv_pages_transferred_total",
+                    "KV pages shipped to or adopted from peer "
+                    "replicas.").inc(0, model=self.name)
+        reg.gauge("kfx_lm_kv_offload_pages",
+                  "Prefix-cache pages held per KV offload tier.").set(
+                      len(self._offload)
+                      if self._offload is not None else 0,
+                      model=self.name, tier="host")
+        reg.histogram("kfx_lm_kv_transfer_seconds",
+                      "End-to-end KV transfer time (export snapshot "
+                      "to peer acknowledgement).",
+                      buckets=QUEUE_WAIT_BUCKETS).observe(
+                          0.0, n=0, model=self.name)
         # Engine truth, not a bench-derived number: capacity planning
         # reads pool bytes = kv_pages x page_size x this gauge.
         reg.gauge("kfx_lm_kv_bytes_per_token",
@@ -2322,7 +2401,9 @@ class DecodeEngine:
                 raise PageAllocError(
                     f"chaos[engine.kv_alloc]: {self.name}")
         while self._mgr.n_free < n:
-            if self._prefix is None or not self._prefix.evict_one():
+            if self._prefix is None or not self._prefix.evict_one(
+                    spill=(self._spill_page
+                           if self._offload is not None else None)):
                 break  # alloc() raises with the honest numbers
         pages = self._mgr.alloc(n)
         if self._mgr.dirty:
@@ -2372,16 +2453,723 @@ class DecodeEngine:
         self._draft_tables[slot, :] = -1
         self._spec_ok[slot] = False
 
+    # -- KV transfer plane (serving/kvtransfer.py) ---------------------------
+    # Slot state is loop-thread-only, so every transfer operation that
+    # touches it (export snapshot, import install, detach) runs as a
+    # control job at an iteration boundary: other threads post a thunk
+    # and wait. The network leg never holds the loop: migrate_out
+    # snapshots on the loop, ships from the caller's thread, and only
+    # detaches after the peer ACKs — so a severed transfer leaves the
+    # donor's copy authoritative and running (zero lost requests).
+
+    def _run_on_loop(self, fn: Callable[[], Any],
+                     timeout: float = 30.0) -> Any:
+        """Run ``fn`` on the decode-loop thread at the next iteration
+        boundary and return its result (exceptions propagate to the
+        caller). Called FROM the loop thread it just runs inline —
+        handoff and offload paths compose without deadlock."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def job() -> None:
+            try:
+                box["r"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["e"] = e
+            finally:
+                done.set()
+
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError(f"engine {self.name} is closed")
+            self._control.append(job)
+            self._cond.notify()
+        deadline = time.monotonic() + timeout
+        while not done.wait(0.05):
+            if self._stopped and not done.is_set():
+                raise RuntimeError(
+                    f"engine {self.name} closed before the control "
+                    "job ran")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"engine {self.name} loop did not service the "
+                    f"control job within {timeout}s")
+        if "e" in box:
+            raise box["e"]
+        return box.get("r")
+
+    def _service_control(self) -> None:
+        """Drain pending control jobs (loop thread, iteration start).
+        Job exceptions are captured into the waiter's box by the job
+        wrapper itself — a refused import must fail the TRANSFER, not
+        the engine."""
+        while True:
+            with self._cond:
+                if not self._control:
+                    return
+                job = self._control.popleft()
+            job()
+
+    def _gather_fn(self):
+        """Compiled single-page gather: one [layers, 1, ...] row per
+        cache-tree leaf at page ``src`` — the export read and the
+        offload demotion read (ONE compile serves both). Never
+        donates: the pool must survive the read."""
+        with self._exec_lock:
+            fn = self._gather_exec
+        if fn is not None:
+            return fn
+        import jax
+
+        def run(cache, src):
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.lax.dynamic_slice_in_dim(
+                    leaf, src, 1, axis=1), cache)
+
+        sds = jax.ShapeDtypeStruct
+        specs = (self._cache_specs(), sds((), np.int32))
+        fn = self._build(jax.jit(run).lower(*specs).compile)
+        with self._exec_lock:
+            if self._gather_exec is None:
+                self._gather_exec = fn
+            return self._gather_exec
+
+    def _scatter_fn(self):
+        """Compiled single-page scatter: writes one gathered row tree
+        into page ``dst`` — the import write and the offload
+        promote-on-hit (ONE compile serves both)."""
+        with self._exec_lock:
+            fn = self._scatter_exec
+        if fn is not None:
+            return fn
+        import jax
+
+        def run(cache, row, dst):
+            return jax.tree_util.tree_map(
+                lambda leaf, r: jax.lax.dynamic_update_slice_in_dim(
+                    leaf, r, dst, axis=1), cache, row)
+
+        donate = (0,) if self._donate else ()
+        sds = jax.ShapeDtypeStruct
+        specs = (self._cache_specs(), self._row_specs(),
+                 sds((), np.int32))
+        fn = self._build(
+            jax.jit(run, donate_argnums=donate).lower(*specs).compile)
+        with self._exec_lock:
+            if self._scatter_exec is None:
+                self._scatter_exec = fn
+            return self._scatter_exec
+
+    def _row_specs(self):
+        """ShapeDtypeStructs of ONE page's row tree (page axis is 1
+        on every cache leaf — the _copy_fn convention)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape[:1] + (1,) + s.shape[2:], s.dtype),
+            self._cache_specs())
+
+    def _leaf_descriptors(self) -> List[Dict[str, Any]]:
+        """Wire geometry: one (path, per-page shape, dtype) descriptor
+        per cache-tree leaf in flatten order. The receiver requires
+        leaf-for-leaf identity before scattering a single page — int8
+        entries, scale planes and cached position ids all described,
+        so an f32 donor can never feed an int8 receiver."""
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            self._row_specs())
+        return [{"path": "".join(str(k) for k in path),
+                 "shape": [int(d) for d in s.shape],
+                 "dtype": np.dtype(s.dtype).name}
+                for path, s in flat]
+
+    def _page_payload(self, page: int) -> bytes:
+        """One page's wire payload: every cache-tree leaf's row bytes
+        concatenated in flatten order (loop thread only)."""
+        import jax
+
+        rows = self._gather_fn()(self._cache, np.int32(page))
+        flat, _ = jax.tree_util.tree_flatten(rows)
+        return b"".join(np.asarray(x).tobytes() for x in flat)
+
+    def _unpack_page(self, payload: bytes):
+        """Parse one wire payload back into a page row tree (numpy
+        host arrays, fed straight to the compiled scatter). Size
+        mismatches raise TransferError — geometry drift must never
+        scatter garbage into the pool."""
+        import jax
+
+        specs, treedef = jax.tree_util.tree_flatten(self._row_specs())
+        arrays: List[np.ndarray] = []
+        off = 0
+        for s in specs:
+            dt = np.dtype(s.dtype)
+            count = int(np.prod(s.shape))
+            nbytes = count * dt.itemsize
+            if off + nbytes > len(payload):
+                raise kvtransfer.TransferError(
+                    f"short page payload ({len(payload)} bytes, leaf "
+                    f"at offset {off} needs {nbytes})")
+            arrays.append(np.frombuffer(
+                payload, dtype=dt, count=count,
+                offset=off).reshape(s.shape))
+            off += nbytes
+        if off != len(payload):
+            raise kvtransfer.TransferError(
+                f"page payload size mismatch ({len(payload)} bytes, "
+                f"geometry says {off})")
+        return jax.tree_util.tree_unflatten(treedef, arrays)
+
+    def _export_slot(self, slot: int) -> Tuple[Request, bytes, int]:
+        """Snapshot one slot's in-flight request as a kvtransfer
+        payload (loop thread only): pin the slot's pages, gather each
+        to host bytes, and pack them with the full resume state —
+        prompt + generated tokens, sampling knobs, RNG stash, the
+        pending-logits row (mid-decode) or the prefill cursor
+        (mid-chunking). The slot keeps running; the caller decides
+        when (and whether) to detach it (_finish_migrated)."""
+        req = self._slots[slot]
+        assert req is not None, f"export of empty slot {slot}"
+        cur = self._prefilling.get(slot)
+        blocks = [b for b in range(self.n_blocks)
+                  if self._tables[slot, b] >= 0]
+        phys = [int(self._tables[slot, b]) for b in blocks]
+        with obs_trace.span("engine.kv_export", trace_id=req.trace_id,
+                            parent_id=req.span_id, model=self.name,
+                            slot=str(slot), pages=str(len(blocks))):
+            for pg in phys:
+                self._mgr.incref(pg)  # pinned for the gather window
+            try:
+                frames = [self._page_payload(pg) for pg in phys]
+            finally:
+                self._mgr.decref(phys)
+            rd = req.deadline
+            header: Dict[str, Any] = {
+                "format": 1,
+                "model": self.name,
+                "page_size": self.page_size,
+                "max_seq_len": int(self.cfg.max_seq_len),
+                "vocab": int(self.cfg.vocab_size),
+                "leaves": self._leaf_descriptors(),
+                "blocks": blocks,
+                "resume": kvtransfer.resume_key(
+                    req.prompt, req.max_new, req.temperature,
+                    req.top_k, req.seed, req.stop, req.adapter),
+                "req": {
+                    "prompt": req.prompt,
+                    "tokens": list(req.tokens),
+                    "max_new": req.max_new,
+                    "temperature": req.temperature,
+                    "top_k": req.top_k,
+                    "seed": req.seed,
+                    "stop": req.stop,
+                    "adapter": req.adapter or "",
+                    "qos": req.qos,
+                    "tenant": req.tenant,
+                    "deadline_s": (max(rd - time.monotonic(), 0.001)
+                                   if rd is not None else 0.0),
+                },
+            }
+            if cur is not None:
+                # Mid-prefill: the chunked cursor is the shipping unit
+                # — the receiver resumes chunking at ``next``.
+                header["phase"] = "prefill"
+                header["cursor"] = {"next": int(cur["next"]),
+                                    "bucket": int(cur["bucket"]),
+                                    "remaining": int(cur["remaining"]),
+                                    "fresh": bool(cur["fresh"])}
+                rng = req.rng
+            else:
+                header["phase"] = "decode"
+                header["slot_state"] = {
+                    "pos": int(self._pos[slot]),
+                    "loc": int(self._loc[slot]),
+                    "max_loc": int(self._max_loc[slot]),
+                    "pending": int(self._pending[slot]),
+                }
+                # The decode dispatch samples from the slot's LAST
+                # logits row — it is state, exactly like the RNG.
+                rng = np.asarray(self._rngs[slot], np.uint32)
+                logrow = np.asarray(self._logbuf[slot])
+                header["aux"] = {"dtype": logrow.dtype.name,
+                                 "shape": [int(d)
+                                           for d in logrow.shape]}
+                frames = frames + [logrow.tobytes()]
+            header["rng"] = ([int(x) for x in rng]
+                             if rng is not None else None)
+            payload = kvtransfer.encode(header, frames)
+        return req, payload, len(blocks)
+
+    def migrate_out(self, reason: str = "manual",
+                    send: Optional[Callable[[bytes], str]] = None,
+                    rids: Optional[Sequence[int]] = None
+                    ) -> Dict[str, int]:
+        """Live migration: export every in-flight request (optionally
+        filtered by rid), ship each to a peer, and finish the local
+        copy with RequestMigrated so the router's bounded re-dispatch
+        attaches to the peer's adopted generation. Ordering is
+        fail-safe: the local copy keeps decoding until the peer ACKs
+        the import, so a severed transfer (the ``kv.transfer`` chaos
+        point) costs nothing — the donor serves (or drains) the
+        request exactly as if no migration was attempted, and the
+        router's seeded re-dispatch remains the recovery of last
+        resort. Returns {"moved", "failed", "pages"}."""
+        send = send if send is not None else self._peer_send
+        if send is None:
+            raise ValueError(
+                f"engine {self.name} has no KV transfer peer "
+                "configured")
+        wanted = set(rids) if rids is not None else None
+
+        def snap() -> List[Tuple[Request, bytes, int]]:
+            out = []
+            for slot, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                if wanted is not None and req.rid not in wanted:
+                    continue
+                out.append(self._export_slot(slot))
+            return out
+
+        moved = failed = pages = 0
+        for req, payload, npages in self._run_on_loop(snap):
+            t0 = time.monotonic()
+            try:
+                inj = chaos.draw("kv.transfer", target=self.name)
+                if inj is not None:
+                    if inj.delay > 0:
+                        time.sleep(inj.delay)
+                    if inj.mode != "delay":
+                        raise kvtransfer.TransferError(
+                            f"chaos[kv.transfer]: {self.name}")
+                peer = send(payload)
+            except Exception:
+                failed += 1  # local copy keeps running: zero lost
+                continue
+            self._observe_transfer(time.monotonic() - t0)
+            if self._run_on_loop(
+                    lambda r=req, p=peer: self._finish_migrated(
+                        r, p, reason)):
+                moved += 1
+                pages += npages
+                self._count_migration(reason, npages)
+            # else: it retired normally while the bytes traveled; the
+            # peer's adopted copy finishes unclaimed and idles out.
+        return {"moved": moved, "failed": failed, "pages": pages}
+
+    def _observe_transfer(self, seconds: float, n: int = 1) -> None:
+        self._reg().histogram(
+            "kfx_lm_kv_transfer_seconds",
+            "End-to-end KV transfer time (export snapshot to peer "
+            "acknowledgement).",
+            buckets=QUEUE_WAIT_BUCKETS).observe(
+                seconds, n=n, model=self.name)
+
+    def _count_migration(self, reason: str, npages: int) -> None:
+        reg = self._reg()
+        reg.counter("kfx_lm_kv_migrations_total",
+                    "In-flight requests migrated to a peer replica, "
+                    "by reason.").inc(1, model=self.name,
+                                      reason=reason)
+        reg.counter("kfx_lm_kv_pages_transferred_total",
+                    "KV pages shipped to or adopted from peer "
+                    "replicas.").inc(npages, model=self.name)
+
+    def _finish_migrated(self, req: Request, peer: str,
+                         reason: str) -> bool:
+        """Detach a migrated request from its slot (loop thread):
+        pages release, and the waiter gets RequestMigrated — the
+        retriable "gone to ``peer``" the server turns into 503 +
+        ``X-Kfx-Migrated``. Returns False when the request already
+        retired (a migration racing normal completion costs nothing;
+        the peer's adopted copy idles out unclaimed)."""
+        slot = next((s for s, r in enumerate(self._slots)
+                     if r is req), None)
+        if slot is None:
+            return False
+        self._prefilling.pop(slot, None)
+        self._slots[slot] = None
+        self._release_slot(slot)
+        if self.flight is not None:
+            self.flight.event(req, "migrated", peer=peer,
+                              reason=reason)
+        req._finish(RequestMigrated(
+            f"request migrated to {peer} ({reason})", peer=peer))
+        self._touch_gauges()
+        return True
+
+    def kv_import(self, raw: bytes,
+                  on_token: Optional[Callable[[Optional[int]], None]]
+                  = None) -> Request:
+        """Adopt a migrated request: verify the page stream (chain
+        digest per page — TransferCorrupt discards the partial import
+        WHOLE), check leaf-for-leaf geometry, then install it in a
+        free slot at the next iteration boundary: allocate pages,
+        scatter each frame, and restore exactly the slot state the
+        donor exported (mid-decode) or the prefill cursor
+        (mid-chunking). Returns the live Request — already decoding;
+        wait on ``.result()`` or stream via ``on_token``. Raises
+        TransferError/TransferCorrupt (nothing imported) or
+        EngineOverloaded (no slot / no pages — the donor keeps the
+        request)."""
+        inj = chaos.draw("kv.transfer", target=self.name)
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                raise kvtransfer.TransferCorrupt(
+                    f"chaos[kv.transfer]: {self.name}")
+        header, frames = kvtransfer.decode(raw)
+        if int(header.get("format", -1)) != 1:
+            raise kvtransfer.TransferError(
+                f"unknown transfer format {header.get('format')!r}")
+        if header.get("page_size") != self.page_size \
+                or header.get("max_seq_len") != int(
+                    self.cfg.max_seq_len) \
+                or header.get("vocab") != int(self.cfg.vocab_size) \
+                or header.get("leaves") != self._leaf_descriptors():
+            raise kvtransfer.TransferError(
+                "kv geometry mismatch: donor and receiver caches are "
+                "not leaf-for-leaf identical")
+        r = header["req"]
+        stop = int(r["stop"])
+        req = self._make_request(
+            r["prompt"], int(r["max_new"]), float(r["temperature"]),
+            int(r["top_k"]), int(r["seed"]),
+            None if stop < 0 else stop, r["adapter"] or None,
+            qos=r.get("qos"),
+            deadline_s=float(r.get("deadline_s") or 0) or None,
+            tenant=r.get("tenant") or None)
+        req.tokens = [int(t) for t in r["tokens"]]
+        # The donor billed (and possibly streamed) these tokens:
+        # bill only receiver-generated output, once fleet-wide — the
+        # same contract as the router's stream_skip re-dispatch.
+        req.meter_skip = len(req.tokens)
+        req.counted = True
+        req.t_admitted = time.monotonic()
+        req.on_token = on_token
+        if header.get("rng") is not None:
+            req.rng = np.asarray(header["rng"], np.uint32)
+        self._run_on_loop(
+            lambda: self._install_import(header, frames, req))
+        return req
+
+    def _install_import(self, header: Dict[str, Any],
+                        frames: List[bytes], req: Request) -> None:
+        """Install an adopted request (loop thread): the all-or-
+        nothing half of kv_import. Any failure past allocation
+        releases every page it took — a discarded partial import
+        leaks nothing."""
+        blocks = [int(b) for b in header["blocks"]]
+        phase = header.get("phase", "decode")
+        with obs_trace.span("engine.kv_import", trace_id=req.trace_id,
+                            parent_id=req.span_id, model=self.name,
+                            pages=str(len(blocks)), phase=phase):
+            if self._draining:
+                raise EngineDraining(
+                    f"engine {self.name} is draining; the donor "
+                    "keeps the request")
+            slot = next((s for s, rq in enumerate(self._slots)
+                         if rq is None), None)
+            if slot is None:
+                raise EngineOverloaded(
+                    f"engine {self.name} has no free slot for a KV "
+                    "import")
+            if any(b < 0 or b >= self.n_blocks for b in blocks):
+                raise kvtransfer.TransferError(
+                    "block index out of range")
+            if phase == "decode":
+                st = header["slot_state"]
+                if int(st["pending"]) >= 0 and not self.spec:
+                    raise kvtransfer.TransferError(
+                        "pending speculative token requires a "
+                        "speculative receiver")
+                if len(frames) != len(blocks) + 1:
+                    raise kvtransfer.TransferError(
+                        f"expected {len(blocks)} pages + 1 aux "
+                        f"frame, got {len(frames)}")
+            elif len(frames) != len(blocks):
+                raise kvtransfer.TransferError(
+                    f"expected {len(blocks)} pages, got "
+                    f"{len(frames)}")
+            # Parse every frame BEFORE touching the pool: a geometry
+            # lie discovered at frame k must not strand k pages.
+            rows = [self._unpack_page(frames[i])
+                    for i in range(len(blocks))]
+            aid = -1
+            if req.adapter:
+                aid = self._resolve_adapter(req)  # raises = refusal
+                if aid < 0:
+                    raise kvtransfer.TransferError(
+                        f"imported pages hold adapter KV but "
+                        f"{req.adapter!r} degraded to base here")
+            try:
+                pages = self._alloc_pages(len(blocks))
+            except PageAllocError:
+                if aid >= 0:
+                    self._apool.release(aid)
+                raise
+            try:
+                for row, pg in zip(rows, pages):
+                    self._cache = self._scatter_fn()(
+                        self._cache, row, np.int32(pg))
+            except Exception as e:
+                if self._donate:
+                    self._fail_inflight(e)
+                else:
+                    self._mgr.decref(pages)  # discard the partial
+                    if aid >= 0:             # import whole
+                        self._apool.release(aid)
+                raise
+            trow = np.full((self.n_blocks,), -1, np.int32)
+            for b, pg in zip(blocks, pages):
+                trow[b] = pg
+            self._tables[slot] = trow
+            self._slot_pages[slot] = list(pages)
+            self._aids[slot] = aid
+            full = req.prompt + req.tokens
+            n = len(full)
+            ps = self.page_size
+            # Register the imported PROMPT pages in the local prefix
+            # cache: a migration carries its share of the fleet cache
+            # with it, and the router's affinity re-learn (it follows
+            # the successful re-dispatch) points the prefix here next.
+            root = req.adapter.encode() if (req.adapter and aid >= 0) \
+                else b""
+            key = root
+            covered = len(req.prompt) // ps
+            if phase == "prefill":
+                covered = min(int(header["cursor"]["next"]),
+                              len(req.prompt)) // ps
+            reg_block = covered
+            if self._prefix is not None:
+                reg_block = 0
+                for b in range(covered):
+                    pg = int(trow[b])
+                    if pg < 0:
+                        break
+                    key = self._prefix.insert_full(
+                        key, full[b * ps:(b + 1) * ps], pg)
+                    reg_block = b + 1
+            if phase == "prefill":
+                cur = header["cursor"]
+                self._active[slot] = False
+                self._pending[slot] = -1
+                self._slots[slot] = req
+                self._prefilling[slot] = {
+                    "req": req, "full": full, "n": n,
+                    "next": int(cur["next"]), "key": key,
+                    "reg_block": reg_block,
+                    "bucket": int(cur["bucket"]),
+                    "remaining": int(cur["remaining"]),
+                    "fresh": bool(cur.get("fresh"))}
+            else:
+                import jax
+                import jax.numpy as jnp
+
+                st = header["slot_state"]
+                aux = header.get("aux") or {}
+                logrow = np.frombuffer(
+                    frames[len(blocks)],
+                    dtype=np.dtype(str(aux.get("dtype", "float32"))))
+                logrow = logrow.reshape(
+                    [int(d) for d in aux["shape"]])
+                self._logbuf = self._logbuf.at[slot].set(
+                    jnp.asarray(logrow, self._logbuf.dtype))
+                self._pos[slot] = int(st["pos"])
+                self._loc[slot] = int(st["loc"])
+                self._max_loc[slot] = int(st["max_loc"])
+                self._pending[slot] = int(st["pending"])
+                self._produced[slot] = len(req.tokens)
+                if req.rng is not None:
+                    self._rngs[slot] = req.rng
+                else:
+                    import jax
+
+                    self._rngs[slot] = np.asarray(
+                        jax.random.PRNGKey(req.seed), np.uint32)
+                self._temp[slot] = req.temperature
+                self._topk[slot] = req.top_k
+                self._stop[slot] = req.stop
+                self._max_new[slot] = req.max_new
+                if self.spec:
+                    # Adopted slots never speculate: the draft pool
+                    # holds none of their KV. The fused verify step
+                    # serves degraded slots exactly (1 token/iter).
+                    self._spec_ok[slot] = False
+                self._active[slot] = True
+                self._slots[slot] = req
+            if self.flight is not None:
+                self.flight.event(req, "kv_import",
+                                  pages=len(blocks), phase=phase)
+            self._reg().counter(
+                "kfx_lm_kv_pages_transferred_total",
+                "KV pages shipped to or adopted from peer replicas."
+                ).inc(len(blocks), model=self.name)
+            self._touch_gauges()
+
+    def _handoff_ready(self) -> None:
+        """Prefill-role handoff (loop thread): every active slot whose
+        prefill just completed (and was not handed off yet) exports
+        NOW — before this iteration's decode step — and ships to a
+        decode peer from a side thread, so the loop keeps chunking
+        other prompts while the bytes travel. Transfer failure
+        demotes the slot to local decode (mixed behavior):
+        disaggregation is an optimization, never a correctness
+        surface."""
+        for slot, req in enumerate(self._slots):
+            if req is None or not self._active[slot] \
+                    or slot in self._prefilling:
+                continue
+            if req.rid in self._handoff_skip:
+                continue
+            if len(self._handoff_skip) > 4096:
+                self._handoff_skip.clear()
+            self._handoff_skip.add(req.rid)
+            try:
+                _, payload, npages = self._export_slot(slot)
+            except Exception:
+                continue  # decode locally
+            threading.Thread(
+                target=self._handoff_send,
+                args=(req, payload, npages),
+                name=f"kfx-kv-handoff-{self.name}",
+                daemon=True).start()
+
+    def _handoff_send(self, req: Request, payload: bytes,
+                      npages: int) -> None:
+        t0 = time.monotonic()
+        try:
+            inj = chaos.draw("kv.transfer", target=self.name)
+            if inj is not None:
+                if inj.delay > 0:
+                    time.sleep(inj.delay)
+                if inj.mode != "delay":
+                    raise kvtransfer.TransferError(
+                        f"chaos[kv.transfer]: {self.name}")
+            peer = self._peer_send(payload)
+        except Exception:
+            return  # the slot decodes locally: zero lost
+        self._observe_transfer(time.monotonic() - t0)
+        try:
+            if self._run_on_loop(lambda: self._finish_migrated(
+                    req, peer, "disagg")):
+                self._count_migration("disagg", npages)
+        except (RuntimeError, TimeoutError):
+            pass  # engine closed mid-handoff; the peer copy idles out
+
+    # -- host-RAM offload tier ------------------------------------------------
+    def _offload_gauge(self) -> None:
+        if self._offload is None:
+            return
+        self._reg().gauge(
+            "kfx_lm_kv_offload_pages",
+            "Prefix-cache pages held per KV offload tier.").set(
+                len(self._offload), model=self.name, tier="host")
+
+    def _spill_page(self, e: "_PrefixEntry") -> None:
+        """Demote one evicted prefix page into the host offload tier
+        (refcount-aware by construction: evict_one only selects
+        childless entries at pool ref 1, so no live slot still reads
+        the page). Partial boundary pages are skipped — they are COW
+        sources keyed by token comparison, not chain hash. The
+        ``kv.offload`` chaos point (or any gather failure) drops the
+        demotion: the page's next miss recomputes, never crashes."""
+        if e.partial:
+            return
+        inj = chaos.draw("kv.offload", target=self.name)
+        if inj is not None:
+            if inj.delay > 0:
+                time.sleep(inj.delay)
+            if inj.mode != "delay":
+                return
+        try:
+            self._offload.put(e.key, self._page_payload(e.page))
+        except Exception:
+            return
+        self._offload_gauge()
+
+    def _promote_offloaded(self, full: List[int], max_reuse: int,
+                           shared: List[int], matched: int,
+                           key: bytes) -> Tuple[int, bytes]:
+        """Extend a prefix-cache match from the host offload tier:
+        while the next full page's chain hash is resident in host
+        RAM, allocate a device page, scatter the payload back (the
+        compiled promote — the same executable as the import path)
+        and register it as a live cache entry, so the admission skips
+        that much more prefill. ``shared`` grows in place. Pool
+        pressure, geometry drift or the ``kv.offload`` chaos point
+        stop the walk — the remaining tail re-prefills, exactly the
+        cost of never having offloaded."""
+        ps = self.page_size
+        base = list(shared)
+        for pg in base:
+            self._mgr.incref(pg)  # eviction guard: promote allocs may
+        ours: List[int] = []      # reclaim LRU cache pages
+        while matched + ps <= max_reuse:
+            nxt = _chain_hash(key, full[matched:matched + ps])
+            payload = self._offload.get(nxt)
+            if payload is None:
+                break
+            inj = chaos.draw("kv.offload", target=self.name)
+            if inj is not None:
+                if inj.delay > 0:
+                    time.sleep(inj.delay)
+                if inj.mode != "delay":
+                    break  # promote refused: the tail re-prefills
+            try:
+                row = self._unpack_page(payload)
+            except kvtransfer.TransferError:
+                self._offload.pop(nxt)  # stale geometry: unusable
+                break
+            try:
+                page = self._alloc_pages(1)[0]
+            except PageAllocError:
+                break
+            try:
+                self._cache = self._scatter_fn()(
+                    self._cache, row, np.int32(page))
+            except Exception as e:
+                if self._donate:
+                    self._fail_inflight(e)  # pool rebuilt: refs gone
+                    raise
+                self._mgr.decref(base + ours + [page])
+                raise
+            self._offload.pop(nxt)
+            self._prefix.insert_full(
+                key, full[matched:matched + ps], page)
+            ours.append(page)
+            shared.append(page)
+            key = nxt
+            matched += ps
+        # Promoted pages keep their cache ref (insert_full); ours and
+        # the guards drop here — the caller pins ``shared`` right
+        # after, same thread, nothing allocates in between.
+        self._mgr.decref(base + ours)
+        if ours:
+            self._offload_gauge()
+        return matched, key
+
     # -- the decode loop -----------------------------------------------------
     def _loop(self) -> None:
         while True:
             with self._cond:
                 while (not self._stopped and not self._queue
-                       and self._active_count() == 0):
+                       and self._active_count() == 0
+                       and not self._control):
                     self._cond.wait()
                 if self._stopped:
                     return
             try:
+                # KV-transfer control jobs first (export snapshots,
+                # import installs): they are slot-state surgery and
+                # must see a quiesced iteration boundary, exactly like
+                # admission.
+                self._service_control()
                 # Decode-stall accounting: prefill dispatch time (a
                 # monolithic admission's, or this iteration's one
                 # prompt chunk) is observed as stall only when active
@@ -2408,6 +3196,13 @@ class DecodeEngine:
                             for slot, r in enumerate(self._slots):
                                 if r is not None and self._active[slot]:
                                     r.stall_s += self._iter_stall
+                    if self.role == "prefill" \
+                            and self._peer_send is not None:
+                        # Disaggregation: ship every freshly-prefilled
+                        # slot's pages toward a decode peer BEFORE this
+                        # iteration's decode step — a successful
+                        # handoff never decodes a token here.
+                        self._handoff_ready()
                     if bool(self._active.any()):
                         self._decode_once()
                 if self.flight is not None:
@@ -2591,6 +3386,14 @@ class DecodeEngine:
         if self._prefix is not None:
             shared, cow, matched, key = self._prefix.match(
                 full, n - 1, root=root)
+            if self._offload is not None and cow is None \
+                    and len(self._offload):
+                # Page-aligned matches may extend from the host
+                # offload tier (compiled promote-on-hit); a COW match
+                # already consumed mid-page tokens, past which the
+                # chain cannot fold.
+                matched, key = self._promote_offloaded(
+                    full, n - 1, shared, matched, key)
         tail = full[matched:]
         if self.prefill_chunk_tokens and \
                 len(tail) > self.prefill_chunk_tokens:

@@ -40,13 +40,14 @@ import queue as _queue
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Iterator, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
 from ..obs.metrics import default_registry
+from . import kvtransfer
 from .server import Predictor
 
 CONFIG_FILE = "lm_config.json"
@@ -262,6 +263,37 @@ class LMPredictor(Predictor):
                 f"KFX_LM_RATE_LIMITS is not valid JSON: {e}") from e
         self.rate_burst_s = float(
             os.environ.get("KFX_LM_RATE_BURST_S", "2.0"))
+        # KV transfer plane (docs/serving.md "KV as a fleet
+        # resource"): ROLE is this replica's disaggregation tier —
+        # "prefill" ships every finished prompt's pages to a decode
+        # peer, "decode" receives them, "mixed" (default) does both
+        # phases locally. KV_PEERS is a JSON list of peer base URLs
+        # (the operator points prefill replicas at their decode
+        # tier); OFFLOAD_PAGES > 0 spills cold prefix-cache pages to
+        # a host-RAM tier of that many pages instead of dropping them.
+        self.role = os.environ.get("KFX_LM_ROLE", "mixed")
+        try:
+            self.kv_peers = json.loads(
+                os.environ.get("KFX_LM_KV_PEERS", "") or "[]")
+        except ValueError as e:
+            raise ValueError(
+                f"KFX_LM_KV_PEERS is not valid JSON: {e}") from e
+        if not isinstance(self.kv_peers, list) or any(
+                not isinstance(p, str) for p in self.kv_peers):
+            raise ValueError(
+                "KFX_LM_KV_PEERS must be a JSON list of URLs")
+        self.kv_offload_pages = int(
+            os.environ.get("KFX_LM_KV_OFFLOAD_PAGES", "0"))
+        # Peer round-robin cursor for _kv_send: the operator re-pushes
+        # the decode-tier URL set via :kvpeers every reconcile (ports
+        # change on respawn), so sends snapshot the CURRENT list.
+        self._kv_rr = 0
+        self._kv_rr_lock = threading.Lock()
+        # Adopted in-flight generations by resume key (kv_import):
+        # the router's re-dispatched :generate body claims its entry
+        # here and attaches instead of recomputing.
+        self._resume: Dict[str, Dict[str, Any]] = {}
+        self._resume_lock = threading.Lock()
         self.warm_buckets = list(warm_buckets) if warm_buckets else None
         # Replaced with the hosting ModelServer's registry at register()
         # time so decode throughput shows up on that server's /metrics.
@@ -324,7 +356,17 @@ class LMPredictor(Predictor):
                 qos_default=self.qos_default,
                 deadline_default_s=self.deadline_default_ms / 1000.0,
                 rate_limits=self.rate_limits or None,
-                rate_burst_s=self.rate_burst_s)
+                rate_burst_s=self.rate_burst_s,
+                role=self.role,
+                # A prefill-tier replica always gets a sender, even
+                # before the operator's first :kvpeers push: an empty
+                # list raises TransferError and the handoff degrades
+                # to decoding locally (zero lost), exactly the severed
+                # -transfer path.
+                kv_peer_send=(self._kv_send
+                              if (self.kv_peers or self.role == "prefill")
+                              else None),
+                kv_offload_pages=max(0, self.kv_offload_pages))
             self._attach_usage()
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
@@ -431,6 +473,106 @@ class LMPredictor(Predictor):
         if self._engine is None:
             return True
         return self._engine.drain(wait_s)
+
+    # -- KV transfer plane (docs/serving.md "KV as a fleet resource") -----
+    _RESUME_TTL_S = 120.0
+
+    def kv_import(self, raw: bytes) -> Dict[str, Any]:
+        """Adopt a migrated in-flight generation: hand the page
+        stream to the engine (verify, allocate, scatter, resume) and
+        index the live Request by its content-derived resume key, so
+        the router's re-dispatched ``:generate`` body — the seeded
+        recovery it would have sent anyway — claims the adopted
+        generation here instead of recomputing from the prompt."""
+        if self._engine is None:
+            raise kvtransfer.TransferError(
+                "KV import requires the engine path (KFX_LM_ENGINE=1)")
+        header = kvtransfer.peek(raw)
+        key = str(header.get("resume", ""))
+        q: "_queue.Queue[Optional[int]]" = _queue.Queue()
+        req = self._engine.kv_import(raw, on_token=q.put)
+        if key:
+            with self._resume_lock:
+                self._prune_resume_locked()
+                self._resume[key] = {"req": req, "q": q,
+                                     "imported": len(req.tokens),
+                                     "t": time.monotonic()}
+        self.metrics.counter(
+            "kfx_lm_kv_migrations_total",
+            "In-flight requests migrated to a peer replica, by "
+            "reason.").inc(1, model=self.name, reason="adopted")
+        return {"resume": key, "tokens": len(req.tokens),
+                "pages": len(header.get("blocks", []))}
+
+    def migrate_to(self, peer: str,
+                   reason: str = "manual") -> Dict[str, int]:
+        """Push every in-flight generation to ``peer`` (the operator's
+        migrate-before-kill hook; also the rebalancing verb). Failed
+        transfers keep running here — the stats say how many moved."""
+        if self._engine is None:
+            return {"moved": 0, "failed": 0, "pages": 0}
+        return self._engine.migrate_out(
+            reason=reason,
+            send=lambda payload: kvtransfer.post_pages(
+                peer, self.name, payload))
+
+    def _kv_send(self, payload: bytes) -> str:
+        """The engine's ``kv_peer_send``: round-robin over the LIVE
+        peer list (set_kv_peers replaces it between sends), falling
+        through the rest on refusal and raising the last TransferError
+        only when every peer refused — the donor then keeps the
+        request local."""
+        peers = [p for p in list(self.kv_peers) if p]
+        if not peers:
+            raise kvtransfer.TransferError(
+                "no decode peers configured (operator has not pushed "
+                ":kvpeers yet)")
+        with self._kv_rr_lock:
+            start = self._kv_rr
+            self._kv_rr += 1
+        last: Optional[kvtransfer.TransferError] = None
+        for off in range(len(peers)):
+            peer = peers[(start + off) % len(peers)]
+            try:
+                return kvtransfer.post_pages(peer, self.name, payload)
+            except kvtransfer.TransferError as e:
+                last = e
+        assert last is not None
+        raise last
+
+    def set_kv_peers(self, peers: List[str]) -> None:
+        """Replace the decode-peer URL set (the operator's per-
+        reconcile push: decode-tier ports change on respawn, so the
+        set is live state, not spawn-time env)."""
+        if not isinstance(peers, list) or any(
+                not isinstance(p, str) for p in peers):
+            raise ValueError("peers must be a JSON list of URLs")
+        self.kv_peers = [p for p in peers if p]
+
+    def _prune_resume_locked(self) -> None:
+        now = time.monotonic()
+        for key in [k for k, e in self._resume.items()
+                    if now - e["t"] > self._RESUME_TTL_S]:
+            del self._resume[key]  # unclaimed adoption idles out
+
+    def _claim_resume(self, key: str) -> Optional[Dict[str, Any]]:
+        with self._resume_lock:
+            self._prune_resume_locked()
+            return self._resume.pop(key, None)
+
+    def _resume_key_for(self, p: Dict[str, Any]) -> str:
+        """The resume key this parsed single-prompt body would carry —
+        derived with the same adapter-default resolution the engine
+        applies, so donor and receiver agree without a side channel."""
+        adapter = p["adapter"]
+        if adapter is None:
+            adapter = getattr(self._engine, "adapter_default", "")
+        kw = p["kw"]
+        return kvtransfer.resume_key(
+            p["prompts"][0], kw["max_new_tokens"], kw["temperature"],
+            kw["top_k"], kw["seed"],
+            -1 if p["stop"] is None else int(p["stop"]),
+            str(adapter or ""))
 
     def close(self) -> None:
         if self._engine is not None:
@@ -553,15 +695,24 @@ class LMPredictor(Predictor):
         t0 = time.perf_counter()
         reqs = None
         if self._engine is not None:
-            # submit_batch + result instead of generate(): identical
-            # semantics (same atomic enqueue, same batch deadline), but
-            # the Request handles survive for the per-request timing
-            # block the flight recorder computes.
-            reqs = self._engine.submit_batch(
-                p["prompts"], stop_token=p["stop"],
-                adapter=p["adapter"], qos=p["qos"],
-                deadline_s=p["deadline_s"], tenant=p["tenant"],
-                **p["kw"])
+            # A re-dispatched body whose generation migrated HERE
+            # attaches to the adopted in-flight request instead of
+            # recomputing (kv_import indexed it by resume key).
+            entry = (self._claim_resume(self._resume_key_for(p))
+                     if len(p["prompts"]) == 1 else None)
+            if entry is not None:
+                reqs = [entry["req"]]
+            else:
+                # submit_batch + result instead of generate():
+                # identical semantics (same atomic enqueue, same batch
+                # deadline), but the Request handles survive for the
+                # per-request timing block the flight recorder
+                # computes.
+                reqs = self._engine.submit_batch(
+                    p["prompts"], stop_token=p["stop"],
+                    adapter=p["adapter"], qos=p["qos"],
+                    deadline_s=p["deadline_s"], tenant=p["tenant"],
+                    **p["kw"])
             deadline = time.monotonic() \
                 + self._wait_budget_s(p["deadline_s"])
             out = [r.result(max(0.001, deadline - time.monotonic()))
@@ -616,6 +767,16 @@ class LMPredictor(Predictor):
             elapsed = time.perf_counter() - t0
             self._record_generate(len(out), elapsed)
             return iter(self._replay_events(out, skip, elapsed))
+        # A re-dispatched stream whose generation migrated HERE
+        # attaches to the adopted request: tokens that traveled with
+        # the pages replay first (their indices continue the donor's
+        # engine order, so stream_skip dedups exactly), then the
+        # adoption queue delivers receiver-generated tokens live.
+        entry = self._claim_resume(self._resume_key_for(p))
+        if entry is not None:
+            return self._stream_events(entry["req"], entry["q"], skip,
+                                       budget_s,
+                                       prefix=entry["imported"])
         q: "_queue.Queue[Optional[int]]" = _queue.Queue()
         req = self._engine.submit(
             p["prompts"][0], stop_token=p["stop"],
@@ -638,11 +799,19 @@ class LMPredictor(Predictor):
         yield self._sse({"done": True, "n_tokens": len(tokens),
                          "tokens_per_second": round(tps, 2)})
 
-    def _stream_events(self, req, q, skip: int,
-                       budget_s: float) -> Iterator[bytes]:
+    def _stream_events(self, req, q, skip: int, budget_s: float,
+                       prefix: int = 0) -> Iterator[bytes]:
         t0 = time.perf_counter()
         deadline = time.monotonic() + budget_s
         seen = 0
+        # Adopted generations (kv_import): req.tokens[:prefix] were
+        # produced before the queue attached — replay them by engine
+        # index, honoring the same skip window.
+        for i in range(prefix):
+            if i >= skip:
+                yield self._sse({"index": i,
+                                 "token": int(req.tokens[i])})
+            seen = i + 1
         while True:
             try:
                 tok = q.get(timeout=min(
@@ -661,7 +830,17 @@ class LMPredictor(Predictor):
                 yield self._sse({"index": seen, "token": tok})
             seen += 1
         if req.error is not None:
-            from .engine import EngineOverloaded
+            from .engine import EngineOverloaded, RequestMigrated
+            if isinstance(req.error, RequestMigrated):
+                # Mid-stream migration: sever instead of erroring.
+                # The server's SSE pump turns an iterator exception
+                # into a hard connection cut — exactly the truncated
+                # stream the router's mid-SSE recovery retries on;
+                # its re-dispatched body (stream_skip = tokens
+                # already relayed) then claims the adopted
+                # generation on the peer and the client's stream
+                # concatenates byte-identical.
+                raise ConnectionResetError(str(req.error))
             code = 503 if isinstance(req.error, EngineOverloaded) \
                 else 500
             yield self._sse({"error": str(req.error), "code": code},

@@ -53,6 +53,20 @@ def chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
+def payload_chain(parent: bytes, payload: bytes) -> bytes:
+    """One frame link of the TRANSFER chain (serving/kvtransfer.py):
+    SHA-256 over the parent digest + the frame's raw bytes — the same
+    fold discipline as ``chain_hash``, applied to wire frames instead
+    of token pages. A KV page stream severed or corrupted mid-transfer
+    breaks the chain at the first bad frame, so the receiver can
+    discard the partial import WHOLE instead of resuming from pages it
+    cannot trust (the donor then falls back to the router's seeded
+    re-dispatch recovery)."""
+    h = hashlib.sha256(parent)
+    h.update(payload)
+    return h.digest()
+
+
 def affinity_key(tokens: Sequence[int],
                  page_tokens: int = AFFINITY_PAGE_TOKENS,
                  max_pages: int = AFFINITY_MAX_PAGES,

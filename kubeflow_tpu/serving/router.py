@@ -192,6 +192,15 @@ class BackendSet:
             probe, _ = self._probe_or_healthy(())
             return probe
 
+    def has(self, endpoint: str) -> bool:
+        """Membership check for dispatch hints (X-Kfx-Migrated): True
+        when ``endpoint`` is in the set and not currently ejected —
+        a hint naming a sick or departed replica must not override
+        passive health."""
+        with self._lock:
+            return endpoint in self._endpoints \
+                and endpoint not in self._ejected
+
     def pick(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
         """Next endpoint, skipping ``exclude`` (the retry path's
         already-failed backend) and ejected endpoints — except a due
@@ -734,7 +743,12 @@ class Router:
                     break
                 chosen.report_failure(attempt_backend)
                 if attempt == 0:
-                    alt = chosen.pick(exclude=(attempt_backend,))
+                    # A migrated request's 503 names its adopting
+                    # peer: retry THERE — the peer's resume table
+                    # holds the in-flight generation, any other pick
+                    # would recompute from the prompt.
+                    alt = self._migrated_hint(last, chosen) \
+                        or chosen.pick(exclude=(attempt_backend,))
                     if alt is not None and alt != attempt_backend:
                         recovering = last_err is not None and \
                             h.path.partition("?")[0].endswith(":generate")
@@ -769,6 +783,23 @@ class Router:
         h.send_header("Content-Length", str(len(body)))
         h.end_headers()
         h.wfile.write(body)
+
+    @staticmethod
+    def _migrated_hint(last: Optional[Tuple[int, List[Tuple[str, str]],
+                                            bytes]],
+                       chosen: BackendSet) -> Optional[str]:
+        """The adopting peer named by a 503's ``X-Kfx-Migrated``
+        header, when it is a live (non-ejected) member of this backend
+        set — else None and the normal healthy pick applies."""
+        if last is None or last[0] != 503:
+            return None
+        peer = ""
+        for k, v in last[1]:
+            if k.lower() == "x-kfx-migrated":
+                peer = v.strip()
+        if peer and chosen.has(peer):
+            return peer
+        return None
 
     def _attempt(self, h, backend: str, data: bytes, span_id: str = ""
                  ) -> Tuple[int, List[Tuple[str, str]], bytes]:

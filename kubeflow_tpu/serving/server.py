@@ -762,6 +762,26 @@ class ModelServer:
             finally:
                 self._finish_request(h, name, "generate", t0)
                 self._finish_span(h, sp)
+        route = path.split("?", 1)[0]
+        if route.startswith("/v1/models/") and route.endswith(":kvimport"):
+            name = route[len("/v1/models/"):-len(":kvimport")]
+            sp = self._request_span(h, "serving.kvimport", name)
+            try:
+                return self._handle_kvimport(h, name)
+            finally:
+                self._finish_request(h, name, "kvimport", t0)
+                self._finish_span(h, sp)
+        if route.startswith("/v1/models/") and route.endswith(":migrate"):
+            name = route[len("/v1/models/"):-len(":migrate")]
+            sp = self._request_span(h, "serving.migrate", name)
+            try:
+                return self._handle_migrate(h, name)
+            finally:
+                self._finish_request(h, name, "migrate", t0)
+                self._finish_span(h, sp)
+        if route.startswith("/v1/models/") and route.endswith(":kvpeers"):
+            name = route[len("/v1/models/"):-len(":kvpeers")]
+            return self._handle_kvpeers(h, name)
         if not (path.startswith("/v1/models/") and path.endswith(":predict")):
             h._send(404, {"error": f"no route {path}"})
             return
@@ -901,6 +921,53 @@ class ModelServer:
             # Retry-After so the router's jittered retry can wait out
             # the actual deficit instead of hammering the same wall.
             retry = getattr(e, "retry_after_s", None)
+            extra = {"Retry-After": f"{retry:.1f}" if retry else "1"}
+            # A migrated request's 503 carries the adopting peer so
+            # the router's re-dispatch can go straight there (the
+            # peer's resume table holds the in-flight generation).
+            peer = getattr(e, "peer", "")
+            if peer:
+                extra["X-Kfx-Migrated"] = str(peer)
+            h._send(503, {"error": str(e)}, extra_headers=extra)
+            return
+        except Exception as e:
+            h._send(500, {"error": str(e)})
+            return
+        h._send(200, result, extra_headers=_timing_header(result))
+
+    def _handle_kvimport(self, h, name: str) -> None:
+        """Adopt a migrated request's KV pages (serving/kvtransfer.py
+        wire format, raw in the body). Refusals are honest: a corrupt
+        or geometry-mismatched stream is a 400 (the donor must not
+        retry the same bytes here), a capacity refusal is a 503
+        (retriable at another peer); either way the donor's copy
+        stays authoritative."""
+        from . import kvtransfer
+
+        p = self.predictors.get(name)
+        if p is None:
+            h._send(404, {"error": f"model {name!r} not found"})
+            return
+        if not getattr(p, "kv_import", None):
+            h._send(400, {"error": f"model {name!r} does not accept "
+                                   "KV imports"})
+            return
+        if not p.ready or self.draining:
+            h._send(503, {"error": f"model {name!r} not ready"
+                          if not p.ready else "server draining"},
+                    extra_headers={"Retry-After": "1"})
+            return
+        raw = h.rfile.read(int(h.headers.get("Content-Length", 0)))
+        try:
+            result = p.kv_import(raw)
+        except kvtransfer.TransferCorrupt as e:
+            h._send(400, {"error": str(e), "corrupt": True})
+            return
+        except (kvtransfer.TransferError, ValueError) as e:
+            h._send(400, {"error": str(e)})
+            return
+        except EngineOverloaded as e:
+            retry = getattr(e, "retry_after_s", None)
             h._send(503, {"error": str(e)},
                     extra_headers={"Retry-After":
                                    f"{retry:.1f}" if retry else "1"})
@@ -908,7 +975,60 @@ class ModelServer:
         except Exception as e:
             h._send(500, {"error": str(e)})
             return
-        h._send(200, result, extra_headers=_timing_header(result))
+        h._send(200, result)
+
+    def _handle_migrate(self, h, name: str) -> None:
+        """Operator hook: push this model's in-flight requests to a
+        peer (``?peer=URL&reason=drain``) before a kill. Answers 200
+        with the {moved, failed, pages} stats — a failed transfer is
+        a degrade (the seeded re-dispatch recovery still covers those
+        requests), never an HTTP error."""
+        from urllib.parse import parse_qs, urlsplit
+
+        p = self.predictors.get(name)
+        if p is None:
+            h._send(404, {"error": f"model {name!r} not found"})
+            return
+        if not getattr(p, "migrate_to", None):
+            h._send(400, {"error": f"model {name!r} does not support "
+                                   "migration"})
+            return
+        q = parse_qs(urlsplit(h.path).query)
+        peer = (q.get("peer") or [""])[0]
+        reason = (q.get("reason") or ["manual"])[0]
+        if not peer:
+            h._send(400, {"error": "peer=URL is required"})
+            return
+        try:
+            stats = p.migrate_to(peer, reason=reason)
+        except ValueError as e:
+            h._send(400, {"error": str(e)})
+            return
+        except Exception as e:
+            h._send(500, {"error": str(e)})
+            return
+        h._send(200, stats)
+
+    def _handle_kvpeers(self, h, name: str) -> None:
+        """Operator hook: replace this replica's decode-peer URL set
+        (body: JSON list). Pushed every reconcile — peer ports change
+        on respawn, so the set is live state, not spawn-time env."""
+        p = self.predictors.get(name)
+        if p is None:
+            h._send(404, {"error": f"model {name!r} not found"})
+            return
+        if not getattr(p, "set_kv_peers", None):
+            h._send(400, {"error": f"model {name!r} does not support "
+                                   "KV peers"})
+            return
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            peers = json.loads(h.rfile.read(n).decode() or "[]")
+            p.set_kv_peers(peers)
+        except (ValueError, UnicodeDecodeError) as e:
+            h._send(400, {"error": str(e)})
+            return
+        h._send(200, {"peers": len(p.kv_peers)})
 
     def _send_sse(self, h, events) -> None:
         """Stream SSE events over a chunked HTTP/1.1 response. The

@@ -193,6 +193,24 @@ class TestBoxGuard:
         for key in ("obs_slo_eval_ms", "obs_slo_tokens_delta_frac"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_disagg_keys_in_contract(self):
+        """The KV-transfer-plane numbers (ISSUE 19: asymmetric
+        prefill/decode tokens/s + p99 vs interleaved, and migration-
+        vs-recompute cost at three context lengths) ride the compact
+        BENCH_CONTRACT line."""
+        for key in ("lm_disagg_handoffs", "lm_disagg_tokens_per_s",
+                    "lm_disagg_interleaved_tokens_per_s",
+                    "lm_disagg_itl_p99_ms",
+                    "lm_disagg_interleaved_itl_p99_ms",
+                    "lm_disagg_migrate_ms_c64",
+                    "lm_disagg_recompute_ms_c64",
+                    "lm_disagg_migrate_ms_c128",
+                    "lm_disagg_recompute_ms_c128",
+                    "lm_disagg_migrate_ms_c224",
+                    "lm_disagg_recompute_ms_c224",
+                    "lm_disagg_migrate_speedup"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_own_descendants_are_not_strays(self):
         # A gang worker tree spawned by THIS process is measurement, not
         # contamination — at any depth (mpi ranks are grandchildren).

@@ -151,7 +151,8 @@ class TestKfxVerbs:
                 "kvUtil": 0.42, "prefillSkip": 0.63,
                 "specAcceptRate": 0.87,
                 "quant": "w8+kv8", "adapters": "3/8",
-                "classes": "2/1", "restarts": 3}},
+                "classes": "2/1", "restarts": 3,
+                "role": "prefill", "migrations": 17}},
         }
         clf = InferenceService.from_dict({
             "metadata": {"name": "clf", "namespace": "default"},
@@ -161,29 +162,37 @@ class TestKfxVerbs:
                       "autoscaling": {"default": {"desired": 1,
                                                   "target": 8}}}
         rows = _serving_top_rows([lm, clf])
-        assert rows[0][6] == "42%"
+        # ROLE column: the disaggregation tier (P/D/M), "-" when the
+        # status snapshot predates the KV transfer plane.
+        assert rows[0][3] == "P"
+        assert rows[0][7] == "42%"
         # SKIP% column: prompt tokens served from cached prefix pages
         # (the fleet prefill-skip signal prefix-affinity routing moves).
-        assert rows[0][7] == "63%"
-        assert rows[0][8] == "87%"
+        assert rows[0][8] == "63%"
+        assert rows[0][9] == "87%"
         # Q column: the engine's quantization mode; "-" when the
         # operator never sampled one (classifier revisions).
-        assert rows[0][9] == "w8+kv8"
+        assert rows[0][10] == "w8+kv8"
         # ADPT column: the adapter-slot pool as pinned/total
         # (multi-tenant LoRA revisions; "-" when the engine has no
         # adapter pool).
-        assert rows[0][10] == "3/8"
+        assert rows[0][11] == "3/8"
         # I/B column: the in-flight QoS-class split (request plane) as
         # interactive/batch; "-" on classifier revisions.
-        assert rows[0][11] == "2/1"
+        assert rows[0][12] == "2/1"
+        # MIG column: cumulative KV migrations out of the revision's
+        # replicas (disagg handoffs + drain/scale-in moves).
+        assert rows[0][13] == "17"
         # RESTARTS column, fed from the operator's restart accounting
         # (same number kfx_replica_restarts_total counts).
-        assert rows[0][12] == "3"
-        assert rows[1][6] == "-" and rows[1][7] == "-"
-        assert rows[1][8] == "-" and rows[1][9] == "-"
-        assert rows[1][10] == "-"  # no adapter pool sampled
-        assert rows[1][11] == "-"  # no request-plane classes sampled
-        assert rows[1][12] == "-"  # operator never reported restarts
+        assert rows[0][14] == "3"
+        assert rows[1][3] == "-"  # no role sampled
+        assert rows[1][7] == "-" and rows[1][8] == "-"
+        assert rows[1][9] == "-" and rows[1][10] == "-"
+        assert rows[1][11] == "-"  # no adapter pool sampled
+        assert rows[1][12] == "-"  # no request-plane classes sampled
+        assert rows[1][13] == "-"  # no KV migrations sampled
+        assert rows[1][14] == "-"  # operator never reported restarts
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main

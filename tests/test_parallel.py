@@ -123,8 +123,11 @@ class TestShardedTraining:
     @pytest.mark.parametrize("variant", [
         # Stage-only mesh: the pipeline goes fully manual over the mesh
         # (pipeline.py), which every jax lowers — the GPipe schedule's
-        # numeric coverage no longer skips on the compat shims.
-        "stage_only",
+        # numeric coverage no longer skips on the compat shims. ~18s
+        # of tier-1 wall, so the soak rides tier-2;
+        # test_pipeline_rejects_bad_shapes and the runner pipeline
+        # e2e keep the plumbing in tier-1.
+        pytest.param("stage_only", marks=pytest.mark.slow),
         # dp/tp inside a stage ride GSPMD under a hybrid manual/auto
         # shard_map — native mesh API only.
         pytest.param("hybrid_tp", marks=drift_skip),
@@ -210,6 +213,10 @@ class TestShardedTraining:
                 jax.random.PRNGKey(0),
                 np.zeros((1, 8), np.int32))
 
+    # ~11s of tier-1 wall: the flash+remat numeric core
+    # (test_save_flash_remat_grads_match, test_ops.py) stays tier-1;
+    # this composition smoke rides tier-2.
+    @pytest.mark.slow
     def test_flash_remat_trains_on_sharded_mesh(self):
         """The pallas flash kernel (interpret mode off-TPU) composed
         with tp+fsdp shardings AND a save_flash remat policy — the
@@ -240,6 +247,10 @@ class TestShardedTraining:
         assert all(np.isfinite(l) for l in losses), losses
         assert losses[-1] < losses[0] + 0.5  # training, not diverging
 
+    # ~17s of tier-1 wall (two sharded train loops compile): the
+    # loss_chunk validation check below stays tier-1; the numeric
+    # parity soak rides tier-2.
+    @pytest.mark.slow
     def test_chunked_ce_matches_whole_logits(self, tiny_cfg):
         """loss_chunk (lm_head + CE per sequence chunk, the HBM lever
         for big-vocab long-context configs) is a scheduling choice:
@@ -289,6 +300,11 @@ class TestShardedTraining:
         with pytest.raises(ValueError, match="loss_chunk"):
             loop.train_step(state, next(ds.batches(16)))
 
+    # ~18s of tier-1 wall for a second ring-attention parity angle:
+    # TestRingAttention::test_gradients_match keeps the kernel's
+    # numeric coverage in tier-1; the end-to-end cp=2 training track
+    # rides tier-2.
+    @pytest.mark.slow
     def test_cp_matches_no_cp(self, tiny_cfg):
         """Context parallelism (ring attention over "ctx") is numerically
         a layout choice: training with cp=2 must track the cp=1 loop.
@@ -386,6 +402,11 @@ class TestMoE:
         row_norms = np.asarray(jnp.sum(jnp.abs(y), axis=-1))[0]
         assert (row_norms == 0).sum() >= 16 - 8
 
+    # ~11s of tier-1 wall: EP training is exercised every tier-1 run
+    # by test_fsdp_tp_sp_ep_loss_decreases (n_experts=4) and the
+    # capacity-dispatch numerics by the cheap MoE oracles above; the
+    # wider E=8 variant rides tier-2.
+    @pytest.mark.slow
     def test_ep_e8_trains(self, tiny_cfg):
         """E=8 experts (one per device over "data"): capacity dispatch keeps
         expert FLOPs O(E·C), where the dense oracle would do E× the token

@@ -462,14 +462,14 @@ class TestTopWatchRates:
             [isvc], rates_fn=lambda ns, name, rev: (12.3, 4.5, 0.25))
         # Window rates fill TOK/S + RPS, and the WINDOW skip replaces
         # the cumulative status snapshot.
-        assert rows[0][7] == "25%"
-        # TOK/S + RPS sit after the ADPT and I/B columns (10, 11).
-        assert rows[0][13] == "12.3" and rows[0][14] == "4.5"
+        assert rows[0][8] == "25%"
+        # TOK/S + RPS sit after the MIG and RESTARTS columns.
+        assert rows[0][15] == "12.3" and rows[0][16] == "4.5"
         # Without history the snapshot and "-" cells remain.
         rows = _serving_top_rows(
             [isvc], rates_fn=lambda ns, name, rev: (None, None, None))
-        assert rows[0][7] == "90%"
-        assert rows[0][13] == "-" and rows[0][14] == "-"
+        assert rows[0][8] == "90%"
+        assert rows[0][15] == "-" and rows[0][16] == "-"
 
     def test_top_watch_single_shot(self, tmp_path, capsys):
         from kubeflow_tpu.cli import KfxCLI

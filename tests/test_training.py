@@ -65,6 +65,10 @@ class TestModels:
         assert out.shape == (2, 10)
         assert out.dtype == np.float32  # logits upcast for stable CE
 
+    # ~14s of tier-1 wall, nearly all resnet compile, for a forward
+    # shape check; the get_model forward contract stays covered by
+    # the mlp/cnn/vit forwards, so this rides tier-2.
+    @pytest.mark.slow
     def test_resnet18_forward_cifar_stem(self):
         import jax
         from kubeflow_tpu.models import get_model
