@@ -329,6 +329,71 @@ class InferenceService(Resource):
                             f"spec.{rev}.adapters.fallback",
                             "'base' (degrade to base-only) or "
                             "'error' (503 + Retry-After)")
+                md = spec.get("models")
+                if md is not None:
+                    if not isinstance(md, dict):
+                        raise ValidationError(
+                            f"spec.{rev}.models",
+                            "must be an object {artifacts, default, "
+                            "slots, idleSeconds}")
+                    arts = md.get("artifacts")
+                    if not isinstance(arts, dict) or not arts:
+                        raise ValidationError(
+                            f"spec.{rev}.models.artifacts",
+                            "must be a non-empty object "
+                            "{name: LM export URI}")
+                    for mname, uri in arts.items():
+                        if not str(mname) or not isinstance(uri, str) \
+                                or not uri:
+                            raise ValidationError(
+                                f"spec.{rev}.models."
+                                f"artifacts[{mname!r}]",
+                                "export URI must be a non-empty "
+                                "string")
+                    dflt = md.get("default")
+                    if not isinstance(dflt, str) or dflt not in arts:
+                        raise ValidationError(
+                            f"spec.{rev}.models.default",
+                            "must name one of models.artifacts (the "
+                            "resident model the revision's storageUri "
+                            "loads)")
+                    # bool subclasses int: `slots: true` must be a 400
+                    # at apply, not slot count 1 at revision startup.
+                    sl = md.get("slots")
+                    if sl is not None and (isinstance(sl, bool)
+                                           or not isinstance(sl, int)
+                                           or sl < 1):
+                        raise ValidationError(
+                            f"spec.{rev}.models.slots",
+                            "must be an integer >= 1")
+                    idle = md.get("idleSeconds")
+                    if idle is not None:
+                        try:
+                            ok = (not isinstance(idle, bool)
+                                  and float(idle) >= 0)
+                        except (TypeError, ValueError):
+                            ok = False
+                        if not ok:
+                            raise ValidationError(
+                                f"spec.{rev}.models.idleSeconds",
+                                "must be a number >= 0 (0 = never "
+                                "evict on idle)")
+                    # A weight pool excludes the per-request planes
+                    # that assume ONE set of weights per replica:
+                    # adapter factors pair with specific base weights,
+                    # and KV pages moved between tiers would decode
+                    # under a different model.
+                    if ad is not None:
+                        raise ValidationError(
+                            f"spec.{rev}.models",
+                            "incompatible with spec.adapters (LoRA "
+                            "factors pair with one base model)")
+                    if str(spec.get("role", "mixed")) != "mixed":
+                        raise ValidationError(
+                            f"spec.{rev}.models",
+                            "requires role 'mixed' (KV pages moved "
+                            "between tiers would decode under a "
+                            "different model's weights)")
                 q = spec.get("quantization")
                 if q is not None:
                     if not isinstance(q, dict):

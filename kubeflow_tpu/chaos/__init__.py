@@ -35,6 +35,8 @@ tested property: sites across the stack declare *fault points* —
     router.stream_cut   sever an in-flight SSE      (serving/router.py)
                         token stream after >=1
                         relayed token
+    weights.load        artifact load fails/stalls  (serving/weights.py)
+                        during a weight-pool swap
 
 — and a *plan* decides, deterministically, which evaluations inject.
 
@@ -103,7 +105,7 @@ KNOWN_POINTS = frozenset({
     "router.affinity", "router.stream_cut",
     "runner.crash", "sched.preempt",
     "autoscale.decide", "serving.cold_start",
-    "kv.transfer", "kv.offload",
+    "kv.transfer", "kv.offload", "weights.load",
 })
 
 

@@ -51,6 +51,20 @@ def _job_state(obj: Resource) -> str:
     return display_state(obj.conditions)
 
 
+def _fmt_pooled(pooled: dict) -> str:
+    """Render status.pooledModels ({revision: {model: loaded?}}):
+    resident models by name, unloaded ones parenthesized — "(m)" is
+    pooled but unloaded, one weight swap from serving."""
+    names: dict = {}
+    for rev_map in pooled.values():
+        for m, loaded in rev_map.items():
+            names[m] = bool(loaded) or names.get(m, False)
+    if not names:
+        return "-"
+    return ",".join(m if loaded else f"({m})"
+                    for m, loaded in sorted(names.items()))
+
+
 def _print_table(rows: List[List[str]], headers: List[str]) -> None:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
@@ -172,7 +186,16 @@ class KfxCLI:
             rows = [[o.name, _job_state(o),
                      str(o.status.get("restartCount", 0)),
                      _fmt_age(o.metadata.creation_timestamp)] for o in objs]
-            _print_table(rows, ["NAME", "STATE", "RESTARTS", "AGE"])
+            headers = ["NAME", "STATE", "RESTARTS", "AGE"]
+            if any(o.status.get("pooledModels") for o in objs):
+                # Multi-model weight pools (status.pooledModels):
+                # "loaded" names are HBM-resident, "(name)" is pooled
+                # but unloaded — servable after one weight swap.
+                headers.append("POOLED")
+                for row, o in zip(rows, objs):
+                    row.append(_fmt_pooled(
+                        o.status.get("pooledModels") or {}))
+            _print_table(rows, headers)
         return 0
 
     def describe(self, kind: str, name: str, namespace: str) -> int:
@@ -647,7 +670,9 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
     "w8"/"kv8"/"w8+kv8"/"d8"/"f32"; paged LM revisions — "-" for
     classifiers and engines with the signal absent), the adapter-slot
     pool as "pinned/total" (ADPT column — multi-tenant LoRA revisions
-    only), the in-flight QoS-class split as "interactive/batch" (I/B
+    only), the weight-slot pool as "loaded/total" (MODELS column —
+    multi-model revisions only), the in-flight QoS-class split as
+    "interactive/batch" (I/B
     column — request plane, LM revisions only), the disaggregation
     tier as P/D/M (ROLE column — KV transfer plane) with cumulative
     KV migrations out of the revision (MIG column), cumulative
@@ -680,6 +705,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
             acc = a.get("specAcceptRate")
             skip = a.get("prefillSkip")
             adpt = a.get("adapters")  # "pinned/total" or absent
+            mdl = a.get("models")  # weight pool "loaded/total" or absent
             classes = a.get("classes")  # "interactive/batch" or absent
             tok_s = rps = None
             if rates_fn is not None:
@@ -697,6 +723,7 @@ def _serving_top_rows(isvcs, rates_fn=None) -> List[List[str]]:
                 f"{acc * 100:.0f}%" if acc is not None else "-",
                 str(a.get("quant") or "-"),
                 str(adpt) if adpt else "-",
+                str(mdl) if mdl else "-",
                 str(classes) if classes else "-",
                 str(int(mig)) if mig else "-",
                 str(a["restarts"]) if a.get("restarts") is not None
@@ -713,8 +740,8 @@ def _print_serving_top(rows: List[List[str]]) -> None:
     print()
     _print_table(rows, ["ISVC", "NAMESPACE", "REV", "ROLE",
                         "READY/REPL", "DESIRED", "TARGET", "KV%",
-                        "SKIP%", "ACC%", "Q", "ADPT", "I/B", "MIG",
-                        "RESTARTS", "TOK/S", "RPS", "CANARY%"])
+                        "SKIP%", "ACC%", "Q", "ADPT", "MODELS", "I/B",
+                        "MIG", "RESTARTS", "TOK/S", "RPS", "CANARY%"])
 
 
 def _revision_window_rates(query, namespace: str, isvc: str,
@@ -1572,7 +1599,15 @@ def _remote_dispatch(client, args) -> int:
                      str(o.get("status", {}).get("restartCount", 0)),
                      _fmt_age(o["metadata"].get("creationTimestamp", ""))]
                     for o in objs]
-            _print_table(rows, ["NAME", "STATE", "RESTARTS", "AGE"])
+            headers = ["NAME", "STATE", "RESTARTS", "AGE"]
+            if any(o.get("status", {}).get("pooledModels") for o in objs):
+                # Same POOLED column the embedded path renders —
+                # thin-client mode is how a live plane is queried.
+                headers.append("POOLED")
+                for row, o in zip(rows, objs):
+                    row.append(_fmt_pooled(
+                        o.get("status", {}).get("pooledModels") or {}))
+            _print_table(rows, headers)
         return 0
     if args.cmd == "describe":
         import yaml

@@ -94,6 +94,7 @@ class _Revision:
                  quantization: Optional[dict] = None,
                  prefill_chunk: Optional[int] = None,
                  adapters: Optional[dict] = None,
+                 models: Optional[dict] = None,
                  qos_default: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
                  rate_limits: Optional[dict] = None,
@@ -124,6 +125,15 @@ class _Revision:
         # knobs the LMPredictor reads at load; classifier frameworks
         # ignore them.
         self.adapters = adapters
+        # Multi-model weight pool ({artifacts, default, slots,
+        # idleSeconds}, api/serving.py) — exported as the
+        # KFX_LM_MODELS / KFX_LM_MODEL_DEFAULT / KFX_LM_WEIGHT_SLOTS /
+        # KFX_LM_WEIGHT_IDLE_S knobs the LMPredictor reads at load.
+        # Scale-from-zero for a pooled model is a weight SWAP on a
+        # warm replica, not a process spawn — the replica handles it
+        # on admission and records it on the same cold-start
+        # histogram (mode="swap" vs this controller's mode="spawn").
+        self.models = models
         # Request plane (spec.<rev>.qosDefault / deadlineMs /
         # rateLimits, api/serving.py) — exported as KFX_LM_QOS_DEFAULT
         # / KFX_LM_DEADLINE_MS / KFX_LM_RATE_LIMITS; None leaves the
@@ -174,6 +184,13 @@ class _Revision:
         # classifier or base-only LM revisions.
         self.engine_adapter_slots = 0.0
         self.engine_adapter_free = 0.0
+        # Weight-slot pool (multi-model): total/free HBM checkpoint
+        # slots summed across replicas and the per-model residency map
+        # — `kfx top`'s MODELS column and status.pooledModels; empty
+        # on classifier or single-model revisions.
+        self.engine_weight_slots = 0.0
+        self.engine_weight_free = 0.0
+        self.engine_pooled: Dict[str, bool] = {}
         # Prefix-reuse token totals summed across replicas — the
         # revision-level prefill-skipped fraction for `kfx top`'s
         # SKIP% column (the per-replica caches compose into a fleet
@@ -272,6 +289,7 @@ class _Revision:
         self._quant_env(env)
         self._prefill_env(env)
         self._adapter_env(env)
+        self._models_env(env)
         self._request_plane_env(env)
         self._kv_env(env)
         logf = open(os.path.join(
@@ -322,6 +340,21 @@ class _Revision:
             env["KFX_LM_ADAPTER_RANK"] = str(int(ad["rank"]))
         if ad.get("fallback") is not None:
             env["KFX_LM_ADAPTER_FALLBACK"] = str(ad["fallback"])
+
+    def _models_env(self, env: dict) -> None:
+        """spec.<rev>.models -> the LMPredictor's multi-model weight
+        pool knobs: the artifacts map rides as JSON (KFX_LM_MODELS)
+        with the default model's name; slots/idleSeconds export only
+        when explicit (the predictor owns the defaults)."""
+        md = self.models
+        if md is None or self.role != "predictor":
+            return
+        env["KFX_LM_MODELS"] = json.dumps(md.get("artifacts") or {})
+        env["KFX_LM_MODEL_DEFAULT"] = str(md.get("default") or "")
+        if md.get("slots") is not None:
+            env["KFX_LM_WEIGHT_SLOTS"] = str(int(md["slots"]))
+        if md.get("idleSeconds") is not None:
+            env["KFX_LM_WEIGHT_IDLE_S"] = str(float(md["idleSeconds"]))
 
     def _request_plane_env(self, env: dict) -> None:
         """spec.<rev>.qosDefault / deadlineMs / rateLimits -> the
@@ -593,7 +626,13 @@ class InferenceServiceController(Controller):
                     # spawned replica first probes ready. A request that
                     # 503'd just before the replica turned ready is not
                     # a cold start — re-arming here would emit a bogus
-                    # 0s span on the very next probe.
+                    # 0s span on the very next probe. A pooled revision
+                    # with a warm replica never arms this clock at all:
+                    # its cold path is a weight SWAP the replica itself
+                    # closes into the same span/histogram (mode="swap",
+                    # serving/weights.py) — process spawn, measured
+                    # here as mode="spawn", is the fallback when no
+                    # replica is alive to swap into.
                     rev = rt.revisions.get(rev_name)
                     if rev is None or not any(r.ready for r in rev.replicas):
                         rt.cold_started.setdefault(rev_name, time.time())
@@ -638,6 +677,7 @@ class InferenceServiceController(Controller):
             quantization = spec.get("quantization")
             prefill_chunk = spec.get("prefillChunkTokens")
             adapters = spec.get("adapters")
+            models = spec.get("models")
             qos_default = spec.get("qosDefault")
             deadline_ms = spec.get("deadlineMs")
             rate_limits = spec.get("rateLimits")
@@ -650,6 +690,7 @@ class InferenceServiceController(Controller):
                     or rev.quantization != quantization \
                     or rev.prefill_chunk != prefill_chunk \
                     or rev.adapters != adapters \
+                    or rev.models != models \
                     or rev.qos_default != qos_default \
                     or rev.deadline_ms != deadline_ms \
                     or rev.rate_limits != rate_limits \
@@ -678,6 +719,7 @@ class InferenceServiceController(Controller):
                     quantization=quantization,
                     prefill_chunk=prefill_chunk,
                     adapters=adapters,
+                    models=models,
                     qos_default=qos_default,
                     deadline_ms=deadline_ms,
                     rate_limits=rate_limits,
@@ -1048,6 +1090,12 @@ class InferenceServiceController(Controller):
                               - rev.engine_adapter_free))
             status["adapters"] = \
                 f"{used}/{int(rev.engine_adapter_slots)}"
+        if rev.engine_weight_slots > 0:
+            # Weight-slot pool "loaded/total" (multi-model) — `kfx
+            # top`'s MODELS column; absent on single-model revisions.
+            loaded = sum(1 for v in rev.engine_pooled.values() if v)
+            status["models"] = \
+                f"{loaded}/{int(rev.engine_weight_slots)}"
         if rev.engine_active_interactive is not None:
             # In-flight slot split "interactive/batch" (request-plane
             # QoS classes) — `kfx top`'s I/B column; absent on
@@ -1467,6 +1515,19 @@ class InferenceServiceController(Controller):
         rev.engine_prompt_tokens = total("kfx_lm_prompt_tokens_admitted")
         rev.engine_adapter_slots = total("kfx_lm_adapter_slots")
         rev.engine_adapter_free = total("kfx_lm_adapter_slots_free")
+        # Weight-slot pool (multi-model): capacity/headroom for the
+        # MODELS column, and the per-model residency map (the pooled
+        # label rides the 0/1 gauge) for status.pooledModels —
+        # "pooled but unloaded" is an explicit False, never absence.
+        rev.engine_weight_slots = total("kfx_lm_weight_slots")
+        rev.engine_weight_free = total("kfx_lm_weight_slots_free")
+        pooled: Dict[str, bool] = {}
+        for lab, v in t.latest_samples("kfx_lm_weight_model_loaded",
+                                       sel, max_age_s=fresh_s):
+            m = lab.get("pooled", "")
+            if m:
+                pooled[m] = bool(v) or pooled.get(m, False)
+        rev.engine_pooled = pooled
         # KV transfer plane: cumulative migrations (all reasons) for
         # `kfx top`'s MIG column, host-RAM offload residency for the
         # status block.
@@ -1545,12 +1606,16 @@ class InferenceServiceController(Controller):
             parent_id=obs_trace.span_of(isvc),
             namespace=isvc.namespace, isvc=isvc.name,
             revision=rev_name)
+        # mode label: this controller path measures a process SPAWN;
+        # a weight-pool replica closes its artifact-load swaps into
+        # the same family as mode="swap" (serving/weights.py), so one
+        # histogram answers "how much faster is swap than respawn".
         reg.histogram(
             "kfx_autoscaler_cold_start_seconds",
             "Scale-from-zero latency: cold request to first ready "
             "replica.",
         ).observe(duration, namespace=isvc.namespace,
-                  isvc=isvc.name, revision=rev_name)
+                  isvc=isvc.name, revision=rev_name, mode="spawn")
         self.record_event(isvc, "Normal", "ColdStart",
                           f"{rev_name} scaled from zero in {duration:.2f}s")
 
@@ -1671,6 +1736,19 @@ class InferenceServiceController(Controller):
         autoscaling = dict(rt.autoscaling_status)
         if autoscaling and isvc.status.get("autoscaling") != autoscaling:
             isvc.status["autoscaling"] = autoscaling
+            changed = True
+        # Weight-pool residency per revision ({model: loaded?} over
+        # the FULL pooled set) — what `kfx get isvc` renders; "pooled
+        # but unloaded" (False) means servable after one weight swap.
+        pooled = {name: dict(rev.engine_pooled)
+                  for name, rev in rt.revisions.items()
+                  if rev.engine_pooled}
+        if pooled:
+            if isvc.status.get("pooledModels") != pooled:
+                isvc.status["pooledModels"] = pooled
+                changed = True
+        elif "pooledModels" in isvc.status:
+            del isvc.status["pooledModels"]
             changed = True
         if rt.rollout_status is None:
             if "rollout" in isvc.status:

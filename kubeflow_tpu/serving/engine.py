@@ -284,6 +284,28 @@ class AdapterLoadError(EngineOverloaded):
     503 + Retry-After and the router re-dispatches."""
 
 
+class WeightSlotError(PageAllocError):
+    """Every HBM weight slot is pinned by an in-flight request — the
+    whole-checkpoint analogue of AdapterSlotError (serving/weights.py).
+    Pool pressure, not failure: admission requeues behind in-flight
+    work, and a lone unplaceable request sheds with the 503 +
+    Retry-After contract. Subclassing PageAllocError keeps the
+    requeue/preempt handling ONE code path across all three pools
+    (KV pages, adapter slots, weight slots)."""
+
+
+class WeightLoadError(EngineOverloaded):
+    """A model's weight artifact failed to page into its HBM slot
+    (unknown name, unreadable/mismatched export, or the
+    ``weights.load`` chaos point). Unlike adapters there is NO degrade
+    option — serving the wrong weights is never an acceptable
+    fallback — so the engine always fails the request with this
+    error: an EngineOverloaded, so the server answers 503 +
+    Retry-After and the router re-dispatches (possibly landing on a
+    replica that still holds the model, or retrying the swap past a
+    chaos budget)."""
+
+
 class DeadlineInfeasible(EngineOverloaded):
     """The request's deadline cannot be met — judged BEFORE prefill
     (at enqueue against the trailing queue-wait estimate, or at the
@@ -318,7 +340,7 @@ class Request:
     prompt+generated on re-admission."""
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
-                 "stop", "adapter", "tokens", "rng", "error",
+                 "stop", "adapter", "model", "tokens", "rng", "error",
                  "t_enqueue", "t_admitted", "t_done", "counted",
                  "trace_id", "span_id", "_event", "rid", "events",
                  "t_first", "stall_s", "preempts", "spec_prop",
@@ -330,7 +352,7 @@ class Request:
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  top_k: int, seed: int, stop: int, adapter: str = "",
                  qos: str = "interactive",
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, model: str = ""):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -338,6 +360,7 @@ class Request:
         self.seed = seed
         self.stop = stop              # -1 = no stop token
         self.adapter = adapter        # "" = base model (tenant key)
+        self.model = model            # "" = pool default (weight pool)
         # QoS class ("interactive"/"batch"): batch slots are the first
         # preemption victims and the first shed under pool pressure.
         self.qos = qos
@@ -493,16 +516,19 @@ class BlockManager:
 
 
 class _PrefixEntry:
-    __slots__ = ("key", "parent", "page", "tokens", "partial", "nchildren")
+    __slots__ = ("key", "parent", "page", "tokens", "partial",
+                 "nchildren", "root")
 
     def __init__(self, key: bytes, parent: bytes, page: int,
-                 tokens: Tuple[int, ...], partial: bool):
+                 tokens: Tuple[int, ...], partial: bool,
+                 root: bytes = b""):
         self.key = key          # lru/map key (chain hash; partial: parent)
         self.parent = parent
         self.page = page
         self.tokens = tokens    # partial entries: the page's real tokens
         self.partial = partial
         self.nchildren = 0      # cached entries extending this one
+        self.root = root        # chain seed (adapter / model@generation)
 
 
 class PrefixCache:
@@ -574,7 +600,7 @@ class PrefixCache:
         return pages, cow, matched, key
 
     def insert_full(self, parent: bytes, page_tokens: Sequence[int],
-                    page: int) -> bytes:
+                    page: int, root: bytes = b"") -> bytes:
         """Register one full prompt page; returns its chain key. A
         pre-existing identical entry is refreshed, not duplicated."""
         key = _chain_hash(parent, page_tokens)
@@ -582,7 +608,7 @@ class PrefixCache:
         if e is not None:
             self._touch(e)
             return key
-        e = _PrefixEntry(key, parent, page, (), False)
+        e = _PrefixEntry(key, parent, page, (), False, root=root)
         self.mgr.incref(page)
         self.full[key] = e
         self._lru[(False, key)] = e
@@ -592,13 +618,14 @@ class PrefixCache:
         return key
 
     def insert_partial(self, parent: bytes, tokens: Sequence[int],
-                       page: int) -> None:
+                       page: int, root: bytes = b"") -> None:
         """Register a partially-filled boundary page (first writer
         wins per parent — replacing a hot partial with an equivalent
         one would only churn refcounts)."""
         if not tokens or parent in self.partial:
             return
-        e = _PrefixEntry(parent, parent, page, tuple(tokens), True)
+        e = _PrefixEntry(parent, parent, page, tuple(tokens), True,
+                         root=root)
         self.mgr.incref(page)
         self.partial[parent] = e
         self._lru[(True, parent)] = e
@@ -629,6 +656,21 @@ class PrefixCache:
                 self._drop(e)
                 return True
         return False
+
+    def drop_root(self, root: bytes) -> List[int]:
+        """Invalidate every chain seeded at ``root`` — the weight
+        pool's eviction hook (docs/serving.md "Weights as a fleet
+        resource"): a model's cached prompt pages must never survive
+        its weight slot, or a stale prefix hit would pair pages
+        computed under the OLD weights with a freshly swapped-in tree.
+        Pages a live slot still reads keep their slot ref and return
+        to the free list when that slot retires (the in-flight request
+        admitted under the old generation and keeps its pin)."""
+        freed: List[int] = []
+        for e in list(self._lru.values()):
+            if e.root == root:
+                freed += self._drop(e)
+        return freed
 
     def drop_all(self) -> List[int]:
         """Drop every entry, releasing the cache's page refs (pages a
@@ -677,7 +719,11 @@ class DecodeEngine:
                  rate_burst_s: float = 2.0,
                  role: str = "mixed",
                  kv_peer_send: Optional[Callable[[bytes], str]] = None,
-                 kv_offload_pages: int = 0):
+                 kv_offload_pages: int = 0,
+                 models: Optional[Dict[str, str]] = None,
+                 weight_slots: int = 0,
+                 model_default: str = "",
+                 model_idle_s: float = 0.0):
         import jax
 
         from ..models.generate import decode_config
@@ -904,6 +950,62 @@ class DecodeEngine:
                 f"adapter_default {self.adapter_default!r} is not a "
                 "configured adapter")
 
+        # -- multi-model HBM weight pool (serving/weights.py): several
+        # whole checkpoints time-share this engine's chips. The
+        # compiled hot functions take ``params`` as a traced ARGUMENT,
+        # so same-shaped models share ONE executable — a swap is a
+        # device_put, and _decode_once groups batch rows per weight
+        # slot. The ctor params are the DEFAULT model, adopted into a
+        # permanently-pinned slot (the warm template every compile and
+        # readiness check uses).
+        self.model_default = model_default or ""
+        self.model_idle_s = float(model_idle_s)
+        if models:
+            if self.spec:
+                raise ValueError(
+                    "models= (weight pool) is incompatible with "
+                    "speculative decoding: the layer-truncated draft "
+                    "derives from ONE checkpoint")
+            if self._apool is not None:
+                raise ValueError(
+                    "models= (weight pool) is incompatible with "
+                    "adapters=: the LoRA slot pool factors over ONE "
+                    "base model")
+            if role != "mixed" or kv_peer_send is not None:
+                raise ValueError(
+                    "models= (weight pool) requires role='mixed' with "
+                    "no KV peers: a migrated request's pages would "
+                    "decode under the peer's weights")
+            if not self.model_default:
+                raise ValueError(
+                    "model_default must name the engine's resident "
+                    "model (one of models=)")
+            if self.model_default not in models:
+                raise ValueError(
+                    f"model_default {self.model_default!r} is not a "
+                    "configured model")
+            n_wslots = int(weight_slots) if weight_slots else len(models)
+            from .weights import WeightPool
+
+            self._wpool: Optional["WeightPool"] = WeightPool(
+                self.cfg, params, n_slots=n_wslots, sources=models,
+                name=name, registry=self._reg,
+                on_evict=self._on_model_evict)
+            # The default model is the pool's template: adopted
+            # pre-pinned so neither LRU pressure nor the idle sweep
+            # can evict the tree self.params (warm/compile signatures)
+            # aliases.
+            self._default_wid = self._wpool.adopt(
+                self.model_default, self.params, pin=True)
+        else:
+            if weight_slots or self.model_default:
+                raise ValueError(
+                    "weight_slots/model_default require models= "
+                    "(name -> LM export dir)")
+            self._wpool = None
+            self._default_wid = -1
+        self._last_idle_sweep = 0.0  # idle scale-to-zero rate limit
+
         # -- device state (touched only by the loop thread after start)
         self._cache = self._init_cache()
         self._logbuf = self._init_logbuf()
@@ -939,6 +1041,12 @@ class DecodeEngine:
         # every hot dispatch; the slot holds one AdapterPool reference
         # per id >= 0 for its lifetime.
         self._aids = np.full((B,), -1, np.int32)
+        # Per-slot WEIGHT-pool slot ids ([B] int32, -1 = the engine's
+        # resident params — non-pool mode). A slot holds one WeightPool
+        # reference per id >= 0 for its lifetime; _decode_once groups
+        # active slots by wid and dispatches each group with its own
+        # param tree through the SAME compiled executable.
+        self._wids = np.full((B,), -1, np.int32)
         # Chunked-prefill cursors: slot -> {"req", "full", "n",
         # "next" (absolute index of the next chunk's first token),
         # "key"/"reg_block" (incremental prefix-cache registration
@@ -1100,6 +1208,43 @@ class DecodeEngine:
                 "slots": self._apool.n_slots,
                 "free": self._apool.n_free}
 
+    def weight_stats(self) -> Dict[str, Any]:
+        """Cumulative weight-pool counters (zeros without a pool):
+        artifact swap-ins, evictions, slot capacity, free slots and
+        the resident model names. Public surface for bench/test deltas
+        and the server's JSON engine block."""
+        if self._wpool is None:
+            return {"loads": 0, "evictions": 0, "slots": 0, "free": 0,
+                    "loaded": []}
+        return {"loads": self._wpool.loads,
+                "evictions": self._wpool.evictions,
+                "slots": self._wpool.n_slots,
+                "free": self._wpool.n_free,
+                "loaded": self._wpool.loaded()}
+
+    def pooled_models(self) -> Dict[str, bool]:
+        """{name: resident?} for every model the pool was configured
+        with — the readiness/status surface behind
+        ``status.pooledModels`` ("pooled but unloaded" is an explicit
+        False, not an unknown name). Empty without a pool."""
+        if self._wpool is None:
+            return {}
+        loaded = set(self._wpool.loaded())
+        return {m: (m in loaded)
+                for m in sorted(self._wpool.sources)}
+
+    def evict_model(self, name: str) -> bool:
+        """Explicitly evict ``name``'s weights from its pool slot (the
+        operator's scale-to-zero push, or an admin drain). Runs on the
+        decode-loop thread at an iteration boundary — slot state is
+        loop-owned, exactly like KV-transfer surgery. False when the
+        model is not resident, is worn by in-flight requests, or is
+        the pinned default."""
+        if self._wpool is None:
+            return False
+        return bool(self._run_on_loop(
+            lambda: self._wpool.evict_model(name)))
+
     def hbm_bytes(self) -> Dict[str, int]:
         """Measured device-buffer accounting — actual array bytes, not
         estimates, valid on any backend: base weights, target/draft KV
@@ -1124,6 +1269,12 @@ class DecodeEngine:
                       + nbytes(self._draft_cache)) if self.spec else 0,
             "adapters": self._apool.nbytes()
             if self._apool is not None else 0,
+            # Pooled checkpoints BEYOND the resident default (whose
+            # tree aliases self.params and is counted there): the
+            # marginal HBM cost of hosting N models on one replica —
+            # the lm_multimodel bench ratio's numerator delta.
+            "weights": max(0, self._wpool.nbytes() - nbytes(self.params))
+            if self._wpool is not None else 0,
         }
         out["total"] = sum(out.values())
         return out
@@ -1280,6 +1431,13 @@ class DecodeEngine:
             reg.counter("kfx_lm_adapter_requests_total",
                         "Admitted client requests by adapter tenant."
                         ).inc(0, model=self.name, adapter="base")
+        # Weight-pool families are seeded iff the engine HAS a pool
+        # (their absence marks a single-model engine): slot gauges for
+        # `kfx top`'s MODELS column, swap/load/eviction families for
+        # the scale-from-zero story, and per-model residency gauges
+        # the operator folds into status.pooledModels.
+        if self._wpool is not None:
+            self._wpool.touch()
         # Speculative families are seeded iff the engine HAS a draft —
         # their absence is the signal (the server's JSON engine block
         # omits spec_accept_rate and `kfx top` renders "-", never a
@@ -1586,9 +1744,18 @@ class DecodeEngine:
                     positions=eff_pos[:, None], block_tables=tables,
                     write_locations=eff_loc[:, None], lora=lora,
                     adapter_ids=aids, mutable=["cache"])
+                # The logits CARRY is active-gated like the cache
+                # writes: an inactive row's dummy step produced
+                # garbage logits, and in weight-pool mode "inactive"
+                # includes every slot of the OTHER groups — letting
+                # the dummy logits through would overwrite a masked
+                # slot's pending next-token logits with values from a
+                # foreign model's dispatch.
+                logits3 = jnp.where(active[:, None],
+                                    logits2[:, 0], logits)
                 pos2 = jnp.where(active, pos + 1, pos)
                 loc2 = jnp.where(active, loc + 1, loc)
-                return ((vars_["cache"], logits2[:, 0], pos2, loc2,
+                return ((vars_["cache"], logits3, pos2, loc2,
                          active2, produced2, next_rngs), (tok, emit))
 
             carry = (cache, logbuf, pos, loc, active, produced, rngs)
@@ -2121,7 +2288,8 @@ class DecodeEngine:
                       adapter: Optional[str] = None,
                       qos: Optional[str] = None,
                       deadline_s: Optional[float] = None,
-                      tenant: Optional[str] = None) -> Request:
+                      tenant: Optional[str] = None,
+                      model: Optional[str] = None) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -2142,6 +2310,21 @@ class DecodeEngine:
             raise ValueError(
                 f"unknown adapter {name!r} (configured: "
                 f"{sorted(self._apool.sources) if self._apool else []})")
+        # Model selection (weight pool): explicit name, else the
+        # engine's resident default; "" always means the default.
+        # Unknown names are a client mistake (ValueError -> 400),
+        # never a 503 — the pool only pages artifacts it was told
+        # about at spec time.
+        mdl = str(model or "")
+        if mdl:
+            if self._wpool is None:
+                raise ValueError(
+                    "per-request model selection requires a weight "
+                    "pool (models= in the engine spec)")
+            if not self._wpool.known(mdl):
+                raise ValueError(
+                    f"unknown model {mdl!r} (pooled: "
+                    f"{sorted(self._wpool.sources)})")
         # QoS class: per-request override, else the engine default.
         # Unknown classes are a client mistake (-> 400), never a 503.
         cls = qos if qos is not None else self.qos_default
@@ -2162,7 +2345,8 @@ class DecodeEngine:
         req = Request(prompt, int(max_new_tokens), float(temperature),
                       int(top_k), int(seed),
                       -1 if stop_token is None else int(stop_token),
-                      adapter=name, qos=cls, deadline=deadline)
+                      adapter=name, qos=cls, deadline=deadline,
+                      model=mdl)
         req._flight = self.flight
         # Billable tenant: the client's explicit key, else the adapter
         # tenant ("" = the base tenant) — the same resolution the rate
@@ -2316,21 +2500,23 @@ class DecodeEngine:
                deadline_s: Optional[float] = None,
                tenant: Optional[str] = None, meter_skip: int = 0,
                on_token: Optional[Callable[[Optional[int]], None]]
-               = None) -> Request:
+               = None, model: Optional[str] = None) -> Request:
         """Enqueue one prompt; returns the request handle (wait with
         ``.result(timeout)``). ``adapter`` selects a configured LoRA
-        adapter by name (None = engine default, "" = base); ``qos``
-        overrides the engine's class default; ``deadline_s`` is the
-        per-request deadline (None = spec default, which may be none);
-        ``on_token`` is the streaming sink — called on the loop thread
-        with each token id as it lands, then None at retirement.
-        Raises EngineOverloaded when the bounded admission queue is
-        full, DeadlineInfeasible/RateLimited when admission policy
-        sheds the request."""
+        adapter by name (None = engine default, "" = base); ``model``
+        selects a pooled model by name on a multi-model engine (None/""
+        = the resident default); ``qos`` overrides the engine's class
+        default; ``deadline_s`` is the per-request deadline (None =
+        spec default, which may be none); ``on_token`` is the streaming
+        sink — called on the loop thread with each token id as it
+        lands, then None at retirement. Raises EngineOverloaded when
+        the bounded admission queue is full,
+        DeadlineInfeasible/RateLimited when admission policy sheds the
+        request."""
         req = self._make_request(prompt, max_new_tokens, temperature,
                                  top_k, seed, stop_token, adapter,
                                  qos=qos, deadline_s=deadline_s,
-                                 tenant=tenant)
+                                 tenant=tenant, model=model)
         # Recovery re-dispatch (router stream_skip): the first N
         # regenerated tokens were already billed and streamed by the
         # replica that died — set BEFORE enqueue so even an instant
@@ -2347,7 +2533,8 @@ class DecodeEngine:
                  adapter: Optional[str] = None,
                  qos: Optional[str] = None,
                  deadline_s: Optional[float] = None,
-                 tenant: Optional[str] = None
+                 tenant: Optional[str] = None,
+                 model: Optional[str] = None
                  ) -> List[List[int]]:
         """Blocking convenience mirroring LMGenerator.generate: one
         request per prompt (seeded seed+i), results in prompt order.
@@ -2359,7 +2546,7 @@ class DecodeEngine:
         reqs = self.submit_batch(prompts, max_new_tokens, temperature,
                                  top_k, seed, stop_token, adapter,
                                  qos=qos, deadline_s=deadline_s,
-                                 tenant=tenant)
+                                 tenant=tenant, model=model)
         wait_s = deadline_s if deadline_s else self.request_timeout_s
         deadline = time.monotonic() + wait_s
         return [r.result(max(0.001, deadline - time.monotonic()))
@@ -2372,7 +2559,8 @@ class DecodeEngine:
                      adapter: Optional[str] = None,
                      qos: Optional[str] = None,
                      deadline_s: Optional[float] = None,
-                     tenant: Optional[str] = None
+                     tenant: Optional[str] = None,
+                     model: Optional[str] = None
                      ) -> List[Request]:
         """`generate` minus the blocking wait: one request per prompt
         (seeded seed+i), enqueued atomically, handles returned — so a
@@ -2381,7 +2569,7 @@ class DecodeEngine:
         reqs = [self._make_request(p, max_new_tokens, temperature,
                                    top_k, seed + i, stop_token, adapter,
                                    qos=qos, deadline_s=deadline_s,
-                                   tenant=tenant)
+                                   tenant=tenant, model=model)
                 for i, p in enumerate(prompts)]
         self._enqueue(reqs)
         return reqs
@@ -2445,6 +2633,13 @@ class DecodeEngine:
             # happens only under slot pressure).
             self._apool.release(aid)
         self._aids[slot] = -1
+        wid = int(self._wids[slot])
+        if wid >= 0 and self._wpool is not None:
+            # Unpin the slot's model; the WEIGHTS stay resident (LRU
+            # keeps hot models in HBM across requests — eviction
+            # happens only under slot pressure or the idle sweep).
+            self._wpool.release(wid)
+        self._wids[slot] = -1
 
     def _release_draft(self, slot: int) -> None:
         if self._draft_mgr is not None and self._draft_slot_pages[slot]:
@@ -2718,6 +2913,10 @@ class DecodeEngine:
         request exactly as if no migration was attempted, and the
         router's seeded re-dispatch remains the recovery of last
         resort. Returns {"moved", "failed", "pages"}."""
+        if self._wpool is not None:
+            raise ValueError(
+                f"engine {self.name} hosts a weight pool: migrated "
+                "pages would decode under the peer's weights")
         send = send if send is not None else self._peer_send
         if send is None:
             raise ValueError(
@@ -2816,6 +3015,10 @@ class DecodeEngine:
         TransferError/TransferCorrupt (nothing imported) or
         EngineOverloaded (no slot / no pages — the donor keeps the
         request)."""
+        if self._wpool is not None:
+            raise kvtransfer.TransferError(
+                f"engine {self.name} hosts a weight pool: imported "
+                "pages would decode under a different model's weights")
         inj = chaos.draw("kv.transfer", target=self.name)
         if inj is not None:
             if inj.delay > 0:
@@ -2953,7 +3156,7 @@ class DecodeEngine:
                     if pg < 0:
                         break
                     key = self._prefix.insert_full(
-                        key, full[b * ps:(b + 1) * ps], pg)
+                        key, full[b * ps:(b + 1) * ps], pg, root=root)
                     reg_block = b + 1
             if phase == "prefill":
                 cur = header["cursor"]
@@ -2963,7 +3166,7 @@ class DecodeEngine:
                 self._prefilling[slot] = {
                     "req": req, "full": full, "n": n,
                     "next": int(cur["next"]), "key": key,
-                    "reg_block": reg_block,
+                    "reg_block": reg_block, "root": root,
                     "bucket": int(cur["bucket"]),
                     "remaining": int(cur["remaining"]),
                     "fresh": bool(cur.get("fresh"))}
@@ -3095,7 +3298,8 @@ class DecodeEngine:
 
     def _promote_offloaded(self, full: List[int], max_reuse: int,
                            shared: List[int], matched: int,
-                           key: bytes) -> Tuple[int, bytes]:
+                           key: bytes,
+                           root: bytes = b"") -> Tuple[int, bytes]:
         """Extend a prefix-cache match from the host offload tier:
         while the next full page's chain hash is resident in host
         RAM, allocate a device page, scatter the payload back (the
@@ -3141,7 +3345,7 @@ class DecodeEngine:
                 raise
             self._offload.pop(nxt)
             self._prefix.insert_full(
-                key, full[matched:matched + ps], page)
+                key, full[matched:matched + ps], page, root=root)
             ours.append(page)
             shared.append(page)
             key = nxt
@@ -3161,6 +3365,13 @@ class DecodeEngine:
                 while (not self._stopped and not self._queue
                        and self._active_count() == 0
                        and not self._control):
+                    # A weight pool with an idle window must keep
+                    # ticking while parked, or a fully-idle replica
+                    # would never run the scale-to-zero sweep below.
+                    if self._wpool is not None and self.model_idle_s > 0:
+                        self._cond.wait(
+                            timeout=min(1.0, self.model_idle_s))
+                        break
                     self._cond.wait()
                 if self._stopped:
                     return
@@ -3170,6 +3381,12 @@ class DecodeEngine:
                 # must see a quiesced iteration boundary, exactly like
                 # admission.
                 self._service_control()
+                # Replica-side scale-to-zero: models idle past
+                # model_idle_s leave their weight slots at the
+                # iteration boundary (the timed park above keeps the
+                # sweep ticking on a fully-idle replica; the operator
+                # can also push :evict explicitly).
+                self._maybe_evict_idle()
                 # Decode-stall accounting: prefill dispatch time (a
                 # monolithic admission's, or this iteration's one
                 # prompt chunk) is observed as stall only when active
@@ -3218,6 +3435,22 @@ class DecodeEngine:
                 time.sleep(0.01)        # KeyboardInterrupt/SystemExit
                 #                         propagate (they are shutdown,
                 #                         not request failures)
+
+    def _maybe_evict_idle(self) -> None:
+        """The weight pool's idle sweep (loop thread, iteration
+        boundary, rate-limited to ~1/s): every ref-0 model idle past
+        ``model_idle_s`` drops its slot — scale-to-zero as an eviction
+        the NEXT acquire undoes with a measured swap, never a process
+        restart. The resident default stays warm (minReplicas=1
+        semantics)."""
+        if self._wpool is None or self.model_idle_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_idle_sweep < min(1.0, self.model_idle_s):
+            return
+        self._last_idle_sweep = now
+        self._wpool.evict_idle(self.model_idle_s,
+                               keep=self.model_default)
 
     def _record_flight(self) -> None:
         """Append this iteration's flight record (loop thread, end of
@@ -3326,6 +3559,52 @@ class DecodeEngine:
                     1, model=self.name)
             return -1
 
+    def _resolve_model(self, req: Request) -> int:
+        """The request's weight-pool slot for this admission: acquire
+        (and swap in, if needed) its named model — or the engine's
+        resident default — pinning the slot for the request's
+        residency. There is NO fallback knob: serving a request under
+        the wrong weights is never a degrade option, so a load failure
+        propagates as WeightLoadError (-> 503 + Retry-After; the
+        router re-dispatches or the activator spawns a dedicated
+        replica). WeightSlotError (every slot worn by in-flight work)
+        is pool pressure, handled exactly like KV-page exhaustion —
+        the request requeues while slots retire."""
+        if self._wpool is None:
+            return -1
+        return self._wpool.acquire(req.model or self.model_default)
+
+    def _on_model_evict(self, name: str, root: bytes) -> None:
+        """Weight-pool eviction hook (loop thread, fired BEFORE the
+        slot can be refilled): drop the evicted model's live prefix
+        chains so a stale prefix hit can never pair with freshly
+        swapped-in weights. Host-offloaded pages need no sweep — their
+        chain keys embed the per-load generation, so a reloaded model
+        roots a fresh chain that can never match them."""
+        if self._prefix is not None:
+            self._prefix.drop_root(root)
+
+    def _params_for(self, slot: int):
+        """The param tree a dispatch for ``slot`` must run under: the
+        slot's pinned pool model, or the engine's resident params
+        outside pool mode."""
+        wid = int(self._wids[slot])
+        if self._wpool is None or wid < 0:
+            return self.params
+        return self._wpool.tree(wid)
+
+    def _root_for(self, req: Request, aid: int, wid: int) -> bytes:
+        """Prefix-cache chain root for an admission. Pool mode roots
+        at the weight slot's ``name@generation`` (fresh per load, so
+        chains built against evicted weights never match again);
+        otherwise the resolved ADAPTER name — cached pages hold
+        adapter-specific KV, and a request degraded to base-only
+        (adapters.fallback=base) must chain with base traffic."""
+        if wid >= 0:
+            return self._wpool.root(wid)
+        return req.adapter.encode() if (req.adapter and aid >= 0) \
+            else b""
+
     def _admit(self, req: Request, slot: int) -> None:
         # Fault point: admission failure/latency — the engine-era
         # analogue of serving.predict (docs/chaos.md).
@@ -3344,8 +3623,16 @@ class DecodeEngine:
         # pressure. Any later failure that does not install the
         # request in the slot releases the pin (the finally below).
         aid = self._resolve_adapter(req)
+        wid = -1
         try:
-            self._admit_resolved(req, slot, aid)
+            # Weight-pool resolution rides the same contract: the slot
+            # must be pinned (and the swap done) before any page work,
+            # since prompt KV is decoded under these weights.
+            # WeightSlotError requeues like page pressure;
+            # WeightLoadError fails this request via _admit_ready's
+            # net (503 + Retry-After — never the wrong weights).
+            wid = self._resolve_model(req)
+            self._admit_resolved(req, slot, aid, wid)
         finally:
             # _fail_inflight (donated-dispatch death) may already have
             # dropped every pin via release_all(); ref 0 means this
@@ -3353,9 +3640,12 @@ class DecodeEngine:
             if aid >= 0 and self._slots[slot] is not req \
                     and self._apool.ref[aid] > 0:
                 self._apool.release(aid)
+            if wid >= 0 and self._slots[slot] is not req \
+                    and self._wpool.ref[wid] > 0:
+                self._wpool.release(wid)
 
     def _admit_resolved(self, req: Request, slot: int,
-                        aid: int) -> None:
+                        aid: int, wid: int = -1) -> None:
         import jax
 
         from ..models.generate import pow2_bucket
@@ -3371,14 +3661,11 @@ class DecodeEngine:
         bucket = pow2_bucket(n, L - remaining)
         # Shared-prefix reuse, capped at n-1: the last prompt token
         # must run through the model to produce the next-token logits.
-        # The chain roots at the ADAPTER name: cached pages hold
-        # adapter-specific KV, so identical tokens under different
-        # adapters never collide. The root follows the RESOLVED id,
-        # not the requested name — a request degraded to base-only
-        # (adapters.fallback=base) writes BASE KV and must chain with
-        # base traffic, never poison the adapter's chain.
-        root = req.adapter.encode() if (req.adapter and aid >= 0) \
-            else b""
+        # The chain roots at the weight slot's name@generation in pool
+        # mode, else the resolved ADAPTER name: cached pages hold
+        # model/adapter-specific KV, so identical tokens under
+        # different weights never collide (_root_for).
+        root = self._root_for(req, aid, wid)
         shared: List[int] = []
         cow = None
         matched = 0
@@ -3393,7 +3680,7 @@ class DecodeEngine:
                 # already consumed mid-page tokens, past which the
                 # chain cannot fold.
                 matched, key = self._promote_offloaded(
-                    full, n - 1, shared, matched, key)
+                    full, n - 1, shared, matched, key, root=root)
         tail = full[matched:]
         if self.prefill_chunk_tokens and \
                 len(tail) > self.prefill_chunk_tokens:
@@ -3403,7 +3690,7 @@ class DecodeEngine:
             # cursor; the loop advances it one chunk per iteration.
             return self._admit_chunked(req, slot, full, n, remaining,
                                        bucket, shared, cow, matched,
-                                       key, aid)
+                                       key, aid, wid)
         P = pow2_bucket(len(tail), L)
         fn = self._prefill_for(P)       # compile OUTSIDE the mutation
         cfn = self._copy_fn() if cow else None  # window: failing here
@@ -3452,7 +3739,8 @@ class DecodeEngine:
                                       np.int32(cow[0]),
                                       np.int32(cow[1]))
                 self._cache, self._logbuf = fn(
-                    self.params, self._cache, self._logbuf, tokens,
+                    self.params if wid < 0 else self._wpool.tree(wid),
+                    self._cache, self._logbuf, tokens,
                     row[None, :], np.int32(slot), np.int32(len(tail)),
                     np.int32(matched), self._lora_tree(),
                     np.full((1,), aid, np.int32))
@@ -3487,10 +3775,12 @@ class DecodeEngine:
             h = key
             for b in range(len(shared), n // ps):
                 h = self._prefix.insert_full(
-                    h, full[b * ps:(b + 1) * ps], int(row[b]))
+                    h, full[b * ps:(b + 1) * ps], int(row[b]),
+                    root=root)
             if n % ps and row[n // ps] >= 0:
                 self._prefix.insert_partial(
-                    h, full[(n // ps) * ps:n], int(row[n // ps]))
+                    h, full[(n // ps) * ps:n], int(row[n // ps]),
+                    root=root)
         self._pos[slot] = n
         self._loc[slot] = bucket
         self._max_loc[slot] = bucket + remaining - 1
@@ -3511,6 +3801,7 @@ class DecodeEngine:
         self._max_new[slot] = req.max_new
         self._pending[slot] = -1  # next iteration samples from logbuf
         self._aids[slot] = aid
+        self._wids[slot] = wid    # slot owns the weight-pool pin now
         self._slots[slot] = req
         if self.spec:
             self._admit_draft(req, slot, full, n)
@@ -3652,7 +3943,8 @@ class DecodeEngine:
     def _admit_chunked(self, req: Request, slot: int, full: List[int],
                        n: int, remaining: int, bucket: int,
                        shared: List[int], cow, matched: int,
-                       key: bytes, aid: int = -1) -> None:
+                       key: bytes, aid: int = -1,
+                       wid: int = -1) -> None:
         """Chunked admission: place the request in the slot WITHOUT a
         prompt prefill dispatch — pin the matched prefix pages (and
         clone the COW boundary page, a one-page compiled copy), record
@@ -3692,10 +3984,12 @@ class DecodeEngine:
         self._active[slot] = False
         self._pending[slot] = -1
         self._aids[slot] = aid
+        self._wids[slot] = wid    # slot owns the weight-pool pin now
         self._slots[slot] = req
         self._prefilling[slot] = {
             "req": req, "full": full, "n": n, "next": matched,
             "key": key, "reg_block": len(shared),
+            "root": self._root_for(req, aid, wid),
             "bucket": bucket, "remaining": remaining,
             # Whether THIS admission was counted as a client
             # admission — the late re-match's hit accounting must
@@ -3775,7 +4069,8 @@ class DecodeEngine:
                             tokens=str(length)):
             try:
                 self._cache, self._logbuf = fn(
-                    self.params, self._cache, self._logbuf, tokens,
+                    self._params_for(slot), self._cache, self._logbuf,
+                    tokens,
                     np.ascontiguousarray(
                         self._tables[slot])[None, :],
                     np.int32(slot), np.int32(length), np.int32(start),
@@ -3814,12 +4109,12 @@ class DecodeEngine:
         caller must stop touching this cursor)."""
         req = cur["req"]
         # Same resolved-id rule as admission: a degraded slot (aid -1)
-        # holds base KV and must match the base chain.
-        aid = int(self._aids[slot])
+        # holds base KV and must match the base chain; a pool slot
+        # matches only its weight generation's chain.
         shared, cow, matched, key = self._prefix.match(
             cur["full"], cur["n"] - 1,
-            root=req.adapter.encode() if (req.adapter and aid >= 0)
-            else b"")
+            root=self._root_for(req, int(self._aids[slot]),
+                                int(self._wids[slot])))
         if not matched:
             return True
         pinned = shared + ([cow[0]] if cow is not None else [])
@@ -3864,18 +4159,19 @@ class DecodeEngine:
         ps = self.page_size
         n, full = cur["n"], cur["full"]
         h = cur["key"]
+        root = cur.get("root", b"")
         covered = min(cur["next"], n) // ps
         b = cur["reg_block"]
         while b < covered:
             h = self._prefix.insert_full(
                 h, full[b * ps:(b + 1) * ps],
-                int(self._tables[slot, b]))
+                int(self._tables[slot, b]), root=root)
             b += 1
         cur["key"], cur["reg_block"] = h, b
         if final and n % ps and self._tables[slot, n // ps] >= 0:
             self._prefix.insert_partial(
                 h, full[(n // ps) * ps:n],
-                int(self._tables[slot, n // ps]))
+                int(self._tables[slot, n // ps]), root=root)
 
     def _finish_prefill(self, slot: int) -> None:
         """Cursor complete: the slot's pages hold the whole prompt at
@@ -4220,23 +4516,28 @@ class DecodeEngine:
                             parent_id=oldest.span_id, model=self.name,
                             slots=str(n_active),
                             k=str(self.chunk_tokens)):
-            out = self._decode()(
-                self.params, self._cache, self._logbuf,
-                np.ascontiguousarray(self._tables), self._pos,
-                self._loc, self._active, self._produced, self._rngs,
-                self._temp, self._topk, self._stop, self._max_new,
-                self._lora_tree(), np.ascontiguousarray(self._aids))
-        (self._cache, self._logbuf, pos, loc, active, produced, rngs,
-         toks, emits) = out
-        # np.array (copy): admission mutates these rows in place, and a
-        # bare asarray of a jax output is a read-only view.
-        self._pos = np.array(pos)
-        self._loc = np.array(loc)
-        self._active = np.array(active)
-        self._produced = np.array(produced)
-        self._rngs = np.array(rngs)
-        toks = np.asarray(toks)    # [k, B]
-        emits = np.asarray(emits)  # [k, B] bool
+            if self._wpool is None:
+                out = self._decode()(
+                    self.params, self._cache, self._logbuf,
+                    np.ascontiguousarray(self._tables), self._pos,
+                    self._loc, self._active, self._produced,
+                    self._rngs, self._temp, self._topk, self._stop,
+                    self._max_new, self._lora_tree(),
+                    np.ascontiguousarray(self._aids))
+                (self._cache, self._logbuf, pos, loc, active,
+                 produced, rngs, toks, emits) = out
+                # np.array (copy): admission mutates these rows in
+                # place, and a bare asarray of a jax output is a
+                # read-only view.
+                self._pos = np.array(pos)
+                self._loc = np.array(loc)
+                self._active = np.array(active)
+                self._produced = np.array(produced)
+                self._rngs = np.array(rngs)
+                toks = np.asarray(toks)    # [k, B]
+                emits = np.asarray(emits)  # [k, B] bool
+            else:
+                toks, emits = self._decode_grouped()
         reg = self._reg()
         reg.counter("kfx_lm_engine_chunks_total",
                     "Decode-chunk dispatches.").inc(1, model=self.name)
@@ -4268,6 +4569,59 @@ class DecodeEngine:
                             emitted, model=self.name)
         self._touch_gauges()
 
+    def _decode_grouped(self):
+        """One decode chunk across every active slot, in WEIGHT-POOL
+        mode: active slots group by their pinned weight slot and the
+        SAME compiled chunk executable runs once per group — params
+        are a traced argument, so N models share one AOT compilation —
+        with the group's slots active and everyone else masked.
+        Per-group outputs merge under the group mask: the dispatch ran
+        with other slots masked, so its verdicts for them (active
+        forced False, rng streams advanced by the scan) are artifacts
+        of the mask, not state — each slot's pos/loc/active/produced/
+        rng advance exactly once, in its own group's dispatch, keeping
+        every per-slot stream byte-identical to a dedicated engine's.
+        toks/emits accumulate (emit is active-gated, so groups never
+        overlap); cache/logbuf chain through the donation — safe
+        because the compiled step gates BOTH per row (cache writes at
+        location -1, logits carry under the active mask), so a
+        foreign group's dispatch cannot touch a masked slot's KV or
+        its pending next-token logits."""
+        fn = self._decode()
+        wids = sorted({int(self._wids[s])
+                       for s in range(self.n_slots)
+                       if self._active[s]})
+        toks_all = np.zeros((self.chunk_tokens, self.n_slots),
+                            np.int32)
+        emits_all = np.zeros((self.chunk_tokens, self.n_slots),
+                             np.bool_)
+        for wid in wids:
+            gmask = np.asarray(self._active & (self._wids == wid))
+            out = fn(
+                self._wpool.tree(wid), self._cache, self._logbuf,
+                np.ascontiguousarray(self._tables), self._pos,
+                self._loc, gmask, self._produced, self._rngs,
+                self._temp, self._topk, self._stop, self._max_new,
+                self._lora_tree(), np.ascontiguousarray(self._aids))
+            (self._cache, self._logbuf, pos, loc, active, produced,
+             rngs, toks, emits) = out
+            toks = np.asarray(toks)
+            emits = np.asarray(emits)
+            # np.where allocates fresh writable arrays, preserving
+            # the copy-before-mutation contract of the single-model
+            # path.
+            self._pos = np.where(gmask, np.asarray(pos), self._pos)
+            self._loc = np.where(gmask, np.asarray(loc), self._loc)
+            self._produced = np.where(gmask, np.asarray(produced),
+                                      self._produced)
+            self._active = np.where(gmask, np.asarray(active),
+                                    self._active)
+            self._rngs = np.where(gmask[:, None], np.asarray(rngs),
+                                  self._rngs)
+            toks_all = np.where(emits, toks, toks_all)
+            emits_all = emits_all | emits
+        return toks_all, emits_all
+
     def _fail_inflight(self, e: BaseException) -> None:
         for slot, req in enumerate(self._slots):
             if req is not None:
@@ -4280,6 +4634,13 @@ class DecodeEngine:
             # have corrupted them).
             self._apool.release_all()
         self._aids[:] = -1
+        if self._wpool is not None:
+            # Same contract for pooled model weights: slot trees are
+            # never donated, so they survive a dead dispatch intact —
+            # only the request pins drop (the pinned default is not
+            # refcounted, so it stays unevictable).
+            self._wpool.release_all()
+        self._wids[:] = -1
         self._active[:] = False
         self._tables[:, :] = -1
         self._slot_pages = [[] for _ in range(self.n_slots)]

@@ -238,6 +238,33 @@ class LMPredictor(Predictor):
             os.environ.get("KFX_LM_ADAPTER_RANK", "0"))
         self.adapter_fallback = os.environ.get(
             "KFX_LM_ADAPTER_FALLBACK", "base")
+        # Multi-model weight pool (docs/serving.md "Weights as a
+        # fleet resource"): KFX_LM_MODELS is a JSON object
+        # {name: LM export dir} of whole checkpoints time-sharing
+        # this replica's chips (spec.<rev>.models.artifacts via the
+        # operator); requests select one with the body field "model".
+        # MODEL_DEFAULT names the resident model ``model_dir``
+        # already points at (required with MODELS); WEIGHT_SLOTS
+        # sizes the HBM slot pool (0 = one slot per model);
+        # WEIGHT_IDLE_S > 0 evicts models idle that long — the
+        # replica-side scale-to-zero (the default stays warm).
+        try:
+            self.models = json.loads(
+                os.environ.get("KFX_LM_MODELS", "") or "{}")
+        except ValueError as e:
+            raise ValueError(
+                f"KFX_LM_MODELS is not valid JSON: {e}") from e
+        if not isinstance(self.models, dict) or any(
+                not isinstance(k, str) or not isinstance(v, str)
+                for k, v in self.models.items()):
+            raise ValueError(
+                "KFX_LM_MODELS must be a JSON object "
+                "{name: LM export dir}")
+        self.model_default = os.environ.get("KFX_LM_MODEL_DEFAULT", "")
+        self.weight_slots = int(
+            os.environ.get("KFX_LM_WEIGHT_SLOTS", "0"))
+        self.model_idle_s = float(
+            os.environ.get("KFX_LM_WEIGHT_IDLE_S", "0"))
         # Liveness: seconds of decode-loop stall (while busy) before
         # the engine's heartbeat reads wedged and /healthz fails the
         # probe. Size it well above one worst-case dispatch (a chunk on
@@ -328,7 +355,10 @@ class LMPredictor(Predictor):
             # than the target — a 1-layer model has nothing to
             # truncate, so speculation silently stays off there.
             draft = 0
-            if self.spec and cfg.n_layers > 1:
+            if self.spec and cfg.n_layers > 1 and not self.models:
+                # A weight pool excludes speculation (the draft would
+                # need its own per-model truncation); auto-disable
+                # rather than fail construction.
                 draft = self.spec_layers or max(1, cfg.n_layers // 4)
                 draft = min(draft, cfg.n_layers - 1)
             # registry as a thunk: register() swaps self.metrics for
@@ -366,7 +396,13 @@ class LMPredictor(Predictor):
                 kv_peer_send=(self._kv_send
                               if (self.kv_peers or self.role == "prefill")
                               else None),
-                kv_offload_pages=max(0, self.kv_offload_pages))
+                kv_offload_pages=max(0, self.kv_offload_pages),
+                models=self.models or None,
+                weight_slots=(max(0, self.weight_slots)
+                              if self.models else 0),
+                model_default=(self.model_default
+                               if self.models else ""),
+                model_idle_s=max(0.0, self.model_idle_s))
             self._attach_usage()
             buckets = self.warm_buckets or self._engine.prompt_buckets
             # First bucket + the decode chunk warm synchronously —
@@ -464,6 +500,32 @@ class LMPredictor(Predictor):
         if self._engine is None or self._engine.flight is None:
             return None
         return self._engine.flight.requests()
+
+    def pooled_models(self) -> Dict[str, bool]:
+        """{model name: resident-in-HBM?} over the weight pool's full
+        source set (docs/serving.md "Weights as a fleet resource") —
+        empty without a pool. A name mapped to False is "pooled but
+        unloaded": servable after one measured weight swap, so
+        readiness reports it available rather than missing."""
+        if self._engine is None:
+            return {}
+        return self._engine.pooled_models()
+
+    def weight_stats(self) -> Optional[Dict[str, Any]]:
+        """Weight-pool occupancy counters for /v1/models status (None
+        without a pool)."""
+        if self._engine is None:
+            return None
+        return self._engine.weight_stats()
+
+    def evict_model(self, name: str) -> bool:
+        """Operator scale-to-zero push: drop ``name``'s weight slot if
+        it is idle (refcount 0, not the pinned default). Returns True
+        when the slot was freed; False when unknown, not resident, or
+        held by in-flight requests."""
+        if self._engine is None:
+            return False
+        return self._engine.evict_model(name)
 
     def drain(self, wait_s: float = 0.0) -> bool:
         """Stop admitting and wait up to ``wait_s`` for in-flight
@@ -563,7 +625,11 @@ class LMPredictor(Predictor):
     def _resume_key_for(self, p: Dict[str, Any]) -> str:
         """The resume key this parsed single-prompt body would carry —
         derived with the same adapter-default resolution the engine
-        applies, so donor and receiver agree without a side channel."""
+        applies, so donor and receiver agree without a side channel.
+        The per-request model is deliberately NOT part of the key: a
+        weight-pool replica refuses KV transfer in both directions
+        (the pages would decode under different weights), so a pooled
+        request never has a resumable migration to claim."""
         adapter = p["adapter"]
         if adapter is None:
             adapter = getattr(self._engine, "adapter_default", "")
@@ -623,6 +689,17 @@ class LMPredictor(Predictor):
             raise ValueError(
                 "adapter selection requires the engine path "
                 "(KFX_LM_ENGINE=1)")
+        # Per-request model selection (multi-model weight pool): a
+        # string name from spec.<rev>.models.artifacts; absent = the
+        # revision's default model. Unknown names are a client 400; a
+        # pool with every slot refcount-pinned is a 503 (requeue).
+        model = body.get("model")
+        if model is not None and not isinstance(model, str):
+            raise ValueError("model must be a string model name")
+        if model is not None and self._engine is None:
+            raise ValueError(
+                "model selection requires the engine path "
+                "(KFX_LM_ENGINE=1)")
         # QoS class ("interactive"/"batch"): per-request override of
         # the revision default; validated by the engine.
         qos = body.get("qos")
@@ -647,6 +724,7 @@ class LMPredictor(Predictor):
             "prompts": [list(map(int, p)) for p in prompts],
             "stop": stop,
             "adapter": adapter,
+            "model": model,
             "qos": qos,
             "tenant": tenant or None,
             "deadline_s": (float(deadline_ms) / 1000.0
@@ -710,7 +788,8 @@ class LMPredictor(Predictor):
                 # computes.
                 reqs = self._engine.submit_batch(
                     p["prompts"], stop_token=p["stop"],
-                    adapter=p["adapter"], qos=p["qos"],
+                    adapter=p["adapter"], model=p["model"],
+                    qos=p["qos"],
                     deadline_s=p["deadline_s"], tenant=p["tenant"],
                     **p["kw"])
             deadline = time.monotonic() \
@@ -780,7 +859,7 @@ class LMPredictor(Predictor):
         q: "_queue.Queue[Optional[int]]" = _queue.Queue()
         req = self._engine.submit(
             p["prompts"][0], stop_token=p["stop"],
-            adapter=p["adapter"], qos=p["qos"],
+            adapter=p["adapter"], model=p["model"], qos=p["qos"],
             deadline_s=p["deadline_s"], tenant=p["tenant"],
             meter_skip=skip, on_token=q.put, **p["kw"])
         return self._stream_events(req, q, skip, budget_s)

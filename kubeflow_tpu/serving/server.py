@@ -4,6 +4,7 @@ V1 protocol parity (reference kfserving python server, SURVEY.md §3 CS3):
     GET  /v1/models                     -> {"models": [...]}
     GET  /v1/models/{m}                 -> {"name": m, "ready": true}
     POST /v1/models/{m}:predict         -> {"predictions": [...]}
+    POST /v1/models/{m}:evict           -> {"model": n, "evicted": b}
     GET  /healthz | /metrics
     POST /drain[?wait_s=S]              -> {"draining": true, "drained": b}
 
@@ -565,6 +566,12 @@ class ModelServer:
                                "adapter_slots"),
                               ("kfx_lm_adapter_slots_free",
                                "adapter_slots_free"),
+                              ("kfx_lm_weight_slots",
+                               "weight_slots"),
+                              ("kfx_lm_weight_slots_free",
+                               "weight_slots_free"),
+                              ("kfx_lm_weight_models_loaded",
+                               "weight_models_loaded"),
                               ("kfx_lm_spec_accept_rate",
                                "spec_accept_rate")):
             for labels, value in self.metrics.gauge(family).samples():
@@ -578,6 +585,17 @@ class ModelServer:
             model = labels.get("model", "")
             out.setdefault(model, {})["quant"] = quant_mode_string(
                 labels.get("weights", "f32"), labels.get("kv", "f32"))
+        # Per-model weight-pool residency: the pooled label rides the
+        # gauge; the JSON block flattens it into a {name: loaded?}
+        # map the operator folds into status.pooledModels ("pooled
+        # but unloaded" shows as False, never as absence).
+        for labels, value in self.metrics.gauge(
+                "kfx_lm_weight_model_loaded").samples():
+            model = labels.get("model", "")
+            pooled = labels.get("pooled", "")
+            if pooled:
+                out.setdefault(model, {}).setdefault(
+                    "pooled", {})[pooled] = bool(value)
         # Per-QoS-class in-flight split (request plane): the qos label
         # rides the gauge; the JSON block flattens it into the
         # active_interactive / active_batch fields `kfx top` renders
@@ -722,13 +740,33 @@ class ModelServer:
             name = path[len("/v1/models/"):]
             p = self.predictors.get(name)
             if p is None:
+                # A pooled model name resolves to the predictor that
+                # hosts its weight pool: "pooled but unloaded" is
+                # ready-after-one-swap, not 404 — the activator routes
+                # the cold request here and the swap happens on
+                # admission, no process spawn.
+                for host in self.predictors.values():
+                    pooled = getattr(host, "pooled_models",
+                                     lambda: {})()
+                    if name in pooled:
+                        h._send(200, {
+                            "name": name,
+                            "ready": host.ready and not self.draining,
+                            "pooled": True,
+                            "loaded": bool(pooled[name]),
+                            "host": host.name})
+                        return
                 h._send(404, {"error": f"model {name!r} not found"})
             else:
                 # A draining server is deliberately not ready: the
                 # operator's readiness probe (and the router behind it)
                 # must route around a replica that is about to die.
-                h._send(200, {"name": name,
-                              "ready": p.ready and not self.draining})
+                body = {"name": name,
+                        "ready": p.ready and not self.draining}
+                pooled = getattr(p, "pooled_models", lambda: {})()
+                if pooled:
+                    body["pooledModels"] = pooled
+                h._send(200, body)
         else:
             h._send(404, {"error": f"no route {path}"})
 
@@ -782,6 +820,9 @@ class ModelServer:
         if route.startswith("/v1/models/") and route.endswith(":kvpeers"):
             name = route[len("/v1/models/"):-len(":kvpeers")]
             return self._handle_kvpeers(h, name)
+        if route.startswith("/v1/models/") and route.endswith(":evict"):
+            name = route[len("/v1/models/"):-len(":evict")]
+            return self._handle_evict(h, name)
         if not (path.startswith("/v1/models/") and path.endswith(":predict")):
             h._send(404, {"error": f"no route {path}"})
             return
@@ -1008,6 +1049,34 @@ class ModelServer:
             h._send(500, {"error": str(e)})
             return
         h._send(200, stats)
+
+    def _handle_evict(self, h, name: str) -> None:
+        """Operator scale-to-zero push: drop an idle pooled model's
+        weight slot (body: {"model": name}). Evicting is best-effort —
+        a slot refcount-held by in-flight requests (or the pinned
+        default) stays resident and the response says so, letting the
+        operator retry on the next reconcile instead of racing the
+        decode loop."""
+        p = self.predictors.get(name)
+        if p is None:
+            h._send(404, {"error": f"model {name!r} not found"})
+            return
+        if not getattr(p, "pooled_models", lambda: {})():
+            h._send(400, {"error": f"model {name!r} does not host a "
+                                   "weight pool"})
+            return
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            body = json.loads(h.rfile.read(n).decode() or "{}")
+            target = body.get("model", "")
+        except (ValueError, UnicodeDecodeError) as e:
+            h._send(400, {"error": str(e)})
+            return
+        if not isinstance(target, str) or not target:
+            h._send(400, {"error": "body must carry a model name"})
+            return
+        evicted = p.evict_model(target)
+        h._send(200, {"model": target, "evicted": bool(evicted)})
 
     def _handle_kvpeers(self, h, name: str) -> None:
         """Operator hook: replace this replica's decode-peer URL set

@@ -502,6 +502,57 @@ spec:
                            match=r"spec\.canary\.adapters"):
             load_manifests(bad)
 
+    def test_models_field_paths(self):
+        """spec.predictor.models {artifacts, default, slots,
+        idleSeconds} (multi-model weight pool): artifacts is a
+        required non-empty {name: LM export URI} map, default is
+        REQUIRED and must name one of them (it is the resident model
+        the revision's storageUri loads), slots is an integer >= 1
+        (`slots: true` is a 400 at apply), idleSeconds a number >= 0
+        — and the pool excludes adapters and non-mixed roles."""
+        ok = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n    models:\n"
+            "      artifacts: {m0: 'file:///tmp/m/m0', "
+            "m1: 'file:///tmp/m/m1'}\n"
+            "      default: m0\n      slots: 2\n"
+            "      idleSeconds: 600\n", 1)
+        (isvc,) = load_manifests(ok)
+        assert isvc.predictor()["models"]["default"] == "m0"
+        for bad_val, path in (
+                ("{artifacts: {}}", "models.artifacts"),
+                ("{artifacts: [m0]}", "models.artifacts"),
+                ("{artifacts: {m0: 3}}", r"models\.artifacts\['m0'\]"),
+                ("{artifacts: {m0: x}}", "models.default"),
+                ("{artifacts: {m0: x}, default: m9}", "models.default"),
+                ("{artifacts: {m0: x}, default: m0, slots: true}",
+                 "models.slots"),
+                ("{artifacts: {m0: x}, default: m0, slots: 0}",
+                 "models.slots"),
+                ("{artifacts: {m0: x}, default: m0, idleSeconds: -1}",
+                 "models.idleSeconds"),
+                ("pool", r"spec\.predictor\.models")):
+            bad = self.ISVC_YAML.replace(
+                "predictor:\n",
+                f"predictor:\n    models: {bad_val}\n", 1)
+            with pytest.raises(ValidationError, match=path):
+                load_manifests(bad)
+        # One executable per replica: the pool excludes adapters.
+        bad = self.ISVC_YAML.replace(
+            "predictor:\n",
+            "predictor:\n"
+            "    models: {artifacts: {m0: x}, default: m0}\n"
+            "    adapters: {artifacts: {a: y}}\n", 1)
+        with pytest.raises(ValidationError, match="incompatible"):
+            load_manifests(bad)
+        # The canary revision is validated on its own field path.
+        bad = self.ISVC_YAML + (
+            "  canary:\n    models: {artifacts: {}}\n"
+            "    jax: {storageUri: 'file:///tmp/models/resnet'}\n")
+        with pytest.raises(ValidationError,
+                           match=r"spec\.canary\.models"):
+            load_manifests(bad)
+
     def test_drain_window_field_path(self):
         """spec.predictor.drainWindowSeconds bounds drain-before-kill:
         any number >= 0 passes (0 = kill immediately, the escape

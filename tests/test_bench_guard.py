@@ -150,6 +150,22 @@ class TestBoxGuard:
                     "lm_adapters_sep_engines_hbm_ratio"):
             assert key in bench.CONTRACT_KEYS, key
 
+    def test_multimodel_keys_in_contract(self):
+        """The multi-model weight-pool acceptance numbers (ISSUE 20: 8
+        checkpoints on one engine at <= ~1.5x one engine's HBM bytes,
+        swap-in cold start below process respawn, per-model greedy
+        outputs byte-identical to dedicated engines) ride the compact
+        BENCH_CONTRACT line; pinned like the adapter keys."""
+        for key in ("lm_multimodel_n", "lm_multimodel_tokens_per_s",
+                    "lm_multimodel_hbm_mb",
+                    "lm_multimodel_base_hbm_mb",
+                    "lm_multimodel_hbm_ratio",
+                    "lm_multimodel_sep_engines_hbm_ratio",
+                    "lm_multimodel_byte_identical",
+                    "lm_multimodel_swap_cold_s",
+                    "lm_multimodel_respawn_cold_s"):
+            assert key in bench.CONTRACT_KEYS, key
+
     def test_qos_keys_in_contract(self):
         """The request-plane acceptance numbers (ISSUE 17: interactive
         p99 ITL with a batch flood <= 1.5x no-flood, deadline sheds >

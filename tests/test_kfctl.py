@@ -151,7 +151,7 @@ class TestKfxVerbs:
                 "kvUtil": 0.42, "prefillSkip": 0.63,
                 "specAcceptRate": 0.87,
                 "quant": "w8+kv8", "adapters": "3/8",
-                "classes": "2/1", "restarts": 3,
+                "models": "2/4", "classes": "2/1", "restarts": 3,
                 "role": "prefill", "migrations": 17}},
         }
         clf = InferenceService.from_dict({
@@ -177,22 +177,26 @@ class TestKfxVerbs:
         # (multi-tenant LoRA revisions; "-" when the engine has no
         # adapter pool).
         assert rows[0][11] == "3/8"
+        # MODELS column: the multi-model weight pool as loaded/slots;
+        # "-" when the engine has no weight pool.
+        assert rows[0][12] == "2/4"
         # I/B column: the in-flight QoS-class split (request plane) as
         # interactive/batch; "-" on classifier revisions.
-        assert rows[0][12] == "2/1"
+        assert rows[0][13] == "2/1"
         # MIG column: cumulative KV migrations out of the revision's
         # replicas (disagg handoffs + drain/scale-in moves).
-        assert rows[0][13] == "17"
+        assert rows[0][14] == "17"
         # RESTARTS column, fed from the operator's restart accounting
         # (same number kfx_replica_restarts_total counts).
-        assert rows[0][14] == "3"
+        assert rows[0][15] == "3"
         assert rows[1][3] == "-"  # no role sampled
         assert rows[1][7] == "-" and rows[1][8] == "-"
         assert rows[1][9] == "-" and rows[1][10] == "-"
         assert rows[1][11] == "-"  # no adapter pool sampled
-        assert rows[1][12] == "-"  # no request-plane classes sampled
-        assert rows[1][13] == "-"  # no KV migrations sampled
-        assert rows[1][14] == "-"  # operator never reported restarts
+        assert rows[1][12] == "-"  # no weight pool sampled
+        assert rows[1][13] == "-"  # no request-plane classes sampled
+        assert rows[1][14] == "-"  # no KV migrations sampled
+        assert rows[1][15] == "-"  # operator never reported restarts
 
     def test_init_then_generate(self, tmp_path, capsys, monkeypatch):
         from kubeflow_tpu.cli import main as kfx_main

@@ -608,6 +608,62 @@ class TestAdapterEnvPlumbing:
         assert env == {}
 
 
+class TestModelsEnvPlumbing:
+    def test_models_spec_exports_env(self):
+        """spec.predictor.models -> the replica's KFX_LM_MODELS /
+        KFX_LM_MODEL_DEFAULT / KFX_LM_WEIGHT_* env (the multi-model
+        weight-pool knobs LMPredictor reads at load): the artifacts
+        map rides as JSON with the default model's name, slots/
+        idleSeconds export only when explicit, and non-predictor
+        roles export nothing."""
+        import json as _json
+
+        from kubeflow_tpu.operators.serving import _Revision
+
+        rev = _Revision(name="default", model_name="m", model_dir="d",
+                        workdir="w", batcher=None,
+                        models={"artifacts": {"m0": "file:///m/m0",
+                                              "m1": "file:///m/m1"},
+                                "default": "m0", "slots": 2,
+                                "idleSeconds": 600})
+        env: dict = {}
+        rev._models_env(env)
+        assert _json.loads(env["KFX_LM_MODELS"]) == {
+            "m0": "file:///m/m0", "m1": "file:///m/m1"}
+        assert env["KFX_LM_MODEL_DEFAULT"] == "m0"
+        assert env["KFX_LM_WEIGHT_SLOTS"] == "2"
+        assert env["KFX_LM_WEIGHT_IDLE_S"] == "600.0"
+        env = {}
+        rev.models = {"artifacts": {"m0": "file:///m/m0"},
+                      "default": "m0"}
+        rev._models_env(env)
+        assert set(env) == {"KFX_LM_MODELS", "KFX_LM_MODEL_DEFAULT"}
+        env = {}
+        rev.models = None
+        rev._models_env(env)
+        assert env == {}
+        rev.models = {"artifacts": {"m0": "file:///m/m0"},
+                      "default": "m0"}
+        rev.role = "transformer"
+        env = {}
+        rev._models_env(env)
+        assert env == {}
+
+    def test_fmt_pooled_column(self):
+        """`kfx get isvc`'s POOLED column renders status.pooledModels:
+        resident names plain, pooled-but-unloaded parenthesized,
+        loaded-anywhere wins across revisions."""
+        from kubeflow_tpu.cli import _fmt_pooled
+
+        assert _fmt_pooled({}) == "-"
+        assert _fmt_pooled(
+            {"default": {"m0": True, "m1": False}}) == "m0,(m1)"
+        # A model loaded on ANY revision renders resident.
+        assert _fmt_pooled(
+            {"default": {"m1": False},
+             "canary": {"m1": True}}) == "m1"
+
+
 @pytest.mark.slow
 class TestInferenceServiceE2E:
     def test_speculative_spec_exports_env(self):

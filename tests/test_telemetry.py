@@ -464,12 +464,12 @@ class TestTopWatchRates:
         # the cumulative status snapshot.
         assert rows[0][8] == "25%"
         # TOK/S + RPS sit after the MIG and RESTARTS columns.
-        assert rows[0][15] == "12.3" and rows[0][16] == "4.5"
+        assert rows[0][16] == "12.3" and rows[0][17] == "4.5"
         # Without history the snapshot and "-" cells remain.
         rows = _serving_top_rows(
             [isvc], rates_fn=lambda ns, name, rev: (None, None, None))
         assert rows[0][8] == "90%"
-        assert rows[0][15] == "-" and rows[0][16] == "-"
+        assert rows[0][16] == "-" and rows[0][17] == "-"
 
     def test_top_watch_single_shot(self, tmp_path, capsys):
         from kubeflow_tpu.cli import KfxCLI
